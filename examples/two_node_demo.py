@@ -1,0 +1,84 @@
+"""Two-node demo: the full post-quantum secure messaging flow via the public API.
+
+Run:  python examples/two_node_demo.py
+
+Creates two complete stacks (encrypted vault + TCP node + protocol engine) in
+one process, performs the authenticated ML-KEM-768 + ML-DSA-65 + AES-256-GCM
+handshake over real localhost TCP, exchanges a verified message and a file,
+then prints audit-log metrics and key history.
+"""
+
+import asyncio
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from quantum_resistant_p2p_tpu.app import SecureMessaging
+from quantum_resistant_p2p_tpu.net import P2PNode
+from quantum_resistant_p2p_tpu.storage import KeyStorage, SecureLogger
+
+
+async def main() -> None:
+    tmp = Path(tempfile.mkdtemp(prefix="qrp2p_demo_"))
+
+    stacks = {}
+    for name in ("alice", "bob"):
+        vault = KeyStorage(tmp / f"{name}.vault.json")
+        assert vault.unlock(f"{name}-password"), "fresh vault unlock"
+        audit = SecureLogger(vault.get_or_create_purpose_key("audit"), tmp / f"{name}.logs")
+        node = P2PNode(node_id=name, host="127.0.0.1", port=0)
+        await node.start()
+        sm = SecureMessaging(node, key_storage=vault, secure_logger=audit)
+        stacks[name] = (vault, audit, node, sm)
+
+    alice_vault, alice_audit, alice_node, alice = stacks["alice"]
+    bob_vault, bob_audit, bob_node, bob = stacks["bob"]
+
+    inbox: list = []
+    bob.register_message_listener(lambda peer, m: inbox.append((peer, m)))
+
+    peer = await alice_node.connect_to_peer("127.0.0.1", bob_node.port)
+    print(f"[1] alice connected to: {peer}")
+
+    ok = await alice.initiate_key_exchange("bob")
+    print(f"[2] handshake (ML-KEM-768 + ML-DSA-65): {'OK' if ok else 'FAILED'}")
+    print(f"    alice state: {alice.ke_state['bob'].value}")
+    same = alice.shared_keys["bob"] == bob.shared_keys["alice"]
+    print(f"    both sides derived the same AEAD key: {same}")
+
+    msg = await alice.send_message("bob", b"hello post-quantum world")
+    await asyncio.sleep(0.3)
+    texts = [(p, m.content) for p, m in inbox if not m.is_system]
+    print(f"[3] bob received: {texts}")
+
+    blob = tmp / "paper.pdf"
+    blob.write_bytes(b"%PDF-1.4 fake" * 9000)  # ~115 KiB -> chunked transport
+    await alice.send_file("bob", blob)
+    await asyncio.sleep(0.5)
+    files = [(m.filename, len(m.content)) for _, m in inbox if m.is_file]
+    print(f"[4] bob received file: {files}")
+
+    print(f"[5] alice audit metrics: {alice_audit.get_security_metrics()['event_counts']}")
+    hist = alice_vault.list_key_history("bob")
+    print(f"[6] alice key history entries for bob: {len(hist)} (algo: "
+          f"{alice_vault.get_key_history_value(hist[0]['name'])['algorithm']})")
+
+    # negative probes
+    locked = KeyStorage(tmp / "alice.vault.json")
+    print(f"[7] vault unlock with wrong password: {locked.unlock('wrong')}")
+
+    before = len([m for _, m in inbox if not m.is_system])
+    await alice_node.send_message("bob", "secure_message", ct=b"\x00" * 64, ad=b"{}")
+    await asyncio.sleep(0.3)
+    after = len([m for _, m in inbox if not m.is_system])
+    print(f"[8] forged ciphertext delivered to app layer: {after != before}")
+
+    for _, _, node, _ in stacks.values():
+        await node.stop()
+    print("[9] clean shutdown")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,25 @@
+"""quantum_resistant_p2p_tpu — a TPU-native post-quantum-secure P2P framework.
+
+Brand-new framework with the capability set of the reference application
+``ShadowCZEch/quantum-resistant-p2p`` (see SURVEY.md): post-quantum KEMs
+(ML-KEM, FrodoKEM, HQC), signatures (ML-DSA, SPHINCS+), AEAD messaging,
+encrypted key storage and audit logging, asyncio P2P networking — but with the
+cryptographic core implemented as batched JAX/Pallas TPU programs instead of
+serial ctypes calls into liboqs (reference: vendor/oqs.py, crypto/*.py).
+
+Layering (mirrors SURVEY.md §7.1):
+
+- ``core``     — primitive kernels: Keccak sponge, SHA-256, NTT, samplers, codecs
+- ``kem``      — ML-KEM / FrodoKEM / HQC batch implementations
+- ``sig``      — ML-DSA / SPHINCS+ batch implementations
+- ``pyref``    — pure-Python FIPS reference implementations (bit-exactness oracle
+                 and CPU fallback backend; hashlib is the Keccak oracle)
+- ``provider`` — the algorithm-plugin boundary (same API shape as the
+                 reference's crypto/ module) + async batching queue
+- ``storage``  — encrypted key vault, atomic/locked file IO, encrypted audit log
+- ``net``      — asyncio TCP P2P node, UDP discovery, node identity
+- ``app``      — SecureMessaging protocol engine + MessageStore
+- ``cli``      — interactive client (capability parity with the reference UI)
+"""
+
+__version__ = "0.1.0"

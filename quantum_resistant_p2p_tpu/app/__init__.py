@@ -1,0 +1,11 @@
+"""Application layer: the secure-messaging protocol engine and message store.
+
+Capability parity with the reference's app/ package (SURVEY.md §2 row 12-13):
+authenticated ephemeral-KEM handshakes, sign-then-encrypt AEAD messaging,
+crypto-settings gossip, algorithm hot-swap, dedup, key persistence.
+"""
+
+from .message_store import Message, MessageStore
+from .messaging import KeyExchangeState, SecureMessaging
+
+__all__ = ["Message", "MessageStore", "KeyExchangeState", "SecureMessaging"]

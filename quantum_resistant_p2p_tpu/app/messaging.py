@@ -1,0 +1,657 @@
+"""SecureMessaging — the post-quantum secure messaging protocol engine.
+
+Capability parity with the reference's app/messaging.py (2146 LoC), redesigned
+around the provider registry and (optionally) the TPU batching queue:
+
+* 5-message authenticated handshake with ephemeral KEM keys (reference flow:
+  app/messaging.py:546-1261 — init / response / confirm / test / rejected),
+  signature-authenticated with a 300 s replay window and typed rejection
+  reasons (app/messaging.py:724-905).
+* Sign-then-encrypt AEAD messaging with associated-data cross-checks and
+  duplicate suppression (app/messaging.py:1437-1668).
+* Crypto-settings gossip + algorithm hot-swap: changing the KEM drops shared
+  keys and re-initiates; changing the AEAD re-derives from the stored raw
+  shared secret without a new handshake; changing the signature algorithm
+  loads-or-generates a keypair lazily (app/messaging.py:1741-1851).
+* Shared keys persisted to the vault with history (app/messaging.py:274-309);
+  fresh handshake per session by design.
+
+Algorithm objects come from the provider registry — replacing the reference's
+display-name string matching (app/messaging.py:1893-2011) with canonical names.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import json
+import logging
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Callable
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from ..net.p2p_node import P2PNode
+from ..provider import get_kem, get_signature, get_symmetric
+from ..provider.base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricAlgorithm
+from .message_store import Message
+
+logger = logging.getLogger(__name__)
+
+REPLAY_WINDOW = 300.0  # seconds, matching the reference's timestamp check
+KEY_EXCHANGE_TIMEOUT = 20.0
+DEDUP_CAPACITY = 1000
+
+
+class KeyExchangeState(enum.Enum):
+    NONE = "none"
+    INITIATED = "initiated"
+    RESPONDED = "responded"
+    CONFIRMED = "confirmed"
+    ESTABLISHED = "established"
+
+
+class RejectReason(str, enum.Enum):
+    INVALID_SIGNATURE = "invalid_signature"
+    IDENTITY_MISMATCH = "identity_mismatch"
+    TIMESTAMP_INVALID = "timestamp_invalid"
+    ALGORITHM_MISMATCH = "algorithm_mismatch"
+    KEYGEN_ERROR = "keypair_generation_error"
+    ENCAPSULATION_ERROR = "encapsulation_error"
+    GENERAL_ERROR = "general_error"
+
+
+def _canonical(data: dict) -> bytes:
+    return json.dumps(data, sort_keys=True, separators=(",", ":")).encode()
+
+
+def derive_message_key(shared_secret: bytes, id_a: str, id_b: str, aead_name: str) -> bytes:
+    """HKDF-SHA256 over the raw KEM secret, salted by the sorted peer ids.
+
+    Sorted ids make both sides derive identically (reference:
+    app/messaging.py:350-382); binding the AEAD name lets an AEAD hot-swap
+    re-derive a distinct key from the same secret (reference: :1797-1810).
+    """
+    ids = "|".join(sorted([id_a, id_b]))
+    return HKDF(
+        algorithm=hashes.SHA256(),
+        length=32,
+        salt=ids.encode(),
+        info=b"qrp2p-tpu/msgkey/" + aead_name.encode(),
+    ).derive(shared_secret)
+
+
+class SecureMessaging:
+    """Protocol engine: owns algorithms, per-peer keys, and the handshake FSM."""
+
+    def __init__(
+        self,
+        node: P2PNode,
+        key_storage=None,
+        secure_logger=None,
+        kem: KeyExchangeAlgorithm | None = None,
+        symmetric: SymmetricAlgorithm | None = None,
+        signature: SignatureAlgorithm | None = None,
+        backend: str = "cpu",
+    ):
+        self.node = node
+        self.key_storage = key_storage
+        self.secure_logger = secure_logger
+        self.backend = backend
+        self.kem = kem or get_kem("ML-KEM-768", backend)
+        self.symmetric = symmetric or get_symmetric("AES-256-GCM")
+        self.signature = signature or get_signature("ML-DSA-65", backend)
+
+        # per-peer protocol state
+        self.shared_keys: dict[str, bytes] = {}
+        self.raw_secrets: dict[str, bytes] = {}  # for AEAD-change re-derive
+        self.ke_state: dict[str, KeyExchangeState] = {}
+        self.peer_settings: dict[str, dict] = {}
+        self._ephemeral: dict[str, tuple[str, bytes]] = {}  # msg_id -> (peer, sk)
+        self._pending: dict[str, asyncio.Future] = {}
+        self._processed_ids: dict[str, float] = {}
+        self._listeners: list[Callable[[str, Message], None]] = []
+
+        self._sig_keypair = self._load_or_generate_sig_keypair()
+
+        for msg_type, handler in (
+            ("ke_init", self._handle_ke_init),
+            ("ke_response", self._handle_ke_response),
+            ("ke_confirm", self._handle_ke_confirm),
+            ("ke_test", self._handle_ke_test),
+            ("ke_reject", self._handle_ke_reject),
+            ("secure_message", self._handle_secure_message),
+            ("settings_update", self._handle_settings_update),
+            ("settings_request", self._handle_settings_request),
+        ):
+            node.register_message_handler(msg_type, handler)
+        node.register_connection_handler(self._on_connection_event)
+
+    # ------------------------------------------------------------------ util
+
+    @property
+    def node_id(self) -> str:
+        return self.node.node_id
+
+    def register_message_listener(self, cb: Callable[[str, Message], None]) -> None:
+        if cb not in self._listeners:
+            self._listeners.append(cb)
+
+    def _notify(self, peer_id: str, message: Message) -> None:
+        for cb in list(self._listeners):
+            try:
+                cb(peer_id, message)
+            except Exception:
+                logger.exception("message listener failed")
+
+    def _log(self, event_type: str, **fields: Any) -> None:
+        if self.secure_logger is not None:
+            try:
+                self.secure_logger.log_event(event_type, **fields)
+            except Exception:
+                logger.exception("audit log failed")
+
+    def _load_or_generate_sig_keypair(self) -> tuple[bytes, bytes]:
+        """Per-algorithm persistent signature keypair (reference: :254-272)."""
+        name = f"signature_keypair_{self.signature.name}"
+        if self.key_storage is not None and getattr(self.key_storage, "is_unlocked", False):
+            stored = self.key_storage.retrieve(name)
+            if stored:
+                import base64
+
+                return (
+                    base64.b64decode(stored["public"]),
+                    base64.b64decode(stored["secret"]),
+                )
+            pk, sk = self.signature.generate_keypair()
+            import base64
+
+            self.key_storage.store(
+                name,
+                {
+                    "public": base64.b64encode(pk).decode(),
+                    "secret": base64.b64encode(sk).decode(),
+                },
+            )
+            return pk, sk
+        return self.signature.generate_keypair()
+
+    def _dedup(self, message_id: str) -> bool:
+        """True if already seen; prunes the table at capacity (ref: :1506-1517)."""
+        if message_id in self._processed_ids:
+            return True
+        self._processed_ids[message_id] = time.time()
+        if len(self._processed_ids) > DEDUP_CAPACITY:
+            for mid, _ in sorted(self._processed_ids.items(), key=lambda kv: kv[1])[
+                : DEDUP_CAPACITY // 2
+            ]:
+                del self._processed_ids[mid]
+        return False
+
+    def _on_connection_event(self, event: str, peer_id: str) -> None:
+        if event == "connect":
+            # Fresh handshake per session: drop any stale key (ref: :447-452).
+            self.shared_keys.pop(peer_id, None)
+            self.raw_secrets.pop(peer_id, None)
+            self.ke_state[peer_id] = KeyExchangeState.NONE
+            asyncio.ensure_future(self.request_peer_settings(peer_id))
+        elif event == "disconnect":
+            self.ke_state[peer_id] = KeyExchangeState.NONE
+
+    # ----------------------------------------------------------- key exchange
+
+    def verify_key_exchange_state(self, peer_id: str) -> bool:
+        """Key present AND state established/confirmed AND peer connected."""
+        return (
+            peer_id in self.shared_keys
+            and self.ke_state.get(peer_id)
+            in (KeyExchangeState.CONFIRMED, KeyExchangeState.ESTABLISHED)
+            and self.node.is_connected(peer_id)
+        )
+
+    async def initiate_key_exchange(self, peer_id: str) -> bool:
+        """Initiator side of the 5-message handshake (reference: :546-693)."""
+        if self.ke_state.get(peer_id) == KeyExchangeState.INITIATED:
+            logger.info("handshake with %s already in flight", peer_id[:8])
+            return False
+        # Compatibility pre-check against gossiped peer settings (ref: :564-586).
+        peer_cfg = self.peer_settings.get(peer_id)
+        if peer_cfg and peer_cfg.get("kem") != self.kem.name:
+            logger.warning(
+                "algorithm mismatch with %s: %s vs %s",
+                peer_id[:8], self.kem.name, peer_cfg.get("kem"),
+            )
+            return False
+
+        message_id = str(uuid.uuid4())
+        try:
+            pk, sk = self.kem.generate_keypair()
+        except Exception:
+            logger.exception("ephemeral keygen failed")
+            return False
+        self._ephemeral[message_id] = (peer_id, sk)
+        self.ke_state[peer_id] = KeyExchangeState.INITIATED
+
+        ke_data = {
+            "message_id": message_id,
+            "kem": self.kem.name,
+            "aead": self.symmetric.name,
+            "public_key": pk.hex(),
+            "sender": self.node_id,
+            "recipient": peer_id,
+            "timestamp": time.time(),
+        }
+        sig = self.signature.sign(self._sig_keypair[1], _canonical(ke_data))
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[message_id] = fut
+
+        sent = await self.node.send_message(
+            peer_id,
+            "ke_init",
+            ke_data=ke_data,
+            sig=sig,
+            sig_algo=self.signature.name,
+            sig_pk=self._sig_keypair[0],
+        )
+        if not sent:
+            self._cleanup_exchange(message_id, peer_id)
+            return False
+        try:
+            await asyncio.wait_for(fut, KEY_EXCHANGE_TIMEOUT)
+            return True
+        except asyncio.TimeoutError:
+            # Timeout-but-key-exists recovery (reference: :670-681).
+            if peer_id in self.shared_keys:
+                return True
+            self._cleanup_exchange(message_id, peer_id)
+            self._log("key_exchange", peer=peer_id, success=False, reason="timeout")
+            return False
+        except RuntimeError as e:
+            # Typed rejection from the peer (ke_reject) or a local crypto error.
+            logger.warning("key exchange with %s failed: %s", peer_id[:8], e)
+            self._cleanup_exchange(message_id, peer_id)
+            return False
+
+    def _cleanup_exchange(self, message_id: str, peer_id: str) -> None:
+        self._ephemeral.pop(message_id, None)
+        self._pending.pop(message_id, None)
+        if self.ke_state.get(peer_id) == KeyExchangeState.INITIATED:
+            self.ke_state[peer_id] = KeyExchangeState.NONE
+
+    async def _reject(self, peer_id: str, message_id: str, reason: RejectReason) -> None:
+        await self.node.send_message(
+            peer_id, "ke_reject", message_id=message_id, reason=reason.value
+        )
+
+    def _check_common(self, peer_id: str, data: dict, sig: bytes, sig_pk: bytes,
+                      sig_algo: str) -> RejectReason | None:
+        """Signature + identity + replay-window checks shared by init/response."""
+        try:
+            verifier = (
+                self.signature
+                if sig_algo == self.signature.name
+                else get_signature(sig_algo, self.backend)
+            )
+        except Exception:
+            return RejectReason.ALGORITHM_MISMATCH
+        if not verifier.verify(sig_pk, _canonical(data), sig):
+            return RejectReason.INVALID_SIGNATURE
+        if data.get("sender") != peer_id or data.get("recipient") != self.node_id:
+            return RejectReason.IDENTITY_MISMATCH
+        if abs(time.time() - float(data.get("timestamp", 0))) > REPLAY_WINDOW:
+            return RejectReason.TIMESTAMP_INVALID
+        return None
+
+    async def _handle_ke_init(self, peer_id: str, msg: dict) -> None:
+        """Responder: verify, encapsulate, derive, reply (reference: :695-905)."""
+        data = msg.get("ke_data") or {}
+        message_id = data.get("message_id", "?")
+        err = self._check_common(peer_id, data, msg.get("sig", b""),
+                                 msg.get("sig_pk", b""), msg.get("sig_algo", ""))
+        if err is not None:
+            await self._reject(peer_id, message_id, err)
+            return
+        if data.get("kem") != self.kem.name or data.get("aead") != self.symmetric.name:
+            await self._reject(peer_id, message_id, RejectReason.ALGORITHM_MISMATCH)
+            return
+        try:
+            ct, secret = self.kem.encapsulate(bytes.fromhex(data["public_key"]))
+        except Exception:
+            logger.exception("encapsulation failed")
+            await self._reject(peer_id, message_id, RejectReason.ENCAPSULATION_ERROR)
+            return
+        self.raw_secrets[peer_id] = secret
+        self.shared_keys[peer_id] = derive_message_key(
+            secret, self.node_id, peer_id, self.symmetric.name
+        )
+        self.ke_state[peer_id] = KeyExchangeState.RESPONDED
+
+        resp = {
+            "message_id": message_id,
+            "ciphertext": ct.hex(),
+            "sender": self.node_id,
+            "recipient": peer_id,
+            "timestamp": time.time(),
+        }
+        sig = self.signature.sign(self._sig_keypair[1], _canonical(resp))
+        await self.node.send_message(
+            peer_id,
+            "ke_response",
+            ke_data=resp,
+            sig=sig,
+            sig_algo=self.signature.name,
+            sig_pk=self._sig_keypair[0],
+        )
+
+    async def _handle_ke_response(self, peer_id: str, msg: dict) -> None:
+        """Initiator: verify, decapsulate, confirm + AEAD test (ref: :907-1146)."""
+        data = msg.get("ke_data") or {}
+        message_id = data.get("message_id", "?")
+        entry = self._ephemeral.get(message_id)
+        if entry is None or entry[0] != peer_id:
+            logger.warning("ke_response for unknown exchange %s", message_id)
+            return
+        err = self._check_common(peer_id, data, msg.get("sig", b""),
+                                 msg.get("sig_pk", b""), msg.get("sig_algo", ""))
+        if err is not None:
+            self._fail_pending(message_id, err.value)
+            return
+        try:
+            secret = self.kem.decapsulate(entry[1], bytes.fromhex(data["ciphertext"]))
+        except Exception:
+            logger.exception("decapsulation failed")
+            self._fail_pending(message_id, "decapsulation_error")
+            return
+        finally:
+            # Delete the ephemeral secret key immediately (reference: :1041).
+            self._ephemeral.pop(message_id, None)
+
+        self.raw_secrets[peer_id] = secret
+        key = derive_message_key(secret, self.node_id, peer_id, self.symmetric.name)
+        self.shared_keys[peer_id] = key
+        self.ke_state[peer_id] = KeyExchangeState.CONFIRMED
+        self._save_peer_key(peer_id, secret)
+
+        confirm = {
+            "message_id": message_id,
+            "sender": self.node_id,
+            "recipient": peer_id,
+            "timestamp": time.time(),
+        }
+        sig = self.signature.sign(self._sig_keypair[1], _canonical(confirm))
+        await self.node.send_message(
+            peer_id, "ke_confirm", ke_data=confirm, sig=sig,
+            sig_algo=self.signature.name, sig_pk=self._sig_keypair[0],
+        )
+        test_ct = self.symmetric.encrypt(key, b"key-exchange-test", message_id.encode())
+        await self.node.send_message(peer_id, "ke_test", ct=test_ct, message_id=message_id)
+
+        self._log(
+            "key_exchange", peer=peer_id, success=True,
+            algorithm=self.kem.name, role="initiator",
+        )
+        fut = self._pending.pop(message_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(True)
+
+    def _fail_pending(self, message_id: str, reason: str) -> None:
+        fut = self._pending.pop(message_id, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(RuntimeError(f"key exchange failed: {reason}"))
+
+    async def _handle_ke_confirm(self, peer_id: str, msg: dict) -> None:
+        data = msg.get("ke_data") or {}
+        err = self._check_common(peer_id, data, msg.get("sig", b""),
+                                 msg.get("sig_pk", b""), msg.get("sig_algo", ""))
+        if err is not None:
+            logger.warning("bad ke_confirm from %s: %s", peer_id[:8], err.value)
+            return
+        if self.ke_state.get(peer_id) == KeyExchangeState.RESPONDED:
+            self.ke_state[peer_id] = KeyExchangeState.ESTABLISHED
+            secret = self.raw_secrets.get(peer_id)
+            if secret is not None:
+                self._save_peer_key(peer_id, secret)
+            self._log(
+                "key_exchange", peer=peer_id, success=True,
+                algorithm=self.kem.name, role="responder",
+            )
+
+    async def _handle_ke_test(self, peer_id: str, msg: dict) -> None:
+        key = self.shared_keys.get(peer_id)
+        if key is None:
+            return
+        try:
+            pt = self.symmetric.decrypt(
+                key, msg.get("ct", b""), str(msg.get("message_id", "")).encode()
+            )
+        except ValueError:
+            logger.warning("ke_test decrypt failed from %s", peer_id[:8])
+            return
+        if pt == b"key-exchange-test":
+            sysmsg = Message(
+                content=b"Secure connection established",
+                sender_id=peer_id,
+                recipient_id=self.node_id,
+                is_system=True,
+                key_exchange_algo=self.kem.name,
+                symmetric_algo=self.symmetric.name,
+                signature_algo=self.signature.name,
+            )
+            self._notify(peer_id, sysmsg)
+
+    async def _handle_ke_reject(self, peer_id: str, msg: dict) -> None:
+        """Typed rejection handling (reference: :1282-1337)."""
+        message_id = str(msg.get("message_id", ""))
+        reason = str(msg.get("reason", "unknown"))
+        logger.warning("key exchange rejected by %s: %s", peer_id[:8], reason)
+        self._ephemeral.pop(message_id, None)
+        self.ke_state[peer_id] = KeyExchangeState.NONE
+        self._log("key_exchange", peer=peer_id, success=False, reason=reason)
+        self._fail_pending(message_id, reason)
+
+    def _save_peer_key(self, peer_id: str, secret: bytes) -> None:
+        if self.key_storage is not None and getattr(self.key_storage, "is_unlocked", False):
+            try:
+                self.key_storage.save_peer_shared_key(peer_id, secret, self.kem.name)
+            except Exception:
+                logger.exception("failed to persist shared key")
+
+    # --------------------------------------------------------- secure message
+
+    async def send_message(
+        self,
+        peer_id: str,
+        content: bytes,
+        is_file: bool = False,
+        filename: str | None = None,
+    ) -> Message | None:
+        """Sign-then-encrypt send (reference: :1560-1668)."""
+        if not self.verify_key_exchange_state(peer_id):
+            ok = await self.initiate_key_exchange(peer_id)
+            if not ok and peer_id not in self.shared_keys:
+                logger.warning("no shared key with %s; message not sent", peer_id[:8])
+                return None
+        message = Message(
+            content=content,
+            sender_id=self.node_id,
+            recipient_id=peer_id,
+            is_file=is_file,
+            filename=filename,
+            key_exchange_algo=self.kem.name,
+            symmetric_algo=self.symmetric.name,
+            signature_algo=self.signature.name,
+        )
+        package = {
+            "message": message.to_dict(),
+            "sig_algo": self.signature.name,
+        }
+        sig = self.signature.sign(self._sig_keypair[1], _canonical(package["message"]))
+        package["sig"] = sig.hex()
+        package["sig_pk"] = self._sig_keypair[0].hex()
+        ad = _canonical(
+            {
+                "type": "secure_message",
+                "message_id": message.message_id,
+                "sender": self.node_id,
+                "recipient": peer_id,
+                "is_file": is_file,
+            }
+        )
+        ct = self.symmetric.encrypt(self.shared_keys[peer_id], _canonical(package), ad)
+        sent = await self.node.send_message(peer_id, "secure_message", ct=ct, ad=ad)
+        if not sent:
+            return None
+        self._log(
+            "message_sent", peer=peer_id, size=len(content),
+            algorithm=self.symmetric.name, is_file=is_file,
+        )
+        return message
+
+    async def send_file(self, peer_id: str, path: str | Path) -> Message | None:
+        p = Path(path)
+        return await self.send_message(
+            peer_id, p.read_bytes(), is_file=True, filename=p.name
+        )
+
+    async def _handle_secure_message(self, peer_id: str, msg: dict) -> None:
+        """Decrypt -> verify -> cross-check -> dedup -> fan out (ref: :1437-1558)."""
+        key = self.shared_keys.get(peer_id)
+        if key is None:
+            logger.warning("secure message from %s without shared key", peer_id[:8])
+            return
+        ad: bytes = msg.get("ad", b"")
+        try:
+            pt = self.symmetric.decrypt(key, msg.get("ct", b""), ad)
+        except ValueError:
+            logger.warning("AEAD decrypt failed from %s", peer_id[:8])
+            return
+        try:
+            package = json.loads(pt)
+            message = Message.from_dict(package["message"])
+            ad_data = json.loads(ad)
+        except (ValueError, KeyError, TypeError):
+            logger.warning("malformed secure message from %s", peer_id[:8])
+            return
+        # Verify signature over the message body.
+        try:
+            verifier = (
+                self.signature
+                if package.get("sig_algo") == self.signature.name
+                else get_signature(package.get("sig_algo", ""), self.backend)
+            )
+        except Exception:
+            logger.warning("unknown sig algo in message from %s", peer_id[:8])
+            return
+        if not verifier.verify(
+            bytes.fromhex(package.get("sig_pk", "")),
+            _canonical(package["message"]),
+            bytes.fromhex(package.get("sig", "")),
+        ):
+            logger.warning("signature verification failed from %s", peer_id[:8])
+            return
+        # Associated-data cross-checks (reference: :1489-1503).
+        if (
+            ad_data.get("message_id") != message.message_id
+            or ad_data.get("sender") != message.sender_id
+            or message.sender_id != peer_id
+            or ad_data.get("recipient") != self.node_id
+        ):
+            logger.warning("associated-data mismatch from %s", peer_id[:8])
+            return
+        if self._dedup(message.message_id):
+            return
+        self._log(
+            "message_received", peer=peer_id, size=len(message.content),
+            algorithm=self.symmetric.name, is_file=message.is_file,
+        )
+        self._notify(peer_id, message)
+
+    # ------------------------------------------------------- settings gossip
+
+    def get_settings(self) -> dict:
+        return {
+            "kem": self.kem.name,
+            "aead": self.symmetric.name,
+            "signature": self.signature.name,
+        }
+
+    async def notify_peers_of_settings_change(self) -> None:
+        for peer_id in self.node.get_peers():
+            await self.node.send_message(
+                peer_id, "settings_update", settings=self.get_settings()
+            )
+
+    async def request_peer_settings(self, peer_id: str) -> None:
+        await self.node.send_message(peer_id, "settings_request")
+        await self.node.send_message(
+            peer_id, "settings_update", settings=self.get_settings()
+        )
+
+    async def _handle_settings_update(self, peer_id: str, msg: dict) -> None:
+        settings = msg.get("settings") or {}
+        self.peer_settings[peer_id] = settings
+
+    async def _handle_settings_request(self, peer_id: str, msg: dict) -> None:
+        await self.node.send_message(
+            peer_id, "settings_update", settings=self.get_settings()
+        )
+
+    def settings_match(self, peer_id: str) -> bool | None:
+        peer = self.peer_settings.get(peer_id)
+        if peer is None:
+            return None
+        mine = self.get_settings()
+        return all(peer.get(k) == v for k, v in mine.items())
+
+    # ------------------------------------------------------ algorithm hot-swap
+
+    async def set_key_exchange_algorithm(self, name: str) -> None:
+        """Drop all shared keys and re-handshake (reference: :1741-1781)."""
+        self.kem = get_kem(name, self.backend)
+        peers = list(self.shared_keys)
+        self.shared_keys.clear()
+        self.raw_secrets.clear()
+        for peer_id in peers:
+            self.ke_state[peer_id] = KeyExchangeState.NONE
+        self._log("crypto_settings_changed", component="kem", algorithm=name)
+        await self.notify_peers_of_settings_change()
+        for peer_id in peers:
+            if self.node.is_connected(peer_id):
+                asyncio.ensure_future(self.initiate_key_exchange(peer_id))
+
+    async def set_symmetric_algorithm(self, name: str) -> None:
+        """Re-derive per-peer keys from stored raw secrets (reference: :1783-1810)."""
+        self.symmetric = get_symmetric(name)
+        for peer_id, secret in self.raw_secrets.items():
+            self.shared_keys[peer_id] = derive_message_key(
+                secret, self.node_id, peer_id, name
+            )
+        self._log("crypto_settings_changed", component="aead", algorithm=name)
+        await self.notify_peers_of_settings_change()
+
+    async def set_signature_algorithm(self, name: str) -> None:
+        """Lazily load-or-generate the new keypair (reference: :1827-1851)."""
+        self.signature = get_signature(name, self.backend)
+        self._sig_keypair = self._load_or_generate_sig_keypair()
+        self._log("crypto_settings_changed", component="signature", algorithm=name)
+        await self.notify_peers_of_settings_change()
+
+    async def adopt_peer_settings(self, peer_id: str) -> bool:
+        """Switch local algorithms to the peer's gossiped set (ref: :1893-2011)."""
+        peer = self.peer_settings.get(peer_id)
+        if not peer:
+            return False
+        try:
+            if peer.get("aead") and peer["aead"] != self.symmetric.name:
+                await self.set_symmetric_algorithm(peer["aead"])
+            if peer.get("signature") and peer["signature"] != self.signature.name:
+                await self.set_signature_algorithm(peer["signature"])
+            if peer.get("kem") and peer["kem"] != self.kem.name:
+                await self.set_key_exchange_algorithm(peer["kem"])
+        except KeyError as e:
+            logger.warning("cannot adopt peer settings: %s", e)
+            return False
+        return True

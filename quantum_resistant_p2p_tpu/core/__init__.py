@@ -1,0 +1,1 @@
+"""Primitive TPU kernels: Keccak sponge, SHA-256, NTT, samplers, byte codecs."""

@@ -1,0 +1,1 @@
+"""Batched TPU KEM implementations: ML-KEM, FrodoKEM, HQC."""

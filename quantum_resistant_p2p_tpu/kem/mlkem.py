@@ -1,0 +1,295 @@
+"""Batched ML-KEM (FIPS 203) in JAX — the TPU crypto core's flagship KEM.
+
+TPU-native design
+-----------------
+Every function operates on arrays with an arbitrary leading batch shape and
+fixed trailing shapes, so a single jitted program amortises compilation over
+thousands of concurrent handshakes (the reference app performs one serial
+liboqs FFI call per handshake: crypto/key_exchange.py:125-186).
+
+* Polynomials are ``(..., 256)`` int32 kept reduced in [0, q); q = 3329, so all
+  intermediate products fit comfortably in int32 — no 64-bit emulation needed.
+* The NTT is the layered butterfly vectorised across all 128 butterflies of a
+  layer at once (7 static layers, no data-dependent control flow).
+* SampleNTT's rejection loop becomes a fixed-size squeeze (672 bytes -> 448
+  candidates, P[shortfall] < 1e-38) followed by a stable-sort compaction —
+  identical output to the spec's sequential scan whenever the spec would have
+  consumed <= 672 bytes.
+* All hashing (G/H/J/PRF/XOF) is the batched Keccak kernel from
+  ``core.keccak``; randomness (d, z, m) is an explicit input, giving the
+  deterministic seam FIPS 203 defines for KATs.
+
+Bit-exactness oracle: ``pyref.mlkem_ref`` (clean-room FIPS 203 over hashlib).
+Replaces (reference): MLKEMKeyExchange's per-call liboqs objects
+(crypto/key_exchange.py:57-186, vendor/oqs.py:310-390).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import keccak
+from ..pyref.mlkem_ref import (  # parameter sets + computed constant tables
+    GAMMAS,
+    MLKEM512,
+    MLKEM768,
+    MLKEM1024,
+    MLKEMParams,
+    PARAMS,
+    ZETAS,
+)
+
+Q = 3329
+N = 256
+_N_INV = 3303  # 128^-1 mod q
+
+_ZETAS = np.asarray(ZETAS, dtype=np.int32)
+_GAMMAS = np.asarray(GAMMAS, dtype=np.int32)
+
+# --------------------------------------------------------------------------
+# Byte codecs (FIPS 203 ByteEncode_d / ByteDecode_d), batched
+# --------------------------------------------------------------------------
+
+
+def byte_decode(b: jax.Array, d: int) -> jax.Array:
+    """(..., 32*d) uint8 -> (..., 256) int32 (mod q when d == 12)."""
+    bits = (b[..., :, None].astype(jnp.int32) >> jnp.arange(8)) & 1
+    bits = bits.reshape(b.shape[:-1] + (N, d))
+    vals = jnp.sum(bits << jnp.arange(d), axis=-1)
+    return vals % Q if d == 12 else vals
+
+
+def byte_encode(vals: jax.Array, d: int) -> jax.Array:
+    """(..., 256) int32 -> (..., 32*d) uint8."""
+    bits = (vals[..., :, None] >> jnp.arange(d)) & 1
+    bits = bits.reshape(vals.shape[:-1] + (32 * d, 8))
+    return jnp.sum(bits << jnp.arange(8), axis=-1).astype(jnp.uint8)
+
+
+def compress(x: jax.Array, d: int) -> jax.Array:
+    return ((x << (d + 1)) + Q) // (2 * Q) % (1 << d)
+
+
+def decompress(y: jax.Array, d: int) -> jax.Array:
+    return (y * Q + (1 << (d - 1))) >> d
+
+
+# --------------------------------------------------------------------------
+# NTT over Z_q[X]/(X^256+1), q = 3329 (FIPS 203 §4.3), batched & layer-vectorised
+# --------------------------------------------------------------------------
+
+
+def ntt(f: jax.Array) -> jax.Array:
+    """(..., 256) int32 in [0,q) -> NTT domain, same shape."""
+    zetas = jnp.asarray(_ZETAS)
+    k = 1
+    length = 128
+    while length >= 2:
+        groups = N // (2 * length)
+        z = zetas[k : k + groups]
+        fr = f.reshape(f.shape[:-1] + (groups, 2, length))
+        f0, f1 = fr[..., 0, :], fr[..., 1, :]
+        t = (z[:, None] * f1) % Q
+        f = jnp.stack([(f0 + t) % Q, (f0 - t) % Q], axis=-2).reshape(f.shape)
+        k += groups
+        length //= 2
+    return f
+
+
+def ntt_inv(f: jax.Array) -> jax.Array:
+    zetas = jnp.asarray(_ZETAS)
+    k = 127
+    length = 2
+    while length <= 128:
+        groups = N // (2 * length)
+        z = zetas[k - groups + 1 : k + 1][::-1]
+        fr = f.reshape(f.shape[:-1] + (groups, 2, length))
+        f0, f1 = fr[..., 0, :], fr[..., 1, :]
+        s = (f0 + f1) % Q
+        t = (z[:, None] * ((f1 - f0) % Q)) % Q
+        f = jnp.stack([s, t], axis=-2).reshape(f.shape)
+        k -= groups
+        length *= 2
+    return (f * _N_INV) % Q
+
+
+def multiply_ntts(f: jax.Array, g: jax.Array) -> jax.Array:
+    """Pairwise base-case products; broadcasts over leading dims."""
+    gam = jnp.asarray(_GAMMAS)
+    a0, a1 = f[..., 0::2], f[..., 1::2]
+    b0, b1 = g[..., 0::2], g[..., 1::2]
+    c0 = (a0 * b0 + (a1 * b1 % Q) * gam) % Q
+    c1 = (a0 * b1 + a1 * b0) % Q
+    return jnp.stack([c0, c1], axis=-1).reshape(jnp.broadcast_shapes(f.shape, g.shape))
+
+
+# --------------------------------------------------------------------------
+# Samplers (FIPS 203 §4.2.2), batched with fixed shapes
+# --------------------------------------------------------------------------
+
+_SAMPLE_NTT_BYTES = 672  # 4 SHAKE-128 rate blocks -> 448 candidates for 256 slots
+
+
+def sample_ntt(seeds: jax.Array) -> jax.Array:
+    """(..., 34) uint8 XOF seeds -> (..., 256) int32 NTT-domain polynomials.
+
+    Fixed-shape replacement for the spec's squeeze-until-256-accepted loop:
+    squeeze 672 bytes up front, mark candidates < q, and compact accepted
+    candidates to the front with a stable argsort on the reject mask (order
+    preserved == spec order).
+    """
+    buf = keccak.shake128(seeds, _SAMPLE_NTT_BYTES).astype(jnp.int32)
+    t = buf.reshape(buf.shape[:-1] + (-1, 3))
+    d1 = t[..., 0] + 256 * (t[..., 1] % 16)
+    d2 = (t[..., 1] // 16) + 16 * t[..., 2]
+    cand = jnp.stack([d1, d2], axis=-1).reshape(buf.shape[:-1] + (-1,))
+    reject = (cand >= Q).astype(jnp.int8)
+    order = jnp.argsort(reject, axis=-1, stable=True)
+    return jnp.take_along_axis(cand, order, axis=-1)[..., :N]
+
+
+def sample_poly_cbd(b: jax.Array, eta: int) -> jax.Array:
+    """(..., 64*eta) uint8 PRF output -> (..., 256) int32 CBD_eta polynomial."""
+    bits = (b[..., :, None].astype(jnp.int32) >> jnp.arange(8)) & 1
+    bits = bits.reshape(b.shape[:-1] + (N, 2, eta))
+    x = bits.sum(axis=-1)
+    return (x[..., 0] - x[..., 1]) % Q
+
+
+def _prf(s: jax.Array, n_consts: np.ndarray, eta: int) -> jax.Array:
+    """PRF_eta(s, n) for a vector of counter bytes.
+
+    s: (..., 32) -> (..., len(n_consts), 64*eta) via SHAKE-256(s || n).
+    """
+    reps = len(n_consts)
+    s_rep = jnp.broadcast_to(s[..., None, :], s.shape[:-1] + (reps, 32))
+    n_col = jnp.broadcast_to(
+        jnp.asarray(n_consts, dtype=jnp.uint8)[:, None], s.shape[:-1] + (reps, 1)
+    )
+    return keccak.shake256(jnp.concatenate([s_rep, n_col], axis=-1), 64 * eta)
+
+
+def _expand_matrix(rho: jax.Array, k: int) -> jax.Array:
+    """rho (..., 32) -> A_hat (..., k, k, 256) with A[i,j] = SampleNTT(rho||j||i)."""
+    ji = np.array([[j, i] for i in range(k) for j in range(k)], dtype=np.uint8)
+    rho_rep = jnp.broadcast_to(rho[..., None, :], rho.shape[:-1] + (k * k, 32))
+    ji_rep = jnp.broadcast_to(jnp.asarray(ji), rho.shape[:-1] + (k * k, 2))
+    seeds = jnp.concatenate([rho_rep, ji_rep], axis=-1)
+    a = sample_ntt(seeds)
+    return a.reshape(rho.shape[:-1] + (k, k, N))
+
+
+# --------------------------------------------------------------------------
+# K-PKE + ML-KEM (FIPS 203 §5-7), batched
+# --------------------------------------------------------------------------
+
+
+def _kpke_keygen(p: MLKEMParams, d: jax.Array):
+    k = p.k
+    kin = jnp.concatenate(
+        [d, jnp.broadcast_to(jnp.uint8(k), d.shape[:-1] + (1,))], axis=-1
+    )
+    g = keccak.sha3_512(kin)
+    rho, sigma = g[..., :32], g[..., 32:]
+    a_hat = _expand_matrix(rho, k)
+    noise = sample_poly_cbd(_prf(sigma, np.arange(2 * k), p.eta1), p.eta1)
+    s_hat = ntt(noise[..., :k, :])
+    e_hat = ntt(noise[..., k:, :])
+    t_hat = (
+        jnp.sum(multiply_ntts(a_hat, s_hat[..., None, :, :]), axis=-2) + e_hat
+    ) % Q
+    ek = jnp.concatenate(
+        [byte_encode(t_hat, 12).reshape(d.shape[:-1] + (384 * k,)), rho], axis=-1
+    )
+    dk_pke = byte_encode(s_hat, 12).reshape(d.shape[:-1] + (384 * k,))
+    return ek, dk_pke
+
+
+def _kpke_encrypt(p: MLKEMParams, ek: jax.Array, m: jax.Array, r: jax.Array):
+    k = p.k
+    t_hat = byte_decode(ek[..., : 384 * k].reshape(ek.shape[:-1] + (k, 384)), 12)
+    rho = ek[..., 384 * k :]
+    a_hat = _expand_matrix(rho, k)
+    y = sample_poly_cbd(_prf(r, np.arange(k), p.eta1), p.eta1)
+    e1 = sample_poly_cbd(_prf(r, np.arange(k, 2 * k), p.eta2), p.eta2)
+    e2 = sample_poly_cbd(_prf(r, np.array([2 * k]), p.eta2), p.eta2)[..., 0, :]
+    y_hat = ntt(y)
+    # u = invNTT(A^T ∘ y_hat) + e1 : contract over row index i of A[i,j]
+    u = (
+        ntt_inv(jnp.sum(multiply_ntts(a_hat, y_hat[..., :, None, :]), axis=-3) % Q)
+        + e1
+    ) % Q
+    mu = decompress(byte_decode(m, 1), 1)
+    v = (
+        ntt_inv(jnp.sum(multiply_ntts(t_hat, y_hat), axis=-2) % Q) + e2 + mu
+    ) % Q
+    c1 = byte_encode(compress(u, p.du), p.du).reshape(ek.shape[:-1] + (32 * p.du * k,))
+    c2 = byte_encode(compress(v, p.dv), p.dv)
+    return jnp.concatenate([c1, c2], axis=-1)
+
+
+def _kpke_decrypt(p: MLKEMParams, dk_pke: jax.Array, c: jax.Array):
+    k, du, dv = p.k, p.du, p.dv
+    c1 = c[..., : 32 * du * k].reshape(c.shape[:-1] + (k, 32 * du))
+    u = decompress(byte_decode(c1, du), du)
+    v = decompress(byte_decode(c[..., 32 * du * k :], dv), dv)
+    s_hat = byte_decode(dk_pke.reshape(dk_pke.shape[:-1] + (k, 384)), 12)
+    w = (v - ntt_inv(jnp.sum(multiply_ntts(s_hat, ntt(u)), axis=-2) % Q)) % Q
+    return byte_encode(compress(w, 1), 1)
+
+
+def keygen(p: MLKEMParams, d: jax.Array, z: jax.Array):
+    """ML-KEM.KeyGen_internal: seeds d, z (..., 32) -> ek (..., ek_len), dk (..., dk_len)."""
+    d = jnp.asarray(d, jnp.uint8)
+    z = jnp.asarray(z, jnp.uint8)
+    ek, dk_pke = _kpke_keygen(p, d)
+    dk = jnp.concatenate([dk_pke, ek, keccak.sha3_256(ek), z], axis=-1)
+    return ek, dk
+
+
+def encaps(p: MLKEMParams, ek: jax.Array, m: jax.Array):
+    """ML-KEM.Encaps_internal: ek, m (..., 32) -> K (..., 32), c (..., ct_len)."""
+    ek = jnp.asarray(ek, jnp.uint8)
+    m = jnp.asarray(m, jnp.uint8)
+    g = keccak.sha3_512(jnp.concatenate([m, keccak.sha3_256(ek)], axis=-1))
+    key, r = g[..., :32], g[..., 32:]
+    c = _kpke_encrypt(p, ek, m, r)
+    return key, c
+
+
+def decaps(p: MLKEMParams, dk: jax.Array, c: jax.Array):
+    """ML-KEM.Decaps_internal with implicit rejection (branch-free select)."""
+    dk = jnp.asarray(dk, jnp.uint8)
+    c = jnp.asarray(c, jnp.uint8)
+    k = p.k
+    dk_pke = dk[..., : 384 * k]
+    ek = dk[..., 384 * k : 768 * k + 32]
+    h = dk[..., 768 * k + 32 : 768 * k + 64]
+    z = dk[..., 768 * k + 64 :]
+    m2 = _kpke_decrypt(p, dk_pke, c)
+    g = keccak.sha3_512(jnp.concatenate([m2, h], axis=-1))
+    key2, r2 = g[..., :32], g[..., 32:]
+    key_bar = keccak.shake256(jnp.concatenate([z, c], axis=-1), 32)
+    c2 = _kpke_encrypt(p, ek, m2, r2)
+    ok = jnp.all(c == c2, axis=-1, keepdims=True)
+    return jnp.where(ok, key2, key_bar)
+
+
+# --------------------------------------------------------------------------
+# Jitted per-parameter-set entry points
+# --------------------------------------------------------------------------
+
+
+@functools.cache
+def get(name: str):
+    """Jitted (keygen, encaps, decaps) triple for a parameter-set name."""
+    p = PARAMS[name]
+    return (
+        jax.jit(functools.partial(keygen, p)),
+        jax.jit(functools.partial(encaps, p)),
+        jax.jit(functools.partial(decaps, p)),
+    )

@@ -1,0 +1,12 @@
+"""Host networking: asyncio TCP P2P transport, UDP discovery, node identity.
+
+Capability parity with the reference's networking/ package (SURVEY.md §2 rows
+9-11).  The TPU is never on this path — it acts as a crypto coprocessor behind
+the provider layer's batching queue; these modules move opaque bytes/JSON.
+"""
+
+from .identity import load_or_generate_node_id
+from .p2p_node import P2PNode
+from .discovery import NodeDiscovery
+
+__all__ = ["P2PNode", "NodeDiscovery", "load_or_generate_node_id"]

@@ -1,0 +1,307 @@
+"""Asyncio TCP P2P node with framed, chunked message transport.
+
+Capability parity with the reference's networking/p2p_node.py (552 LoC: TCP
+server/client, peer registry, hello handshake, chunked binary framing,
+per-type handler dispatch, disconnect fan-out) with a fresh wire design:
+
+Frame:   magic b"QP" | version u8 | flags u8 | length u32be | payload
+         flags bit0 = CHUNK (payload carries a chunk header)
+Chunk:   stream_id 16B | index u32be | count u32be | data
+Payload: UTF-8 JSON object with a mandatory "type" key.
+
+Messages above ``chunk_size`` (default 64 KiB) are split into chunk frames and
+reassembled on the far side; anything smaller travels in a single frame.
+The hello handshake exchanges node ids + listen ports with a timeout, after
+which the peer enters the registry and connection handlers fire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import struct
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = b"QP"
+_VERSION = 1
+_FLAG_CHUNK = 0x01
+_HEADER = struct.Struct(">2sBBI")
+_CHUNK_HEADER = struct.Struct(">16sII")
+
+MessageHandler = Callable[[str, dict], Awaitable[None]]
+ConnectionHandler = Callable[[str, str], None]  # (event, peer_id)
+
+MAX_FRAME = 16 * 1024 * 1024
+
+
+@dataclass
+class _Peer:
+    peer_id: str
+    reader: asyncio.StreamReader
+    writer: asyncio.StreamWriter
+    host: str
+    port: int  # the peer's listening port (from hello), not the socket port
+    write_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    reassembly: dict[bytes, dict] = field(default_factory=dict)
+
+
+class P2PNode:
+    """TCP transport node: opaque JSON messages between identified peers."""
+
+    def __init__(
+        self,
+        node_id: str | None = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        key_storage=None,
+        chunk_size: int = 64 * 1024,
+    ):
+        if node_id is None:
+            from .identity import load_or_generate_node_id
+
+            node_id = load_or_generate_node_id(key_storage)
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+        self.chunk_size = chunk_size
+        self._server: asyncio.Server | None = None
+        self._peers: dict[str, _Peer] = {}
+        self._read_tasks: dict[str, asyncio.Task] = {}
+        self._msg_handlers: dict[str, list[MessageHandler]] = {}
+        self._conn_handlers: list[ConnectionHandler] = []
+        self._running = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_inbound, self.host, self.port)
+        self._running = True
+        actual = self._server.sockets[0].getsockname()[1] if self._server.sockets else self.port
+        self.port = actual
+        logger.info("node %s listening on %s:%s", self.node_id[:8], self.host, self.port)
+
+    async def stop(self) -> None:
+        self._running = False
+        for peer_id in list(self._peers):
+            await self.disconnect_from_peer(peer_id)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- registry / handlers -------------------------------------------------
+
+    def get_peers(self) -> list[str]:
+        return list(self._peers)
+
+    def is_connected(self, peer_id: str) -> bool:
+        return peer_id in self._peers
+
+    def get_peer_address(self, peer_id: str) -> tuple[str, int] | None:
+        p = self._peers.get(peer_id)
+        return (p.host, p.port) if p else None
+
+    def register_message_handler(self, msg_type: str, handler: MessageHandler) -> None:
+        handlers = self._msg_handlers.setdefault(msg_type, [])
+        if handler not in handlers:
+            handlers.append(handler)
+
+    def unregister_message_handler(self, msg_type: str, handler: MessageHandler) -> None:
+        self._msg_handlers.get(msg_type, []).remove(handler)
+
+    def register_connection_handler(self, handler: ConnectionHandler) -> None:
+        if handler not in self._conn_handlers:
+            self._conn_handlers.append(handler)
+
+    def _fire_connection_event(self, event: str, peer_id: str) -> None:
+        for h in list(self._conn_handlers):
+            try:
+                h(event, peer_id)
+            except Exception:
+                logger.exception("connection handler failed")
+
+    # -- connecting ----------------------------------------------------------
+
+    async def connect_to_peer(self, host: str, port: int, timeout: float = 10.0) -> str | None:
+        """Dial a peer, run the hello handshake, return its node id."""
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            logger.warning("connect to %s:%s failed: %s", host, port, e)
+            return None
+        try:
+            await self._send_frame(
+                writer,
+                asyncio.Lock(),
+                {"type": "__hello__", "node_id": self.node_id, "listen_port": self.port},
+            )
+            hello = await asyncio.wait_for(self._read_plain_frame(reader), 5.0)
+            if hello.get("type") != "__hello__":
+                raise ValueError("bad hello")
+        except Exception as e:
+            logger.warning("hello with %s:%s failed: %s", host, port, e)
+            writer.close()
+            return None
+        peer_id = hello["node_id"]
+        self._register_peer(peer_id, reader, writer, host, int(hello.get("listen_port", port)))
+        return peer_id
+
+    async def _on_inbound(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        addr = writer.get_extra_info("peername") or ("?", 0)
+        try:
+            hello = await asyncio.wait_for(self._read_plain_frame(reader), 5.0)
+            if hello.get("type") != "__hello__":
+                raise ValueError("bad hello")
+            await self._send_frame(
+                writer,
+                asyncio.Lock(),
+                {"type": "__hello__", "node_id": self.node_id, "listen_port": self.port},
+            )
+        except Exception as e:
+            logger.warning("inbound hello from %s failed: %s", addr, e)
+            writer.close()
+            return
+        peer_id = hello["node_id"]
+        self._register_peer(
+            peer_id, reader, writer, addr[0], int(hello.get("listen_port", addr[1]))
+        )
+
+    def _register_peer(self, peer_id, reader, writer, host, port) -> None:
+        old = self._peers.pop(peer_id, None)
+        if old is not None:
+            old.writer.close()
+            task = self._read_tasks.pop(peer_id, None)
+            if task:
+                task.cancel()
+        peer = _Peer(peer_id, reader, writer, host, port)
+        self._peers[peer_id] = peer
+        self._read_tasks[peer_id] = asyncio.create_task(self._read_loop(peer))
+        logger.info("peer %s connected (%s:%s)", peer_id[:8], host, port)
+        self._fire_connection_event("connect", peer_id)
+
+    async def disconnect_from_peer(self, peer_id: str) -> None:
+        peer = self._peers.pop(peer_id, None)
+        task = self._read_tasks.pop(peer_id, None)
+        if task:
+            task.cancel()
+        if peer is not None:
+            peer.writer.close()
+            self._fire_connection_event("disconnect", peer_id)
+
+    # -- send ----------------------------------------------------------------
+
+    async def send_message(self, peer_id: str, msg_type: str, **payload: Any) -> bool:
+        """Send a JSON message; bytes values are transparently base64-tagged."""
+        peer = self._peers.get(peer_id)
+        if peer is None:
+            logger.warning("send to unknown peer %s", peer_id[:8])
+            return False
+        message = {"type": msg_type, **{k: _encode_value(v) for k, v in payload.items()}}
+        try:
+            await self._send_frame(peer.writer, peer.write_lock, message)
+            return True
+        except (ConnectionError, OSError) as e:
+            logger.warning("send to %s failed: %s; evicting", peer_id[:8], e)
+            await self.disconnect_from_peer(peer_id)
+            return False
+
+    async def _send_frame(self, writer, lock: asyncio.Lock, message: dict) -> None:
+        body = json.dumps(message, separators=(",", ":")).encode()
+        async with lock:
+            if len(body) <= self.chunk_size:
+                writer.write(_HEADER.pack(_MAGIC, _VERSION, 0, len(body)) + body)
+            else:
+                stream_id = uuid.uuid4().bytes
+                chunks = [
+                    body[i : i + self.chunk_size]
+                    for i in range(0, len(body), self.chunk_size)
+                ]
+                for idx, chunk in enumerate(chunks):
+                    payload = _CHUNK_HEADER.pack(stream_id, idx, len(chunks)) + chunk
+                    writer.write(
+                        _HEADER.pack(_MAGIC, _VERSION, _FLAG_CHUNK, len(payload)) + payload
+                    )
+            await writer.drain()
+
+    # -- receive -------------------------------------------------------------
+
+    async def _read_plain_frame(self, reader: asyncio.StreamReader) -> dict:
+        flags, payload = await self._read_raw(reader)
+        if flags & _FLAG_CHUNK:
+            raise ValueError("unexpected chunked hello")
+        return json.loads(payload)
+
+    @staticmethod
+    async def _read_raw(reader: asyncio.StreamReader) -> tuple[int, bytes]:
+        header = await reader.readexactly(_HEADER.size)
+        magic, version, flags, length = _HEADER.unpack(header)
+        if magic != _MAGIC or version != _VERSION:
+            raise ValueError(f"bad frame header {header!r}")
+        if length > MAX_FRAME:
+            raise ValueError(f"oversized frame ({length} bytes)")
+        return flags, await reader.readexactly(length)
+
+    async def _read_loop(self, peer: _Peer) -> None:
+        try:
+            while True:
+                flags, payload = await self._read_raw(peer.reader)
+                if flags & _FLAG_CHUNK:
+                    message = self._reassemble(peer, payload)
+                    if message is None:
+                        continue
+                else:
+                    message = json.loads(payload)
+                await self._dispatch(peer.peer_id, message)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception("read loop error for %s", peer.peer_id[:8])
+        finally:
+            if self._peers.get(peer.peer_id) is peer:
+                self._peers.pop(peer.peer_id, None)
+                self._read_tasks.pop(peer.peer_id, None)
+                peer.writer.close()
+                self._fire_connection_event("disconnect", peer.peer_id)
+
+    def _reassemble(self, peer: _Peer, payload: bytes) -> dict | None:
+        stream_id, index, count = _CHUNK_HEADER.unpack_from(payload)
+        data = payload[_CHUNK_HEADER.size :]
+        entry = peer.reassembly.setdefault(stream_id, {"count": count, "chunks": {}})
+        entry["chunks"][index] = data
+        if len(entry["chunks"]) < entry["count"]:
+            return None
+        del peer.reassembly[stream_id]
+        body = b"".join(entry["chunks"][i] for i in range(count))
+        return json.loads(body)
+
+    async def _dispatch(self, peer_id: str, message: dict) -> None:
+        msg_type = message.get("type", "")
+        decoded = {k: _decode_value(v) for k, v in message.items()}
+        handlers = self._msg_handlers.get(msg_type, [])
+        if not handlers:
+            logger.debug("no handler for message type %r", msg_type)
+        for h in list(handlers):
+            try:
+                await h(peer_id, decoded)
+            except Exception:
+                logger.exception("handler for %r failed", msg_type)
+
+
+def _encode_value(v: Any) -> Any:
+    if isinstance(v, (bytes, bytearray)):
+        return {"__b64__": base64.b64encode(bytes(v)).decode("ascii")}
+    return v
+
+
+def _decode_value(v: Any) -> Any:
+    if isinstance(v, dict) and set(v) == {"__b64__"}:
+        return base64.b64decode(v["__b64__"])
+    return v

@@ -1,0 +1,41 @@
+"""The algorithm-plugin boundary + batching queue.
+
+This package replicates the pluggable-algorithm API surface of the
+reference's crypto/ package (KeyExchangeAlgorithm crypto/key_exchange.py:19-54,
+SignatureAlgorithm crypto/signatures.py:18-55, SymmetricAlgorithm
+crypto/symmetric.py:19-63) and adds what the reference could not have:
+
+* a **backend** axis (``cpu`` pure-Python reference vs ``tpu`` batched JAX),
+* an explicit **algorithm registry** replacing the reference's string
+  matching (app/messaging.py:1893-2011),
+* an async **batching queue** (``BatchedProvider``) that coalesces many
+  concurrent handshake ops into single TPU dispatches.
+"""
+
+from .base import (
+    CryptoAlgorithm,
+    KeyExchangeAlgorithm,
+    SignatureAlgorithm,
+    SymmetricAlgorithm,
+)
+from .registry import (
+    get_kem,
+    get_signature,
+    get_symmetric,
+    list_kems,
+    list_signatures,
+    list_symmetrics,
+)
+
+__all__ = [
+    "CryptoAlgorithm",
+    "KeyExchangeAlgorithm",
+    "SignatureAlgorithm",
+    "SymmetricAlgorithm",
+    "get_kem",
+    "get_signature",
+    "get_symmetric",
+    "list_kems",
+    "list_signatures",
+    "list_symmetrics",
+]

@@ -1,0 +1,139 @@
+"""Abstract algorithm interfaces — the plugin boundary.
+
+Same surface the reference exposes so application code (and users migrating
+from it) find the familiar operations:
+  * KEM:       generate_keypair / encapsulate / decapsulate
+               (reference: crypto/key_exchange.py:19-54)
+  * Signature: sign / verify            (reference: crypto/signatures.py:18-55)
+  * AEAD:      encrypt / decrypt        (reference: crypto/symmetric.py:19-63)
+
+Additions over the reference: every algorithm reports its ``backend`` ("cpu"
+or "tpu") and offers ``*_batch`` operations with ``(batch, ...)`` numpy arrays
+— the TPU backends implement these natively and the scalar ops are the
+batch-of-1 special case, which is the inversion that makes 50k ops/s possible.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+import numpy as np
+
+
+class CryptoAlgorithm(abc.ABC):
+    """Common metadata for all algorithms (reference: crypto/algorithm_base.py)."""
+
+    #: canonical registry name, e.g. "ML-KEM-768"
+    name: str = ""
+    #: human-readable name for UIs / settings gossip
+    display_name: str = ""
+    description: str = ""
+    #: NIST security level (1/3/5)
+    security_level: int = 0
+    #: "cpu" (pure-Python reference) or "tpu" (batched JAX)
+    backend: str = "cpu"
+
+    @property
+    def is_using_mock(self) -> bool:
+        # Parity with crypto/algorithm_base.py:30-33 — mock crypto is never used.
+        return False
+
+    @property
+    def actual_variant(self) -> str:
+        return self.name
+
+    def get_security_info(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "display_name": self.display_name,
+            "description": self.description,
+            "security_level": self.security_level,
+            "backend": self.backend,
+            "mock": self.is_using_mock,
+        }
+
+
+class KeyExchangeAlgorithm(CryptoAlgorithm):
+    """KEM interface; byte-level scalar API + array-level batch API."""
+
+    public_key_len: int = 0
+    secret_key_len: int = 0
+    ciphertext_len: int = 0
+    shared_secret_len: int = 32
+
+    @abc.abstractmethod
+    def generate_keypair(self) -> tuple[bytes, bytes]:
+        """-> (public_key, secret_key)"""
+
+    @abc.abstractmethod
+    def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
+        """-> (ciphertext, shared_secret)"""
+
+    @abc.abstractmethod
+    def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
+        """-> shared_secret"""
+
+    # -- batch API (TPU-native path; default = loop over the scalar API) ----
+
+    def generate_keypair_batch(self, n: int) -> tuple[np.ndarray, np.ndarray]:
+        pks, sks = zip(*(self.generate_keypair() for _ in range(n)))
+        return _stack_bytes(pks), _stack_bytes(sks)
+
+    def encapsulate_batch(self, public_keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        cts, sss = zip(*(self.encapsulate(bytes(pk)) for pk in public_keys))
+        return _stack_bytes(cts), _stack_bytes(sss)
+
+    def decapsulate_batch(self, secret_keys: np.ndarray, ciphertexts: np.ndarray) -> np.ndarray:
+        return _stack_bytes(
+            [self.decapsulate(bytes(sk), bytes(ct)) for sk, ct in zip(secret_keys, ciphertexts)]
+        )
+
+
+class SignatureAlgorithm(CryptoAlgorithm):
+    """Signature interface; verify returns False on any failure, never raises."""
+
+    public_key_len: int = 0
+    secret_key_len: int = 0
+    signature_len: int = 0  # maximum length where variable
+
+    @abc.abstractmethod
+    def generate_keypair(self) -> tuple[bytes, bytes]:
+        """-> (public_key, secret_key)"""
+
+    @abc.abstractmethod
+    def sign(self, secret_key: bytes, message: bytes) -> bytes:
+        """-> signature"""
+
+    @abc.abstractmethod
+    def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        """-> True iff the signature is valid (exceptions map to False)"""
+
+    def sign_batch(self, secret_keys: np.ndarray, messages: list[bytes]) -> list[bytes]:
+        return [self.sign(bytes(sk), m) for sk, m in zip(secret_keys, messages)]
+
+    def verify_batch(
+        self, public_keys: np.ndarray, messages: list[bytes], signatures: list[bytes]
+    ) -> np.ndarray:
+        return np.array(
+            [self.verify(bytes(pk), m, s) for pk, m, s in zip(public_keys, messages, signatures)]
+        )
+
+
+class SymmetricAlgorithm(CryptoAlgorithm):
+    """AEAD interface (host-side; transport encryption stays on CPU)."""
+
+    key_size: int = 32
+    nonce_size: int = 12
+
+    @abc.abstractmethod
+    def encrypt(self, key: bytes, plaintext: bytes, associated_data: bytes | None = None) -> bytes:
+        """-> nonce || ciphertext || tag"""
+
+    @abc.abstractmethod
+    def decrypt(self, key: bytes, data: bytes, associated_data: bytes | None = None) -> bytes:
+        """-> plaintext; raises ValueError on authentication failure"""
+
+
+def _stack_bytes(items) -> np.ndarray:
+    return np.stack([np.frombuffer(b, dtype=np.uint8) for b in items])

@@ -1,0 +1,110 @@
+"""KEM algorithm providers over the cpu (pyref) and tpu (JAX) backends.
+
+Mirrors the role of the reference's MLKEMKeyExchange / HQCKeyExchange /
+FrodoKEMKeyExchange classes (crypto/key_exchange.py:57-449), each
+parameterized by NIST security level 1/3/5 — but instead of constructing a
+fresh liboqs FFI object per operation (crypto/key_exchange.py:155,178), ops
+dispatch either to the pure-Python FIPS 203 reference (cpu) or to jitted
+batched JAX programs (tpu).
+
+Randomness policy: seeds are always drawn host-side from ``os.urandom`` and
+fed to the deterministic keygen/encaps cores — the TPU never needs a CSPRNG,
+and KATs can inject seeds through the same seam.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..pyref import mlkem_ref
+from .base import KeyExchangeAlgorithm
+
+_LEVEL_TO_MLKEM = {1: mlkem_ref.MLKEM512, 3: mlkem_ref.MLKEM768, 5: mlkem_ref.MLKEM1024}
+
+
+class MLKEMKeyExchange(KeyExchangeAlgorithm):
+    """ML-KEM (FIPS 203) at NIST level 1, 3 or 5."""
+
+    def __init__(self, security_level: int = 3, backend: str = "cpu"):
+        if security_level not in _LEVEL_TO_MLKEM:
+            raise ValueError(f"ML-KEM level must be 1/3/5, got {security_level}")
+        self.params = _LEVEL_TO_MLKEM[security_level]
+        self.security_level = security_level
+        self.backend = backend
+        self.name = self.params.name
+        self.display_name = f"{self.params.name} ({backend})"
+        self.description = (
+            f"Module-Lattice KEM, FIPS 203, NIST level {security_level}, "
+            f"{'batched JAX/TPU' if backend == 'tpu' else 'pure-Python CPU'} backend"
+        )
+        self.public_key_len = self.params.ek_len
+        self.secret_key_len = self.params.dk_len
+        self.ciphertext_len = self.params.ct_len
+        if backend == "tpu":
+            from ..kem import mlkem as _jax_mlkem  # deferred: pulls in jax
+
+            self._kg, self._enc, self._dec = _jax_mlkem.get(self.params.name)
+
+    # -- scalar API (batch-of-1 on the tpu backend) -------------------------
+
+    def generate_keypair(self) -> tuple[bytes, bytes]:
+        pk, sk = self.generate_keypair_batch(1)
+        return bytes(pk[0]), bytes(sk[0])
+
+    def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
+        pk = np.frombuffer(public_key, dtype=np.uint8)[None]
+        ct, ss = self.encapsulate_batch(pk)
+        return bytes(ct[0]), bytes(ss[0])
+
+    def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
+        sk = np.frombuffer(secret_key, dtype=np.uint8)[None]
+        ct = np.frombuffer(ciphertext, dtype=np.uint8)[None]
+        return bytes(self.decapsulate_batch(sk, ct)[0])
+
+    # -- batch API ----------------------------------------------------------
+
+    def generate_keypair_batch(self, n: int) -> tuple[np.ndarray, np.ndarray]:
+        d = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
+        z = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
+        if self.backend == "tpu":
+            ek, dk = self._kg(d, z)
+            return np.asarray(ek), np.asarray(dk)
+        pairs = [
+            mlkem_ref.keygen(self.params, d[i].tobytes(), z[i].tobytes()) for i in range(n)
+        ]
+        return (
+            np.stack([np.frombuffer(ek, np.uint8) for ek, _ in pairs]),
+            np.stack([np.frombuffer(dk, np.uint8) for _, dk in pairs]),
+        )
+
+    def encapsulate_batch(self, public_keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        n = public_keys.shape[0]
+        m = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
+        if self.backend == "tpu":
+            key, ct = self._enc(public_keys, m)
+            return np.asarray(ct), np.asarray(key)
+        outs = [
+            mlkem_ref.encaps(self.params, public_keys[i].tobytes(), m[i].tobytes())
+            for i in range(n)
+        ]
+        return (
+            np.stack([np.frombuffer(c, np.uint8) for _, c in outs]),
+            np.stack([np.frombuffer(k, np.uint8) for k, _ in outs]),
+        )
+
+    def decapsulate_batch(self, secret_keys: np.ndarray, ciphertexts: np.ndarray) -> np.ndarray:
+        if self.backend == "tpu":
+            return np.asarray(self._dec(secret_keys, ciphertexts))
+        return np.stack(
+            [
+                np.frombuffer(
+                    mlkem_ref.decaps(
+                        self.params, secret_keys[i].tobytes(), ciphertexts[i].tobytes()
+                    ),
+                    np.uint8,
+                )
+                for i in range(secret_keys.shape[0])
+            ]
+        )

@@ -1,0 +1,103 @@
+"""Algorithm registry — the factory the reference lacked.
+
+The reference resolves peer algorithm names by string-matching display names
+back into classes (app/messaging.py:1893-2011).  Here every algorithm has a
+canonical name in an explicit registry; lookups accept canonical names and the
+backend is an orthogonal axis ("cpu" | "tpu" | "auto").
+
+Registered families (target: full parity with the reference's Crypto Settings
+matrix of 9 KEMs x 2 AEADs x 6 signatures, ui/settings_dialog.py:108-172):
+
+  KEM:  ML-KEM-512/768/1024        (cpu + tpu)
+        FrodoKEM-640/976/1344-AES  (cpu + tpu)        [pending]
+        HQC-128/192/256            (cpu + tpu)        [pending]
+  SIG:  ML-DSA-44/65/87            (cpu + tpu)        [pending]
+        SPHINCS+-SHA2-128f/192f/256f-simple           [pending]
+  AEAD: AES-256-GCM, ChaCha20-Poly1305 (host)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricAlgorithm
+from .symmetric import AES256GCM, ChaCha20Poly1305
+
+# name -> (factory(backend) -> algorithm, supported_backends)
+_KEMS: dict[str, tuple[Callable[[str], KeyExchangeAlgorithm], tuple[str, ...]]] = {}
+_SIGS: dict[str, tuple[Callable[[str], SignatureAlgorithm], tuple[str, ...]]] = {}
+_AEADS: dict[str, Callable[[], SymmetricAlgorithm]] = {
+    "AES-256-GCM": AES256GCM,
+    "ChaCha20-Poly1305": ChaCha20Poly1305,
+}
+
+
+def register_kem(name: str, factory, backends: tuple[str, ...]) -> None:
+    _KEMS[name] = (factory, backends)
+
+
+def register_signature(name: str, factory, backends: tuple[str, ...]) -> None:
+    _SIGS[name] = (factory, backends)
+
+
+def _resolve_backend(requested: str, supported: tuple[str, ...]) -> str:
+    if requested == "auto":
+        return "tpu" if "tpu" in supported else "cpu"
+    if requested not in supported:
+        raise ValueError(f"backend {requested!r} not supported (have {supported})")
+    return requested
+
+
+def get_kem(name: str, backend: str = "auto") -> KeyExchangeAlgorithm:
+    if name not in _KEMS:
+        raise KeyError(f"unknown KEM {name!r}; known: {sorted(_KEMS)}")
+    factory, backends = _KEMS[name]
+    return factory(_resolve_backend(backend, backends))
+
+
+def get_signature(name: str, backend: str = "auto") -> SignatureAlgorithm:
+    if name not in _SIGS:
+        raise KeyError(f"unknown signature {name!r}; known: {sorted(_SIGS)}")
+    factory, backends = _SIGS[name]
+    return factory(_resolve_backend(backend, backends))
+
+
+def get_symmetric(name: str) -> SymmetricAlgorithm:
+    if name not in _AEADS:
+        raise KeyError(f"unknown AEAD {name!r}; known: {sorted(_AEADS)}")
+    return _AEADS[name]()
+
+
+def list_kems() -> list[str]:
+    return sorted(_KEMS)
+
+
+def list_signatures() -> list[str]:
+    return sorted(_SIGS)
+
+
+def list_symmetrics() -> list[str]:
+    return sorted(_AEADS)
+
+
+# -- default registrations ---------------------------------------------------
+
+def _register_defaults() -> None:
+    from .kem_providers import MLKEMKeyExchange
+    from .sig_providers import MLDSASignature
+
+    for level, name in ((1, "ML-KEM-512"), (3, "ML-KEM-768"), (5, "ML-KEM-1024")):
+        register_kem(
+            name,
+            lambda backend, _level=level: MLKEMKeyExchange(_level, backend),
+            ("cpu", "tpu"),
+        )
+    for level, name in ((2, "ML-DSA-44"), (3, "ML-DSA-65"), (5, "ML-DSA-87")):
+        register_signature(
+            name,
+            lambda backend, _level=level: MLDSASignature(_level, backend),
+            ("cpu",),  # tpu backend lands with sig/mldsa.py
+        )
+
+
+_register_defaults()

@@ -1,0 +1,64 @@
+"""AEAD algorithms: AES-256-GCM and ChaCha20-Poly1305.
+
+Host-side (OpenSSL via the ``cryptography`` package), as in the reference
+(crypto/symmetric.py:66-258): transport encryption is latency-bound per
+message, so it stays on CPU; the TPU earns its keep on the batched PQC math.
+
+Wire format parity: 12-byte random nonce prepended to the ciphertext
+(crypto/symmetric.py:110-146); authentication failure raises ValueError
+(crypto/symmetric.py:159-161).
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers import aead as _aead
+
+from .base import SymmetricAlgorithm
+
+
+class _AEADBase(SymmetricAlgorithm):
+    _impl = None  # cryptography AEAD class
+
+    key_size = 32
+    nonce_size = 12
+
+    def generate_key(self) -> bytes:
+        return os.urandom(self.key_size)
+
+    def encrypt(self, key: bytes, plaintext: bytes, associated_data: bytes | None = None) -> bytes:
+        if len(key) != self.key_size:
+            raise ValueError(f"{self.name} requires a {self.key_size}-byte key")
+        nonce = os.urandom(self.nonce_size)
+        return nonce + self._impl(key).encrypt(nonce, plaintext, associated_data)
+
+    def decrypt(self, key: bytes, data: bytes, associated_data: bytes | None = None) -> bytes:
+        if len(key) != self.key_size:
+            raise ValueError(f"{self.name} requires a {self.key_size}-byte key")
+        if len(data) < self.nonce_size + 16:
+            raise ValueError("ciphertext too short")
+        nonce, ct = data[: self.nonce_size], data[self.nonce_size :]
+        try:
+            return self._impl(key).decrypt(nonce, ct, associated_data)
+        except InvalidTag as e:
+            raise ValueError("authentication failed") from e
+
+
+class AES256GCM(_AEADBase):
+    _impl = _aead.AESGCM
+    name = "AES-256-GCM"
+    display_name = "AES-256-GCM"
+    description = "AES in Galois/Counter Mode with 256-bit keys (NIST SP 800-38D)"
+    security_level = 5
+    backend = "cpu"
+
+
+class ChaCha20Poly1305(_AEADBase):
+    _impl = _aead.ChaCha20Poly1305
+    name = "ChaCha20-Poly1305"
+    display_name = "ChaCha20-Poly1305"
+    description = "RFC 8439 ChaCha20-Poly1305 AEAD"
+    security_level = 5
+    backend = "cpu"

@@ -1,0 +1,16 @@
+"""Pure-Python FIPS reference implementations.
+
+These serve two purposes:
+
+1. **Bit-exactness oracle** for the JAX/TPU implementations: the vendored
+   liboqs binary the reference app shipped (``vendor/lib/linux/liboqs.so``,
+   stripped from this checkout) is not available and this environment has no
+   network, so cross-validation is done against independent clean-room
+   implementations of FIPS 203 (ML-KEM) / FIPS 204 (ML-DSA) / FIPS 205
+   (SLH-DSA) written directly from the specifications, with ``hashlib``
+   (OpenSSL) as the Keccak/SHA-2 oracle.
+
+2. **CPU fallback backend** for the provider layer, filling the role liboqs
+   plays in the reference app (reference: crypto/key_exchange.py:125-186
+   constructs per-op liboqs objects via the ctypes wrapper vendor/oqs.py).
+"""

@@ -1,0 +1,18 @@
+"""Host-side persistence: atomic/locked files, encrypted key vault, audit log.
+
+Capability parity with the reference's utils/secure_file.py,
+crypto/key_storage.py and app/logging.py (SURVEY.md §2 rows 7, 13, 14).
+Everything here is host-only — no TPU involvement.
+"""
+
+from .secure_file import AtomicFile, FileLock
+from .key_storage import KeyStorage, KeyStorageError
+from .secure_logger import SecureLogger
+
+__all__ = [
+    "AtomicFile",
+    "FileLock",
+    "KeyStorage",
+    "KeyStorageError",
+    "SecureLogger",
+]

@@ -1,0 +1,55 @@
+"""Keccak sponge kernel vs the independent hashlib oracle."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.core import keccak
+
+LENGTHS = [0, 1, 3, 8, 71, 72, 73, 135, 136, 137, 167, 168, 169, 200, 500]
+
+
+@pytest.mark.parametrize("n", LENGTHS)
+def test_sha3_256(n):
+    rng = np.random.default_rng(n)
+    msg = rng.integers(0, 256, size=n, dtype=np.uint8)
+    got = bytes(np.asarray(keccak.sha3_256(msg)))
+    assert got == hashlib.sha3_256(msg.tobytes()).digest()
+
+
+@pytest.mark.parametrize("n", LENGTHS)
+def test_sha3_512(n):
+    rng = np.random.default_rng(100 + n)
+    msg = rng.integers(0, 256, size=n, dtype=np.uint8)
+    got = bytes(np.asarray(keccak.sha3_512(msg)))
+    assert got == hashlib.sha3_512(msg.tobytes()).digest()
+
+
+@pytest.mark.parametrize("n", LENGTHS)
+@pytest.mark.parametrize("out_len", [16, 32, 136, 168, 400])
+def test_shake(n, out_len):
+    rng = np.random.default_rng(1000 + n + out_len)
+    msg = rng.integers(0, 256, size=n, dtype=np.uint8)
+    got128 = bytes(np.asarray(keccak.shake128(msg, out_len)))
+    assert got128 == hashlib.shake_128(msg.tobytes()).digest(out_len)
+    got256 = bytes(np.asarray(keccak.shake256(msg, out_len)))
+    assert got256 == hashlib.shake_256(msg.tobytes()).digest(out_len)
+
+
+def test_batched_matches_serial():
+    rng = np.random.default_rng(7)
+    msgs = rng.integers(0, 256, size=(16, 64), dtype=np.uint8)
+    got = np.asarray(keccak.sha3_256(msgs))
+    for i in range(16):
+        assert bytes(got[i]) == hashlib.sha3_256(msgs[i].tobytes()).digest()
+
+
+def test_nested_batch_shape():
+    rng = np.random.default_rng(8)
+    msgs = rng.integers(0, 256, size=(2, 3, 33), dtype=np.uint8)
+    got = np.asarray(keccak.shake256(msgs, 64))
+    assert got.shape == (2, 3, 64)
+    for i in range(2):
+        for j in range(3):
+            assert bytes(got[i, j]) == hashlib.shake_256(msgs[i, j].tobytes()).digest(64)

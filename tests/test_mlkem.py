@@ -1,0 +1,70 @@
+"""ML-KEM: JAX batch implementation vs the pure-Python FIPS 203 oracle."""
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.kem import mlkem
+from quantum_resistant_p2p_tpu.pyref import mlkem_ref as ref
+
+PARAM_NAMES = ["ML-KEM-512", "ML-KEM-768", "ML-KEM-1024"]
+
+
+def _rand(rng, *shape):
+    return rng.integers(0, 256, size=shape, dtype=np.uint8)
+
+
+@pytest.mark.parametrize("name", PARAM_NAMES)
+def test_cross_implementation_bit_exact(name):
+    """keygen/encaps/decaps bit-exact vs the oracle for a batch of seeds."""
+    p = ref.PARAMS[name]
+    kg, enc, dec = mlkem.get(name)
+    rng = np.random.default_rng(hash(name) % 2**32)
+    B = 4
+    d, z, m = _rand(rng, B, 32), _rand(rng, B, 32), _rand(rng, B, 32)
+
+    ek_j, dk_j = map(np.asarray, kg(d, z))
+    key_j, ct_j = map(np.asarray, enc(ek_j, m))
+    key2_j = np.asarray(dec(dk_j, ct_j))
+
+    for i in range(B):
+        ek_r, dk_r = ref.keygen(p, d[i].tobytes(), z[i].tobytes())
+        assert bytes(ek_j[i]) == ek_r, f"ek mismatch lane {i}"
+        assert bytes(dk_j[i]) == dk_r, f"dk mismatch lane {i}"
+        key_r, ct_r = ref.encaps(p, ek_r, m[i].tobytes())
+        assert bytes(ct_j[i]) == ct_r, f"ct mismatch lane {i}"
+        assert bytes(key_j[i]) == key_r, f"K mismatch lane {i}"
+        assert bytes(key2_j[i]) == key_r, f"decaps K mismatch lane {i}"
+
+
+@pytest.mark.parametrize("name", PARAM_NAMES)
+def test_implicit_rejection(name):
+    """Tampered ciphertext must yield J(z||c), matching the oracle."""
+    p = ref.PARAMS[name]
+    kg, enc, dec = mlkem.get(name)
+    rng = np.random.default_rng(99)
+    B = 4  # same batch shape as the cross-impl test -> shared jit cache
+    d, z, m = _rand(rng, B, 32), _rand(rng, B, 32), _rand(rng, B, 32)
+    ek, dk = map(np.asarray, kg(d, z))
+    _, ct = map(np.asarray, enc(ek, m))
+    bad = ct.copy()
+    bad[:, 0] ^= 1
+    key_bad = np.asarray(dec(dk, bad))
+    for i in range(2):
+        _, dk_r = ref.keygen(p, d[i].tobytes(), z[i].tobytes())
+        want = ref.decaps(p, dk_r, bad[i].tobytes())
+        assert bytes(key_bad[i]) == want
+
+
+def test_sizes():
+    for name in PARAM_NAMES:
+        p = ref.PARAMS[name]
+        kg, enc, dec = mlkem.get(name)
+        rng = np.random.default_rng(1)
+        B = 4
+        d, z, m = _rand(rng, B, 32), _rand(rng, B, 32), _rand(rng, B, 32)
+        ek, dk = kg(d, z)
+        key, ct = enc(np.asarray(ek), m)
+        assert ek.shape == (B, p.ek_len)
+        assert dk.shape == (B, p.dk_len)
+        assert ct.shape == (B, p.ct_len)
+        assert key.shape == (B, 32)

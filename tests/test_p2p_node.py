@@ -1,0 +1,105 @@
+"""Two-node P2P transport tests over localhost TCP (host-only, no JAX).
+
+Models the reference's in-process two-node approach
+(tests/crypto_algorithms_tester.py:455-464) at the transport layer.
+"""
+
+import asyncio
+
+import pytest
+
+from quantum_resistant_p2p_tpu.net import P2PNode
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+async def _pair():
+    a = P2PNode(node_id="node-a", host="127.0.0.1", port=0)
+    b = P2PNode(node_id="node-b", host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    peer = await a.connect_to_peer("127.0.0.1", b.port)
+    assert peer == "node-b"
+    for _ in range(100):
+        if b.is_connected("node-a"):
+            break
+        await asyncio.sleep(0.01)
+    assert b.is_connected("node-a")
+    return a, b
+
+
+def test_hello_and_roundtrip_message(run):
+    async def main():
+        a, b = await _pair()
+        got = asyncio.Event()
+        received = {}
+
+        async def on_ping(peer_id, msg):
+            received.update(msg, peer=peer_id)
+            got.set()
+
+        b.register_message_handler("ping", on_ping)
+        assert await a.send_message("node-b", "ping", n=42, blob=b"\x00\xff")
+        await asyncio.wait_for(got.wait(), 5)
+        assert received["n"] == 42
+        assert received["blob"] == b"\x00\xff"
+        assert received["peer"] == "node-a"
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_large_message_chunked(run):
+    async def main():
+        a, b = await _pair()
+        a.chunk_size = 4096  # force chunking
+        got = asyncio.Event()
+        received = {}
+
+        async def on_big(peer_id, msg):
+            received.update(msg)
+            got.set()
+
+        b.register_message_handler("big", on_big)
+        payload = bytes(range(256)) * 1024  # 256 KiB
+        assert await a.send_message("node-b", "big", data=payload)
+        await asyncio.wait_for(got.wait(), 10)
+        assert received["data"] == payload
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_disconnect_event(run):
+    async def main():
+        a, b = await _pair()
+        events = []
+        b.register_connection_handler(lambda ev, pid: events.append((ev, pid)))
+        await a.stop()
+        for _ in range(100):
+            if ("disconnect", "node-a") in events:
+                break
+            await asyncio.sleep(0.01)
+        assert ("disconnect", "node-a") in events
+        assert not b.is_connected("node-a")
+        await b.stop()
+
+    run(main())
+
+
+def test_send_to_unknown_peer(run):
+    async def main():
+        a = P2PNode(node_id="solo", host="127.0.0.1", port=0)
+        await a.start()
+        assert not await a.send_message("ghost", "ping")
+        await a.stop()
+
+    run(main())
