@@ -1,0 +1,59 @@
+"""Headline benchmark: batched ML-KEM-768 encapsulation throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: BASELINE.md / BASELINE.json north star — >= 50,000 ML-KEM-768
+encaps/sec on one v5e chip (the reference's serial liboqs path measures
+~4 full handshakes/sec end-to-end; 50k/s is the agreed chip-level target, so
+vs_baseline is value / 50_000).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 4096
+BASELINE_OPS_PER_S = 50_000.0
+
+
+def main() -> None:
+    import jax
+
+    from quantum_resistant_p2p_tpu.kem import mlkem
+    from quantum_resistant_p2p_tpu.pyref.mlkem_ref import MLKEM768
+
+    rng = np.random.default_rng(0)
+    d = rng.integers(0, 256, size=(BATCH, 32), dtype=np.uint8)
+    z = rng.integers(0, 256, size=(BATCH, 32), dtype=np.uint8)
+    m = rng.integers(0, 256, size=(BATCH, 32), dtype=np.uint8)
+
+    kg, enc, _ = mlkem.get("ML-KEM-768")
+    ek, _ = jax.block_until_ready(kg(d, z))
+
+    # Warm-up compiles + populates caches.
+    jax.block_until_ready(enc(ek, m))
+
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(enc(ek, m))
+        best = min(best, time.perf_counter() - t0)
+
+    ops_per_s = BATCH / best
+    print(
+        json.dumps(
+            {
+                "metric": "mlkem768_encaps_batch4096",
+                "value": round(ops_per_s, 1),
+                "unit": "encaps/s",
+                "vs_baseline": round(ops_per_s / BASELINE_OPS_PER_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

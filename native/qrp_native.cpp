@@ -1,0 +1,457 @@
+// qrp_native — C++ host crypto core for the CPU backend fast path.
+//
+// The reference app's CPU crypto is native C (vendored liboqs, loaded via
+// ctypes: reference vendor/oqs.py:122-183).  This library fills the same role
+// for this framework: Keccak (SHAKE-128/256, SHA3-256/512) and a complete
+// ML-KEM-512/768/1024 (FIPS 203) with deterministic seams, exposed as a thin
+// extern "C" surface loaded via ctypes (no pybind11 in this environment).
+// The pure-Python pyref stays as the bit-exactness oracle; this is the
+// production CPU path.
+//
+// Build: g++ -O3 -shared -fPIC -o libqrp_native.so qrp_native.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+// ---------------------------------------------------------------- Keccak
+
+const uint64_t RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+inline uint64_t rotl(uint64_t x, int n) { return (x << n) | (x >> (64 - n)); }
+
+void keccak_f1600(uint64_t s[25]) {
+  for (int round = 0; round < 24; ++round) {
+    uint64_t c[5], d[5];
+    for (int x = 0; x < 5; ++x)
+      c[x] = s[x] ^ s[x + 5] ^ s[x + 10] ^ s[x + 15] ^ s[x + 20];
+    for (int x = 0; x < 5; ++x) {
+      d[x] = c[(x + 4) % 5] ^ rotl(c[(x + 1) % 5], 1);
+      for (int y = 0; y < 5; ++y) s[x + 5 * y] ^= d[x];
+    }
+    // rho + pi
+    uint64_t b[25];
+    static const int RHO[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55, 20, 3,  10, 43,
+                                25, 39, 41, 45, 15, 21, 8,  18, 2,  61, 56, 14};
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y) {
+        int src = x + 5 * y;
+        int dst = y + 5 * ((2 * x + 3 * y) % 5);
+        b[dst] = rotl(s[src], RHO[src]);
+      }
+    // chi
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        s[x + 5 * y] = b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y]);
+    s[0] ^= RC[round];
+  }
+}
+
+struct Sponge {
+  uint64_t s[25];
+  unsigned rate;  // bytes
+  unsigned pos;
+  explicit Sponge(unsigned rate_bytes) : rate(rate_bytes), pos(0) {
+    std::memset(s, 0, sizeof(s));
+  }
+  void absorb(const uint8_t* data, size_t len) {
+    while (len) {
+      size_t take = rate - pos;
+      if (take > len) take = len;
+      for (size_t i = 0; i < take; ++i)
+        reinterpret_cast<uint8_t*>(s)[pos + i] ^= data[i];
+      data += take;
+      len -= take;
+      pos += take;
+      if (pos == rate) {
+        keccak_f1600(s);
+        pos = 0;
+      }
+    }
+  }
+  void finish(uint8_t ds) {
+    reinterpret_cast<uint8_t*>(s)[pos] ^= ds;
+    reinterpret_cast<uint8_t*>(s)[rate - 1] ^= 0x80;
+    keccak_f1600(s);
+    pos = 0;
+  }
+  void squeeze(uint8_t* out, size_t len) {
+    while (len) {
+      if (pos == rate) {
+        keccak_f1600(s);
+        pos = 0;
+      }
+      size_t take = rate - pos;
+      if (take > len) take = len;
+      std::memcpy(out, reinterpret_cast<uint8_t*>(s) + pos, take);
+      out += take;
+      len -= take;
+      pos += take;
+    }
+  }
+};
+
+void shake(unsigned rate, const uint8_t* in, size_t inlen, uint8_t* out, size_t outlen) {
+  Sponge sp(rate);
+  sp.absorb(in, inlen);
+  sp.finish(0x1f);
+  sp.squeeze(out, outlen);
+}
+
+void sha3(unsigned rate, const uint8_t* in, size_t inlen, uint8_t* out, size_t outlen) {
+  Sponge sp(rate);
+  sp.absorb(in, inlen);
+  sp.finish(0x06);
+  sp.squeeze(out, outlen);
+}
+
+// ---------------------------------------------------------------- ML-KEM
+
+constexpr int N = 256;
+constexpr int Q = 3329;
+
+struct MLKEMParams {
+  int k, eta1, eta2, du, dv;
+};
+
+MLKEMParams params_for(int k) {
+  if (k == 2) return {2, 3, 2, 10, 4};
+  if (k == 3) return {3, 2, 2, 10, 4};
+  return {4, 2, 2, 11, 5};
+}
+
+int16_t ZETAS[128];
+int16_t GAMMAS[128];
+
+struct ZetaInit {
+  ZetaInit() {
+    auto pw = [](int b, int e) {
+      long r = 1, base = b;
+      while (e) {
+        if (e & 1) r = r * base % Q;
+        base = base * base % Q;
+        e >>= 1;
+      }
+      return (int)r;
+    };
+    auto bitrev7 = [](int i) {
+      int r = 0;
+      for (int b = 0; b < 7; ++b)
+        if (i & (1 << b)) r |= 1 << (6 - b);
+      return r;
+    };
+    for (int i = 0; i < 128; ++i) ZETAS[i] = (int16_t)pw(17, bitrev7(i));
+    for (int i = 0; i < 128; ++i) GAMMAS[i] = (int16_t)pw(17, 2 * bitrev7(i) + 1);
+  }
+} zeta_init;
+
+void ntt(int16_t f[N]) {
+  int kidx = 1;
+  for (int len = 128; len >= 2; len >>= 1)
+    for (int start = 0; start < N; start += 2 * len) {
+      int z = ZETAS[kidx++];
+      for (int j = start; j < start + len; ++j) {
+        int t = (int)z * f[j + len] % Q;
+        f[j + len] = (int16_t)((f[j] - t + Q) % Q);
+        f[j] = (int16_t)((f[j] + t) % Q);
+      }
+    }
+}
+
+void ntt_inv(int16_t f[N]) {
+  int kidx = 127;
+  for (int len = 2; len <= 128; len <<= 1)
+    for (int start = 0; start < N; start += 2 * len) {
+      int z = ZETAS[kidx--];
+      for (int j = start; j < start + len; ++j) {
+        int t = f[j];
+        f[j] = (int16_t)((t + f[j + len]) % Q);
+        f[j + len] = (int16_t)((long)z * ((f[j + len] - t + Q) % Q) % Q);
+      }
+    }
+  for (int j = 0; j < N; ++j) f[j] = (int16_t)((long)f[j] * 3303 % Q);
+}
+
+void basemul(const int16_t a[N], const int16_t b[N], int16_t out[N]) {
+  for (int i = 0; i < 128; ++i) {
+    int a0 = a[2 * i], a1 = a[2 * i + 1], b0 = b[2 * i], b1 = b[2 * i + 1];
+    out[2 * i] = (int16_t)(((long)a0 * b0 + (long)a1 * b1 % Q * GAMMAS[i]) % Q);
+    out[2 * i + 1] = (int16_t)(((long)a0 * b1 + (long)a1 * b0) % Q);
+  }
+}
+
+void sample_ntt(const uint8_t seed[34], int16_t out[N]) {
+  Sponge sp(168);
+  sp.absorb(seed, 34);
+  sp.finish(0x1f);
+  int count = 0;
+  uint8_t buf[168];
+  while (count < N) {
+    sp.squeeze(buf, 168);
+    for (int i = 0; i + 3 <= 168 && count < N; i += 3) {
+      int d1 = buf[i] | ((buf[i + 1] & 0x0f) << 8);
+      int d2 = (buf[i + 1] >> 4) | (buf[i + 2] << 4);
+      if (d1 < Q) out[count++] = (int16_t)d1;
+      if (d2 < Q && count < N) out[count++] = (int16_t)d2;
+    }
+  }
+}
+
+void cbd(const uint8_t* buf, int eta, int16_t out[N]) {
+  for (int i = 0; i < N; ++i) {
+    int a = 0, b = 0;
+    for (int j = 0; j < eta; ++j) {
+      int bit = 2 * i * eta + j;
+      a += (buf[bit >> 3] >> (bit & 7)) & 1;
+      bit = (2 * i + 1) * eta + j;
+      b += (buf[bit >> 3] >> (bit & 7)) & 1;
+    }
+    out[i] = (int16_t)((a - b + Q) % Q);
+  }
+}
+
+void prf(const uint8_t seed[32], uint8_t n, int eta, uint8_t* out) {
+  uint8_t in[33];
+  std::memcpy(in, seed, 32);
+  in[32] = n;
+  shake(136, in, 33, out, 64 * eta);
+}
+
+void byte_encode(const int16_t* vals, int d, uint8_t* out) {
+  std::memset(out, 0, 32 * d);
+  int pos = 0;
+  for (int i = 0; i < N; ++i)
+    for (int j = 0; j < d; ++j, ++pos)
+      out[pos >> 3] |= ((vals[i] >> j) & 1) << (pos & 7);
+}
+
+void byte_decode(const uint8_t* in, int d, int16_t* out) {
+  int pos = 0;
+  for (int i = 0; i < N; ++i) {
+    int v = 0;
+    for (int j = 0; j < d; ++j, ++pos) v |= ((in[pos >> 3] >> (pos & 7)) & 1) << j;
+    out[i] = (int16_t)(d == 12 ? v % Q : v);
+  }
+}
+
+int compress(int x, int d) { return (int)((((long)x << (d + 1)) + Q) / (2 * Q)) % (1 << d); }
+int decompress(int y, int d) { return ((y * Q) + (1 << (d - 1))) >> d; }
+
+struct KpkeKey {
+  int16_t t_hat[4][N];
+  int16_t s_hat[4][N];
+  uint8_t rho[32];
+};
+
+void expand_a(const uint8_t rho[32], int k, int16_t a[4][4][N], bool transposed) {
+  uint8_t seed[34];
+  std::memcpy(seed, rho, 32);
+  for (int i = 0; i < k; ++i)
+    for (int j = 0; j < k; ++j) {
+      seed[32] = (uint8_t)(transposed ? i : j);
+      seed[33] = (uint8_t)(transposed ? j : i);
+      sample_ntt(seed, a[i][j]);
+    }
+}
+
+void kpke_keygen(const MLKEMParams& p, const uint8_t d[32], uint8_t* ek, uint8_t* dk) {
+  uint8_t g_in[33], g_out[64];
+  std::memcpy(g_in, d, 32);
+  g_in[32] = (uint8_t)p.k;
+  sha3(72, g_in, 33, g_out, 64);
+  const uint8_t* rho = g_out;
+  const uint8_t* sigma = g_out + 32;
+  int16_t a[4][4][N];
+  expand_a(rho, p.k, a, false);
+  int16_t s[4][N], e[4][N];
+  uint8_t buf[64 * 3];
+  for (int i = 0; i < p.k; ++i) {
+    prf(sigma, (uint8_t)i, p.eta1, buf);
+    cbd(buf, p.eta1, s[i]);
+    ntt(s[i]);
+  }
+  for (int i = 0; i < p.k; ++i) {
+    prf(sigma, (uint8_t)(p.k + i), p.eta1, buf);
+    cbd(buf, p.eta1, e[i]);
+    ntt(e[i]);
+  }
+  for (int i = 0; i < p.k; ++i) {
+    int16_t acc[N] = {0}, tmp[N];
+    for (int j = 0; j < p.k; ++j) {
+      basemul(a[i][j], s[j], tmp);
+      for (int n = 0; n < N; ++n) acc[n] = (int16_t)((acc[n] + tmp[n]) % Q);
+    }
+    for (int n = 0; n < N; ++n) acc[n] = (int16_t)((acc[n] + e[i][n]) % Q);
+    byte_encode(acc, 12, ek + 384 * i);
+    byte_encode(s[i], 12, dk + 384 * i);
+  }
+  std::memcpy(ek + 384 * p.k, rho, 32);
+}
+
+void kpke_encrypt(const MLKEMParams& p, const uint8_t* ek, const uint8_t m[32],
+                  const uint8_t r[32], uint8_t* ct) {
+  int16_t t_hat[4][N];
+  for (int i = 0; i < p.k; ++i) byte_decode(ek + 384 * i, 12, t_hat[i]);
+  const uint8_t* rho = ek + 384 * p.k;
+  int16_t at[4][4][N];
+  expand_a(rho, p.k, at, true);
+  int16_t y[4][N], e1[4][N], e2[N];
+  uint8_t buf[64 * 3];
+  for (int i = 0; i < p.k; ++i) {
+    prf(r, (uint8_t)i, p.eta1, buf);
+    cbd(buf, p.eta1, y[i]);
+    ntt(y[i]);
+  }
+  for (int i = 0; i < p.k; ++i) {
+    prf(r, (uint8_t)(p.k + i), p.eta2, buf);
+    cbd(buf, p.eta2, e1[i]);
+  }
+  prf(r, (uint8_t)(2 * p.k), p.eta2, buf);
+  cbd(buf, p.eta2, e2);
+  // u = invNTT(A^T y) + e1
+  for (int i = 0; i < p.k; ++i) {
+    int16_t acc[N] = {0}, tmp[N];
+    for (int j = 0; j < p.k; ++j) {
+      basemul(at[i][j], y[j], tmp);
+      for (int n = 0; n < N; ++n) acc[n] = (int16_t)((acc[n] + tmp[n]) % Q);
+    }
+    ntt_inv(acc);
+    for (int n = 0; n < N; ++n) acc[n] = (int16_t)((acc[n] + e1[i][n]) % Q);
+    int16_t cmp[N];
+    for (int n = 0; n < N; ++n) cmp[n] = (int16_t)compress(acc[n], p.du);
+    byte_encode(cmp, p.du, ct + 32 * p.du * i);
+  }
+  // v = invNTT(t^T y) + e2 + Decompress(mu)
+  int16_t acc[N] = {0}, tmp[N];
+  for (int j = 0; j < p.k; ++j) {
+    basemul(t_hat[j], y[j], tmp);
+    for (int n = 0; n < N; ++n) acc[n] = (int16_t)((acc[n] + tmp[n]) % Q);
+  }
+  ntt_inv(acc);
+  int16_t mu[N];
+  byte_decode(m, 1, mu);
+  for (int n = 0; n < N; ++n)
+    acc[n] = (int16_t)((acc[n] + e2[n] + decompress(mu[n], 1)) % Q);
+  int16_t cmp[N];
+  for (int n = 0; n < N; ++n) cmp[n] = (int16_t)compress(acc[n], p.dv);
+  byte_encode(cmp, p.dv, ct + 32 * p.du * p.k);
+}
+
+void kpke_decrypt(const MLKEMParams& p, const uint8_t* dk, const uint8_t* ct,
+                  uint8_t m[32]) {
+  int16_t u[4][N], v[N];
+  for (int i = 0; i < p.k; ++i) {
+    int16_t cmp[N];
+    byte_decode(ct + 32 * p.du * i, p.du, cmp);
+    for (int n = 0; n < N; ++n) u[i][n] = (int16_t)decompress(cmp[n], p.du);
+    ntt(u[i]);
+  }
+  int16_t cmpv[N];
+  byte_decode(ct + 32 * p.du * p.k, p.dv, cmpv);
+  for (int n = 0; n < N; ++n) v[n] = (int16_t)decompress(cmpv[n], p.dv);
+  int16_t acc[N] = {0}, tmp[N], s_hat[N];
+  for (int i = 0; i < p.k; ++i) {
+    byte_decode(dk + 384 * i, 12, s_hat);
+    basemul(s_hat, u[i], tmp);
+    for (int n = 0; n < N; ++n) acc[n] = (int16_t)((acc[n] + tmp[n]) % Q);
+  }
+  ntt_inv(acc);
+  int16_t w[N];
+  for (int n = 0; n < N; ++n) w[n] = (int16_t)((v[n] - acc[n] + Q) % Q);
+  int16_t bits[N];
+  for (int n = 0; n < N; ++n) bits[n] = (int16_t)compress(w[n], 1);
+  byte_encode(bits, 1, m);
+}
+
+}  // namespace
+
+extern "C" {
+
+// -------- hashes ------------------------------------------------------------
+
+void qrp_shake128(const uint8_t* in, size_t inlen, uint8_t* out, size_t outlen) {
+  shake(168, in, inlen, out, outlen);
+}
+void qrp_shake256(const uint8_t* in, size_t inlen, uint8_t* out, size_t outlen) {
+  shake(136, in, inlen, out, outlen);
+}
+void qrp_sha3_256(const uint8_t* in, size_t inlen, uint8_t* out) {
+  sha3(136, in, inlen, out, 32);
+}
+void qrp_sha3_512(const uint8_t* in, size_t inlen, uint8_t* out) {
+  sha3(72, in, inlen, out, 64);
+}
+
+// -------- utilities ---------------------------------------------------------
+
+void qrp_zeroize(uint8_t* buf, size_t len) {
+  volatile uint8_t* p = buf;
+  while (len--) *p++ = 0;
+}
+
+// -------- ML-KEM (FIPS 203 internal forms; k = 2/3/4) -----------------------
+
+void qrp_mlkem_keygen(int k, const uint8_t d[32], const uint8_t z[32],
+                      uint8_t* ek, uint8_t* dk) {
+  MLKEMParams p = params_for(k);
+  int eklen = 384 * k + 32;
+  kpke_keygen(p, d, ek, dk);
+  std::memcpy(dk + 384 * k, ek, eklen);
+  sha3(136, ek, (size_t)eklen, dk + 384 * k + eklen, 32);
+  std::memcpy(dk + 384 * k + eklen + 32, z, 32);
+}
+
+void qrp_mlkem_encaps(int k, const uint8_t* ek, const uint8_t m[32],
+                      uint8_t* key, uint8_t* ct) {
+  MLKEMParams p = params_for(k);
+  int eklen = 384 * k + 32;
+  uint8_t g_in[64], g_out[64];
+  std::memcpy(g_in, m, 32);
+  sha3(136, ek, (size_t)eklen, g_in + 32, 32);
+  sha3(72, g_in, 64, g_out, 64);
+  std::memcpy(key, g_out, 32);
+  kpke_encrypt(p, ek, m, g_out + 32, ct);
+}
+
+void qrp_mlkem_decaps(int k, const uint8_t* dk, const uint8_t* ct, uint8_t* key) {
+  MLKEMParams p = params_for(k);
+  int eklen = 384 * k + 32;
+  int ctlen = 32 * (p.du * p.k + p.dv);
+  const uint8_t* dk_pke = dk;
+  const uint8_t* ek = dk + 384 * k;
+  const uint8_t* h = dk + 384 * k + eklen;
+  const uint8_t* z = h + 32;
+  uint8_t m2[32], g_in[64], g_out[64];
+  kpke_decrypt(p, dk_pke, ct, m2);
+  std::memcpy(g_in, m2, 32);
+  std::memcpy(g_in + 32, h, 32);
+  sha3(72, g_in, 64, g_out, 64);
+  // key_bar = SHAKE256(z || ct, 32)
+  uint8_t kb_in[32 + 32 * (11 * 4 + 5)];
+  std::memcpy(kb_in, z, 32);
+  std::memcpy(kb_in + 32, ct, (size_t)ctlen);
+  uint8_t key_bar[32];
+  shake(136, kb_in, (size_t)(32 + ctlen), key_bar, 32);
+  uint8_t ct2[32 * (11 * 4 + 5)];
+  kpke_encrypt(p, ek, m2, g_out + 32, ct2);
+  // constant-time compare + select
+  uint8_t diff = 0;
+  for (int i = 0; i < ctlen; ++i) diff |= (uint8_t)(ct[i] ^ ct2[i]);
+  uint8_t mask = (uint8_t)(((int)diff - 1) >> 8);  // 0xff iff diff == 0
+  for (int i = 0; i < 32; ++i)
+    key[i] = (uint8_t)((g_out[i] & mask) | (key_bar[i] & ~mask));
+}
+
+int qrp_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,13 @@
+"""Entry point: ``python -m quantum_resistant_p2p_tpu``.
+
+Reference analog: quantum_resistant_p2p/__main__.py:59-114 (argparse +
+logging setup + event loop + graceful shutdown), with the Qt app replaced by
+the asyncio CLI (cli.py).
+"""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,9 @@ class SecureMessaging:
         symmetric: SymmetricAlgorithm | None = None,
         signature: SignatureAlgorithm | None = None,
         backend: str = "cpu",
+        use_batching: bool = False,
+        max_batch: int = 4096,
+        max_wait_ms: float = 2.0,
     ):
         self.node = node
         self.key_storage = key_storage
@@ -104,6 +107,18 @@ class SecureMessaging:
         self.kem = kem or get_kem("ML-KEM-768", backend)
         self.symmetric = symmetric or get_symmetric("AES-256-GCM")
         self.signature = signature or get_signature("ML-DSA-65", backend)
+
+        # Optional TPU batching queue (the north-star refactor): when enabled,
+        # every handshake/sign/verify op from every concurrent peer coalesces
+        # into padded device batches instead of dispatching one-by-one.
+        self.use_batching = use_batching
+        self._batch_cfg = (max_batch, max_wait_ms)
+        self._bkem = self._bsig = None
+        if use_batching:
+            from ..provider.batched import BatchedKEM, BatchedSignature
+
+            self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms)
+            self._bsig = BatchedSignature(self.signature, max_batch, max_wait_ms)
 
         # per-peer protocol state
         self.shared_keys: dict[str, bytes] = {}
@@ -179,6 +194,41 @@ class SecureMessaging:
             return pk, sk
         return self.signature.generate_keypair()
 
+    # -- async crypto helpers: route through the batch queue when enabled ----
+
+    async def _kem_keygen(self) -> tuple[bytes, bytes]:
+        if self._bkem is not None:
+            return await self._bkem.generate_keypair()
+        return self.kem.generate_keypair()
+
+    async def _kem_encaps(self, pk: bytes) -> tuple[bytes, bytes]:
+        if self._bkem is not None:
+            return await self._bkem.encapsulate(pk)
+        return self.kem.encapsulate(pk)
+
+    async def _kem_decaps(self, sk: bytes, ct: bytes) -> bytes:
+        if self._bkem is not None:
+            return await self._bkem.decapsulate(sk, ct)
+        return self.kem.decapsulate(sk, ct)
+
+    async def _sign(self, message: bytes) -> bytes:
+        if self._bsig is not None:
+            return await self._bsig.sign(self._sig_keypair[1], message)
+        return self.signature.sign(self._sig_keypair[1], message)
+
+    async def _verify(self, sig_algo: str, pk: bytes, message: bytes, sig: bytes) -> bool:
+        """Never raises: malformed attacker input means False (scalar verify's
+        contract, kept on the batched path too)."""
+        try:
+            if sig_algo == self.signature.name:
+                if self._bsig is not None:
+                    return await self._bsig.verify(pk, message, sig)
+                return self.signature.verify(pk, message, sig)
+            verifier = get_signature(sig_algo, self.backend)
+            return verifier.verify(pk, message, sig)
+        except Exception:
+            return False
+
     def _dedup(self, message_id: str) -> bool:
         """True if already seen; prunes the table at capacity (ref: :1506-1517)."""
         if message_id in self._processed_ids:
@@ -228,7 +278,7 @@ class SecureMessaging:
 
         message_id = str(uuid.uuid4())
         try:
-            pk, sk = self.kem.generate_keypair()
+            pk, sk = await self._kem_keygen()
         except Exception:
             logger.exception("ephemeral keygen failed")
             return False
@@ -244,7 +294,7 @@ class SecureMessaging:
             "recipient": peer_id,
             "timestamp": time.time(),
         }
-        sig = self.signature.sign(self._sig_keypair[1], _canonical(ke_data))
+        sig = await self._sign(_canonical(ke_data))
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[message_id] = fut
 
@@ -286,18 +336,10 @@ class SecureMessaging:
             peer_id, "ke_reject", message_id=message_id, reason=reason.value
         )
 
-    def _check_common(self, peer_id: str, data: dict, sig: bytes, sig_pk: bytes,
-                      sig_algo: str) -> RejectReason | None:
+    async def _check_common(self, peer_id: str, data: dict, sig: bytes, sig_pk: bytes,
+                            sig_algo: str) -> RejectReason | None:
         """Signature + identity + replay-window checks shared by init/response."""
-        try:
-            verifier = (
-                self.signature
-                if sig_algo == self.signature.name
-                else get_signature(sig_algo, self.backend)
-            )
-        except Exception:
-            return RejectReason.ALGORITHM_MISMATCH
-        if not verifier.verify(sig_pk, _canonical(data), sig):
+        if not await self._verify(sig_algo, sig_pk, _canonical(data), sig):
             return RejectReason.INVALID_SIGNATURE
         if data.get("sender") != peer_id or data.get("recipient") != self.node_id:
             return RejectReason.IDENTITY_MISMATCH
@@ -309,7 +351,7 @@ class SecureMessaging:
         """Responder: verify, encapsulate, derive, reply (reference: :695-905)."""
         data = msg.get("ke_data") or {}
         message_id = data.get("message_id", "?")
-        err = self._check_common(peer_id, data, msg.get("sig", b""),
+        err = await self._check_common(peer_id, data, msg.get("sig", b""),
                                  msg.get("sig_pk", b""), msg.get("sig_algo", ""))
         if err is not None:
             await self._reject(peer_id, message_id, err)
@@ -318,7 +360,7 @@ class SecureMessaging:
             await self._reject(peer_id, message_id, RejectReason.ALGORITHM_MISMATCH)
             return
         try:
-            ct, secret = self.kem.encapsulate(bytes.fromhex(data["public_key"]))
+            ct, secret = await self._kem_encaps(bytes.fromhex(data["public_key"]))
         except Exception:
             logger.exception("encapsulation failed")
             await self._reject(peer_id, message_id, RejectReason.ENCAPSULATION_ERROR)
@@ -336,7 +378,7 @@ class SecureMessaging:
             "recipient": peer_id,
             "timestamp": time.time(),
         }
-        sig = self.signature.sign(self._sig_keypair[1], _canonical(resp))
+        sig = await self._sign(_canonical(resp))
         await self.node.send_message(
             peer_id,
             "ke_response",
@@ -354,13 +396,13 @@ class SecureMessaging:
         if entry is None or entry[0] != peer_id:
             logger.warning("ke_response for unknown exchange %s", message_id)
             return
-        err = self._check_common(peer_id, data, msg.get("sig", b""),
+        err = await self._check_common(peer_id, data, msg.get("sig", b""),
                                  msg.get("sig_pk", b""), msg.get("sig_algo", ""))
         if err is not None:
             self._fail_pending(message_id, err.value)
             return
         try:
-            secret = self.kem.decapsulate(entry[1], bytes.fromhex(data["ciphertext"]))
+            secret = await self._kem_decaps(entry[1], bytes.fromhex(data["ciphertext"]))
         except Exception:
             logger.exception("decapsulation failed")
             self._fail_pending(message_id, "decapsulation_error")
@@ -381,7 +423,7 @@ class SecureMessaging:
             "recipient": peer_id,
             "timestamp": time.time(),
         }
-        sig = self.signature.sign(self._sig_keypair[1], _canonical(confirm))
+        sig = await self._sign(_canonical(confirm))
         await self.node.send_message(
             peer_id, "ke_confirm", ke_data=confirm, sig=sig,
             sig_algo=self.signature.name, sig_pk=self._sig_keypair[0],
@@ -404,7 +446,7 @@ class SecureMessaging:
 
     async def _handle_ke_confirm(self, peer_id: str, msg: dict) -> None:
         data = msg.get("ke_data") or {}
-        err = self._check_common(peer_id, data, msg.get("sig", b""),
+        err = await self._check_common(peer_id, data, msg.get("sig", b""),
                                  msg.get("sig_pk", b""), msg.get("sig_algo", ""))
         if err is not None:
             logger.warning("bad ke_confirm from %s: %s", peer_id[:8], err.value)
@@ -488,7 +530,7 @@ class SecureMessaging:
             "message": message.to_dict(),
             "sig_algo": self.signature.name,
         }
-        sig = self.signature.sign(self._sig_keypair[1], _canonical(package["message"]))
+        sig = await self._sign(_canonical(package["message"]))
         package["sig"] = sig.hex()
         package["sig_pk"] = self._sig_keypair[0].hex()
         ad = _canonical(
@@ -536,16 +578,8 @@ class SecureMessaging:
             logger.warning("malformed secure message from %s", peer_id[:8])
             return
         # Verify signature over the message body.
-        try:
-            verifier = (
-                self.signature
-                if package.get("sig_algo") == self.signature.name
-                else get_signature(package.get("sig_algo", ""), self.backend)
-            )
-        except Exception:
-            logger.warning("unknown sig algo in message from %s", peer_id[:8])
-            return
-        if not verifier.verify(
+        if not await self._verify(
+            package.get("sig_algo", ""),
             bytes.fromhex(package.get("sig_pk", "")),
             _canonical(package["message"]),
             bytes.fromhex(package.get("sig", "")),
@@ -611,6 +645,10 @@ class SecureMessaging:
     async def set_key_exchange_algorithm(self, name: str) -> None:
         """Drop all shared keys and re-handshake (reference: :1741-1781)."""
         self.kem = get_kem(name, self.backend)
+        if self.use_batching:
+            from ..provider.batched import BatchedKEM
+
+            self._bkem = BatchedKEM(self.kem, *self._batch_cfg)
         peers = list(self.shared_keys)
         self.shared_keys.clear()
         self.raw_secrets.clear()
@@ -635,6 +673,10 @@ class SecureMessaging:
     async def set_signature_algorithm(self, name: str) -> None:
         """Lazily load-or-generate the new keypair (reference: :1827-1851)."""
         self.signature = get_signature(name, self.backend)
+        if self.use_batching:
+            from ..provider.batched import BatchedSignature
+
+            self._bsig = BatchedSignature(self.signature, *self._batch_cfg)
         self._sig_keypair = self._load_or_generate_sig_keypair()
         self._log("crypto_settings_changed", component="signature", algorithm=name)
         await self.notify_peers_of_settings_change()

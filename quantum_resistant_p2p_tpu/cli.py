@@ -1,0 +1,377 @@
+"""Interactive CLI — capability parity with the reference's PyQt5 UI.
+
+The reference ships a Qt desktop app (ui/main_window.py and 7 dialogs,
+SURVEY.md §2 rows 17-27).  This framework keeps the identical operations
+surface as a terminal client (login gate, peer list, chat, file transfer,
+crypto settings + adopt-peer-settings, security metrics, encrypted log
+viewer, key history with audited decrypt, password change, destructive
+reset), driven by slash-commands over an asyncio stdin reader fused with the
+node's event loop — the same loop-fusion role qasync plays in the reference
+(__main__.py:82-83 there).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import getpass
+import json
+import logging
+import shlex
+import sys
+import time
+from pathlib import Path
+
+from .app.message_store import Message, MessageStore
+from .app.messaging import SecureMessaging
+from .net.discovery import NodeDiscovery
+from .net.identity import load_or_generate_node_id
+from .net.p2p_node import P2PNode
+from .provider import list_kems, list_signatures, list_symmetrics
+from .storage.key_storage import KeyStorage, get_app_data_dir
+from .storage.secure_logger import SecureLogger
+
+logger = logging.getLogger(__name__)
+
+HELP = """\
+commands:
+  /peers                     list discovered + connected peers
+  /connect <host> [port]     connect to a peer (default port 8000)
+  /key <peer>                establish a shared key (handshake)
+  /send <peer> <text...>     send an encrypted message
+  /sendfile <peer> <path>    send a file
+  /settings                  show current + available algorithms
+  /set kem|aead|sig <name>   hot-swap an algorithm
+  /adopt <peer>              adopt the peer's gossiped settings
+  /metrics                   security metrics (events, bytes, algorithms)
+  /logs [type] [n]           decrypted audit log (latest n, default 20)
+  /clearlogs                 delete all audit logs
+  /keyhistory [peer]         list stored shared-key history entries
+  /showkey <entry>           decrypt + display a stored key (audited)
+  /delkey <entry>            delete one key-history entry
+  /clearhistory              delete ALL key-history entries
+  /passwd                    change the vault password
+  /reset                     DESTRUCTIVE vault reset
+  /batchstats                TPU batch-queue statistics (if batching on)
+  /quit                      exit
+"""
+
+
+class CLI:
+    """Command processor; separable from stdin so tests can drive it."""
+
+    def __init__(
+        self,
+        vault_path: str | None = None,
+        port: int = 8000,
+        backend: str = "cpu",
+        use_batching: bool = False,
+        enable_discovery: bool = True,
+        out=sys.stdout,
+    ):
+        self.out = out
+        self.port = port
+        self.backend = backend
+        self.use_batching = use_batching
+        self.enable_discovery = enable_discovery
+        self.storage = KeyStorage(vault_path)
+        self.node: P2PNode | None = None
+        self.discovery: NodeDiscovery | None = None
+        self.messaging: SecureMessaging | None = None
+        self.secure_logger: SecureLogger | None = None
+        self.store = MessageStore()
+        self._stop = asyncio.Event()
+
+    # ---------------------------------------------------------------- output
+
+    def print(self, *args) -> None:
+        print(*args, file=self.out)
+
+    # ----------------------------------------------------------------- login
+
+    def login(self, password: str) -> bool:
+        """Unlock-or-initialise the vault (reference: ui/login_dialog.py:92-138)."""
+        return self.storage.unlock(password)
+
+    def login_interactive(self) -> bool:
+        for attempt in range(3):
+            pw = getpass.getpass("vault password: ")
+            if self.login(pw):
+                return True
+            self.print("unlock failed — wrong password or corrupt vault")
+        return False
+
+    # ----------------------------------------------------------------- start
+
+    async def start(self) -> None:
+        assert self.storage.is_unlocked, "login first"
+        log_key = self.storage.get_or_create_purpose_key("secure_logger")
+        self.secure_logger = SecureLogger(log_key)
+        node_id = load_or_generate_node_id(self.storage)
+        self.node = P2PNode(node_id=node_id, host="0.0.0.0", port=self.port)
+        await self.node.start()
+        if self.enable_discovery:
+            self.discovery = NodeDiscovery(node_id, tcp_port=self.node.port)
+            await self.discovery.start()
+        self.messaging = SecureMessaging(
+            self.node,
+            key_storage=self.storage,
+            secure_logger=self.secure_logger,
+            backend=self.backend,
+            use_batching=self.use_batching,
+        )
+        self.messaging.register_message_listener(self._on_message)
+        self.secure_logger.log_event("initialization", node_id=node_id, port=self.node.port)
+        self.print(f"node {node_id[:12]}… listening on :{self.node.port} "
+                   f"(backend={self.backend}, batching={self.use_batching})")
+
+    async def stop(self) -> None:
+        if self.discovery:
+            await self.discovery.stop()
+        if self.node:
+            await self.node.stop()
+        self._stop.set()
+
+    def _on_message(self, peer_id: str, message: Message) -> None:
+        self.store.add_message(peer_id, message, unread=True)
+        if message.is_file:
+            # Path(...).name strips directories — a peer-supplied filename like
+            # "../../x" or an absolute path must not escape the received dir.
+            safe_name = Path(message.filename or "file.bin").name or "file.bin"
+            dest = get_app_data_dir() / "received" / safe_name
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            dest.write_bytes(message.content)
+            self.print(f"\n[{peer_id[:8]}] sent file {message.filename} "
+                       f"({len(message.content)} bytes) -> {dest}")
+        else:
+            tag = "system" if message.is_system else peer_id[:8]
+            self.print(f"\n[{tag}] {message.content.decode(errors='replace')}")
+
+    # -------------------------------------------------------------- commands
+
+    async def handle(self, line: str) -> bool:
+        """Process one command line; returns False when the CLI should exit."""
+        line = line.strip()
+        if not line:
+            return True
+        if not line.startswith("/"):
+            self.print("commands start with '/'; /help for a list")
+            return True
+        try:
+            parts = shlex.split(line)
+        except ValueError as e:
+            self.print(f"parse error: {e}")
+            return True
+        cmd, args = parts[0].lower(), parts[1:]
+        try:
+            return await self._dispatch(cmd, args)
+        except Exception as e:  # keep the REPL alive
+            logger.exception("command failed")
+            self.print(f"error: {e}")
+            return True
+
+    async def _dispatch(self, cmd: str, args: list[str]) -> bool:
+        m = self.messaging
+        if cmd in ("/help", "/?"):
+            self.print(HELP)
+        elif cmd == "/quit":
+            await self.stop()
+            return False
+        elif cmd == "/peers":
+            connected = set(self.node.get_peers())
+            rows = []
+            if self.discovery:
+                for pid, info in self.discovery.get_discovered_nodes().items():
+                    host, port = info["host"], info["port"]
+                    status = "connected" if pid in connected else "discovered"
+                    if m.verify_key_exchange_state(pid):
+                        status = "secure"
+                    match = m.settings_match(pid)
+                    warn = " ⚠ settings mismatch" if match is False else ""
+                    rows.append(f"  {pid[:12]}…  {host}:{port}  {status}{warn}"
+                                f"  unread={self.store.get_unread_count(pid)}")
+            for pid in connected:
+                if not self.discovery or pid not in self.discovery.get_discovered_nodes():
+                    status = "secure" if m.verify_key_exchange_state(pid) else "connected"
+                    rows.append(f"  {pid[:12]}…  {status}"
+                                f"  unread={self.store.get_unread_count(pid)}")
+            self.print("\n".join(rows) if rows else "  (no peers)")
+        elif cmd == "/connect":
+            host = args[0]
+            port = int(args[1]) if len(args) > 1 else 8000
+            pid = await self.node.connect_to_peer(host, port)
+            self.print(f"connected to {pid[:12]}…" if pid else "connect failed")
+        elif cmd == "/key":
+            ok = await m.initiate_key_exchange(self._peer(args[0]))
+            self.print("shared key established" if ok else "key exchange failed")
+        elif cmd == "/send":
+            sent = await m.send_message(self._peer(args[0]), " ".join(args[1:]).encode())
+            self.print("sent" if sent else "send failed")
+        elif cmd == "/sendfile":
+            sent = await m.send_file(self._peer(args[0]), Path(args[1]))
+            self.print("sent" if sent else "send failed")
+        elif cmd == "/settings":
+            s = m.get_settings()
+            self.print(f"current: kem={s['kem']} aead={s['aead']} sig={s['signature']}")
+            self.print(f"kems: {', '.join(list_kems())}")
+            self.print(f"aeads: {', '.join(list_symmetrics())}")
+            self.print(f"signatures: {', '.join(list_signatures())}")
+        elif cmd == "/set":
+            kind, name = args[0], args[1]
+            if kind == "kem":
+                await m.set_key_exchange_algorithm(name)
+            elif kind == "aead":
+                await m.set_symmetric_algorithm(name)
+            elif kind == "sig":
+                await m.set_signature_algorithm(name)
+            else:
+                self.print("usage: /set kem|aead|sig <name>")
+                return True
+            self.print(f"{kind} -> {name}")
+        elif cmd == "/adopt":
+            ok = await m.adopt_peer_settings(self._peer(args[0]))
+            self.print("adopted peer settings" if ok else "no gossiped settings for peer")
+        elif cmd == "/metrics":
+            self.print(json.dumps(self.secure_logger.get_security_metrics(), indent=2))
+        elif cmd == "/logs":
+            etype = args[0] if args and not args[0].isdigit() else None
+            n = int(args[-1]) if args and args[-1].isdigit() else 20
+            events = self.secure_logger.get_events(event_type=etype)[-n:]
+            for ev in events:
+                ts = time.strftime("%H:%M:%S", time.localtime(ev.get("timestamp", 0)))
+                rest = {k: v for k, v in ev.items() if k not in ("timestamp", "event_type")}
+                self.print(f"  {ts} {ev.get('event_type')} {rest}")
+            if not events:
+                self.print("  (no events)")
+        elif cmd == "/clearlogs":
+            self.print(f"deleted {self.secure_logger.clear_logs()} log file(s)")
+        elif cmd == "/keyhistory":
+            entries = self.storage.list_key_history(args[0] if args else None)
+            for e in entries:
+                self.print(f"  {e['name']}  peer={e.get('peer_id', '?')[:12]}  "
+                           f"algo={e.get('algo', '?')}")
+            if not entries:
+                self.print("  (none)")
+        elif cmd == "/showkey":
+            v = self.storage.get_key_history_value(args[0])
+            self.secure_logger.log_event("key_history_access", entry=args[0])
+            if v is None:
+                self.print("not found")
+            else:
+                self.print(f"  hex: {bytes.fromhex(v['key']).hex() if isinstance(v.get('key'), str) else v}")
+        elif cmd == "/delkey":
+            ok = self.storage.delete_key_history(args[0])
+            self.secure_logger.log_event("key_history_changed", deleted=args[0], ok=ok)
+            self.print("deleted" if ok else "not found")
+        elif cmd == "/clearhistory":
+            n = self.storage.clear_key_history()
+            self.secure_logger.log_event("key_history_changed", cleared=n)
+            self.print(f"deleted {n} entries")
+        elif cmd == "/passwd":
+            old = getpass.getpass("old password: ")
+            new = getpass.getpass("new password: ")
+            if new != getpass.getpass("confirm: "):
+                self.print("mismatch")
+            elif self.storage.change_password(old, new):
+                self.secure_logger.log_event("password_change")
+                self.print("password changed")
+            else:
+                self.print("wrong password")
+        elif cmd == "/reset":
+            confirm = input("type RESET to destroy the vault and start fresh: ")
+            if confirm == "RESET":
+                new = getpass.getpass("new password: ")
+                self.storage.reset_storage(new)
+                self.print("vault reset")
+            else:
+                self.print("cancelled")
+        elif cmd == "/batchstats":
+            if m._bkem is None:
+                self.print("batching disabled (start with --batch)")
+            else:
+                self.print(json.dumps({"kem": m._bkem.stats(), "sig": m._bsig.stats()},
+                                      indent=2))
+        else:
+            self.print(f"unknown command {cmd}; /help for a list")
+        return True
+
+    def _peer(self, prefix: str) -> str:
+        """Resolve a peer-id prefix to a full id."""
+        candidates = set(self.node.get_peers())
+        if self.discovery:
+            candidates |= set(self.discovery.get_discovered_nodes())
+        matches = [p for p in candidates if p.startswith(prefix)]
+        if len(matches) == 1:
+            return matches[0]
+        if not matches:
+            return prefix  # allow full ids for not-yet-listed peers
+        raise ValueError(f"ambiguous peer prefix {prefix!r}: {matches}")
+
+    # ------------------------------------------------------------------ REPL
+
+    async def repl(self) -> None:
+        loop = asyncio.get_running_loop()
+        reader = asyncio.StreamReader()
+        await loop.connect_read_pipe(
+            lambda: asyncio.StreamReaderProtocol(reader), sys.stdin
+        )
+        self.print("type /help for commands")
+        while not self._stop.is_set():
+            line = await reader.readline()
+            if not line:
+                await self.stop()
+                break
+            if not await self.handle(line.decode()):
+                break
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    from .config import Config
+
+    ap = argparse.ArgumentParser(prog="quantum_resistant_p2p_tpu")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--vault", default=None, help="vault file path")
+    ap.add_argument("--backend", choices=("cpu", "tpu", "auto"), default=None)
+    ap.add_argument("--batch", action="store_true", help="enable the TPU batch queue")
+    ap.add_argument("--config", default=None, help="config file path")
+    ap.add_argument("--no-discovery", action="store_true")
+    ap.add_argument("--log-level", default="INFO")
+    args = ap.parse_args(argv)
+
+    cfg = Config.load(
+        path=args.config,
+        port=args.port,
+        backend=args.backend,
+        use_batching=True if args.batch else None,
+    )
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        filename=str(get_app_data_dir() / "system.log"),
+    )
+
+    cli = CLI(
+        vault_path=args.vault,
+        port=cfg.port,
+        backend=cfg.backend,
+        use_batching=cfg.use_batching,
+        enable_discovery=not args.no_discovery,
+    )
+    if not cli.login_interactive():
+        return 1
+
+    async def run():
+        await cli.start()
+        await cli.repl()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

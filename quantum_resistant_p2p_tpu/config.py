@@ -1,0 +1,81 @@
+"""Configuration system — algorithm defaults, provider selection, batching.
+
+The reference has no config file at all (SURVEY.md §5: pyyaml/python-dotenv
+declared but never imported; everything is constructor defaults + UI state).
+This framework adds the real config layer the survey calls for: a JSON file
+(``~/.quantum_resistant_p2p_tpu/config.json`` by default) overridden by
+``QRP2P_*`` environment variables, feeding the CLI and SecureMessaging
+constructors.
+
+Precedence: explicit kwargs > environment > config file > defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Config:
+    # algorithm defaults (reference defaults: app/messaging.py:126-128)
+    kem: str = "ML-KEM-768"
+    aead: str = "AES-256-GCM"
+    signature: str = "ML-DSA-65"
+    # provider
+    backend: str = "auto"  # cpu | tpu | auto
+    use_batching: bool = False
+    max_batch: int = 4096
+    max_wait_ms: float = 2.0
+    # networking (reference defaults: networking/p2p_node.py:20-21)
+    port: int = 8000
+    discovery_port: int = 8001
+    chunk_size: int = 64 * 1024
+    # multi-chip: devices along the batch axis; 0 = all visible devices
+    mesh_devices: int = 0
+
+    @classmethod
+    def default_path(cls) -> Path:
+        from .storage.key_storage import get_app_data_dir
+
+        return get_app_data_dir() / "config.json"
+
+    @classmethod
+    def load(cls, path: str | os.PathLike | None = None, **overrides) -> "Config":
+        cfg = cls()
+        p = Path(path) if path else cls.default_path()
+        if p.exists():
+            try:
+                data = json.loads(p.read_text())
+                for k, v in data.items():
+                    if hasattr(cfg, k):
+                        setattr(cfg, k, v)
+                    else:
+                        logger.warning("unknown config key %r in %s", k, p)
+            except ValueError as e:
+                logger.warning("malformed config %s: %s (using defaults)", p, e)
+        for f in dataclasses.fields(cls):
+            env = os.environ.get(f"QRP2P_{f.name.upper()}")
+            if env is not None:
+                try:
+                    if f.type == "bool":
+                        setattr(cfg, f.name, env.lower() in ("1", "true", "yes", "on"))
+                    else:
+                        setattr(cfg, f.name, type(getattr(cfg, f.name))(env))
+                except ValueError:
+                    logger.warning("bad env value QRP2P_%s=%r", f.name.upper(), env)
+        for k, v in overrides.items():
+            if v is not None:
+                setattr(cfg, k, v)
+        return cfg
+
+    def save(self, path: str | os.PathLike | None = None) -> Path:
+        p = Path(path) if path else self.default_path()
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(dataclasses.asdict(self), indent=2))
+        return p

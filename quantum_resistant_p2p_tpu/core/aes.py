@@ -1,0 +1,102 @@
+"""Batched AES-128-ECB encryption in JAX (for FrodoKEM-AES matrix expansion).
+
+TPU-native notes: SubBytes is a 256-entry gather (``jnp.take``) — TPUs handle
+small-table gathers fine; ShiftRows is a static permutation; MixColumns is
+GF(2^8) xtime arithmetic on uint8 lanes; the key schedule is 10 tiny rounds
+vectorised over the batch.  Everything operates on ``(..., blocks, 16)`` uint8
+arrays, so one jitted program encrypts millions of counter blocks across a
+batch of keys — the access pattern FrodoKEM's A-matrix generation needs
+(reference behavior: AES inside liboqs FrodoKEM, crypto/key_exchange.py:332).
+
+Oracle: cryptography's AES-ECB (tests/test_frodo.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# S-box generated from GF(2^8) inverse + affine map (computed, not transcribed).
+
+
+def _make_sbox() -> np.ndarray:
+    # GF(2^8) with modulus x^8+x^4+x^3+x+1 (0x11B)
+    exp = np.zeros(256, dtype=np.int32)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x ^= (x << 1) ^ (0x11B if x & 0x80 else 0)
+        x &= 0xFF
+    inv = np.zeros(256, dtype=np.int32)
+    for v in range(1, 256):
+        inv[v] = exp[(255 - log[v]) % 255]
+    sbox = np.zeros(256, dtype=np.uint8)
+    for v in range(256):
+        b = inv[v]
+        r = 0x63
+        for sh in (0, 1, 2, 3, 4):
+            r ^= ((b << sh) | (b >> (8 - sh))) & 0xFF
+        sbox[v] = r
+    return sbox
+
+
+_SBOX = _make_sbox()
+_RCON = np.array([0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36], np.uint8)
+
+# ShiftRows on column-major state bytes (byte i = row i%4, col i//4)
+_SHIFT = np.array([0, 5, 10, 15, 4, 9, 14, 3, 8, 13, 2, 7, 12, 1, 6, 11])
+
+
+def key_schedule(key: jax.Array) -> jax.Array:
+    """(..., 16) uint8 -> (..., 11, 16) uint8 round keys."""
+    sbox = jnp.asarray(_SBOX)
+    w = [key[..., i * 4 : (i + 1) * 4] for i in range(4)]
+    for r in range(10):
+        last = w[-1]
+        rot = jnp.concatenate([last[..., 1:], last[..., :1]], axis=-1)
+        sub = jnp.take(sbox, rot.astype(jnp.int32), axis=0)
+        rcon = jnp.zeros_like(sub).at[..., 0].set(_RCON[r])
+        t = sub ^ rcon
+        w.append(w[-4] ^ t)
+        for _ in range(3):
+            w.append(w[-4] ^ w[-1])
+    keys = jnp.concatenate(w, axis=-1)  # (..., 44*4)
+    return keys.reshape(keys.shape[:-1] + (11, 16))
+
+
+def _xtime(b: jax.Array) -> jax.Array:
+    return ((b << 1) ^ jnp.where(b & 0x80 != 0, 0x1B, 0)).astype(jnp.uint8) & 0xFF
+
+
+def _mix_columns(s: jax.Array) -> jax.Array:
+    """(..., 16) uint8 column-major state."""
+    c = s.reshape(s.shape[:-1] + (4, 4))  # (..., col, row)
+    a0, a1, a2, a3 = c[..., 0], c[..., 1], c[..., 2], c[..., 3]
+    x0, x1, x2, x3 = _xtime(a0), _xtime(a1), _xtime(a2), _xtime(a3)
+    b0 = x0 ^ (x1 ^ a1) ^ a2 ^ a3
+    b1 = a0 ^ x1 ^ (x2 ^ a2) ^ a3
+    b2 = a0 ^ a1 ^ x2 ^ (x3 ^ a3)
+    b3 = (x0 ^ a0) ^ a1 ^ a2 ^ x3
+    return jnp.stack([b0, b1, b2, b3], axis=-1).reshape(s.shape)
+
+
+def encrypt_blocks(round_keys: jax.Array, blocks: jax.Array) -> jax.Array:
+    """round_keys (..., 11, 16), blocks (..., B, 16) uint8 -> (..., B, 16).
+
+    round_keys broadcast over the block axis.
+    """
+    sbox = jnp.asarray(_SBOX)
+    shift = jnp.asarray(_SHIFT)
+    rk = round_keys[..., None, :, :]  # (..., 1, 11, 16)
+    s = blocks ^ rk[..., 0, :]
+    for r in range(1, 10):
+        s = jnp.take(sbox, s.astype(jnp.int32), axis=0)
+        s = s[..., shift]
+        s = _mix_columns(s)
+        s = s ^ rk[..., r, :]
+    s = jnp.take(sbox, s.astype(jnp.int32), axis=0)
+    s = s[..., shift]
+    return s ^ rk[..., 10, :]

@@ -204,21 +204,44 @@ def sponge(data: jax.Array, rate: int, ds_byte: int, out_len: int) -> jax.Array:
     hi = jnp.zeros(batch + (25,), dtype=jnp.uint32)
     lo = jnp.zeros(batch + (25,), dtype=jnp.uint32)
     nwords = rate // 8
-    for b in range(nblocks):
-        block = padded[..., b * rate : (b + 1) * rate]
+
+    def absorb(state, block):
+        hi, lo = state
         bh, bl = _bytes_to_words(block)
         hi = hi.at[..., :nwords].set(hi[..., :nwords] ^ bh)
         lo = lo.at[..., :nwords].set(lo[..., :nwords] ^ bl)
-        hi, lo = keccak_f1600(hi, lo)
+        return keccak_f1600(hi, lo)
 
-    out_blocks = []
-    produced = 0
-    while produced < out_len:
-        out_blocks.append(_words_to_bytes(hi[..., :nwords], lo[..., :nwords]))
-        produced += rate
-        if produced < out_len:
-            hi, lo = keccak_f1600(hi, lo)
-    out = jnp.concatenate(out_blocks, axis=-1) if len(out_blocks) > 1 else out_blocks[0]
+    # Unroll short sponges (lower dispatch overhead); lax.scan long ones so
+    # graph size / compile time stays O(1) in message length — FrodoKEM and
+    # HQC absorb/squeeze hundreds of blocks.
+    if nblocks <= 4:
+        for b in range(nblocks):
+            hi, lo = absorb((hi, lo), padded[..., b * rate : (b + 1) * rate])
+    else:
+        blocks = jnp.moveaxis(
+            padded.reshape(batch + (nblocks, rate)), -2, 0
+        )  # (nblocks, ..., rate)
+        (hi, lo), _ = lax.scan(lambda s, blk: (absorb(s, blk), None), (hi, lo), blocks)
+
+    out_nblocks = -(-out_len // rate)
+    if out_nblocks <= 4:
+        out_blocks = []
+        for b in range(out_nblocks):
+            out_blocks.append(_words_to_bytes(hi[..., :nwords], lo[..., :nwords]))
+            if b + 1 < out_nblocks:
+                hi, lo = keccak_f1600(hi, lo)
+        out = (
+            jnp.concatenate(out_blocks, axis=-1) if len(out_blocks) > 1 else out_blocks[0]
+        )
+    else:
+        def squeeze(state, _):
+            hi, lo = state
+            blk = _words_to_bytes(hi[..., :nwords], lo[..., :nwords])
+            return keccak_f1600(hi, lo), blk
+
+        _, blks = lax.scan(squeeze, (hi, lo), None, length=out_nblocks)
+        out = jnp.moveaxis(blks, 0, -2).reshape(batch + (out_nblocks * rate,))
     return out[..., :out_len]
 
 

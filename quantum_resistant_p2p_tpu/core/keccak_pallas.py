@@ -1,0 +1,139 @@
+"""Pallas TPU kernel for batched Keccak-f[1600].
+
+The jnp version (core.keccak) lowers to an XLA fori_loop whose 24-round body
+materialises intermediate 25-lane stacks each round.  This kernel keeps the
+whole 50-word (25 lanes x hi/lo uint32) state resident in VMEM for all 24
+rounds, with the batch on the 128-lane axis — one grid cell per 128 sponges:
+
+  layout:  state[56, B] int32 — rows 0..24 hi words, rows 28..52 lo words
+           (row count padded to a multiple of 8 for int32 sublane tiling)
+  grid:    (B // 128,) — each cell permutes its 128-sponge block in place
+
+Rotations are per-lane compile-time constants, so the round body unrolls into
+pure VPU bitwise ops with zero gathers.  Use ``keccak_f1600`` below as a
+drop-in for core.keccak.keccak_f1600 on (batch, 25) uint32 pairs; it falls
+back to the jnp implementation off-TPU (Pallas interpret mode is only used in
+tests).
+
+Reference for parity: same permutation the vendored liboqs implements in C
+(reference vendor/oqs.py loads it; every KEM/sig depends on it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import keccak as _jnp_keccak
+
+try:  # pallas import can fail on exotic platforms; fall back silently
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+_RHO = _jnp_keccak._rho_offsets()
+_PI_SRC = _jnp_keccak._pi_source()
+_RC = _jnp_keccak._round_constants()
+
+_ROWS = 56  # 25 hi + pad + 25 lo, multiple of 8
+_LO_OFF = 28
+_BLOCK_B = 128
+
+
+def _rotl_pair(hi, lo, n: int):
+    n %= 64
+    if n == 0:
+        return hi, lo
+    if n >= 32:
+        hi, lo = lo, hi
+        n -= 32
+        if n == 0:
+            return hi, lo
+    return (hi << n) | (lo >> (32 - n)), (lo << n) | (hi >> (32 - n))
+
+
+def _kernel(state_ref, out_ref):
+    # load the full 56x128 block once; all rounds run on register/VMEM values
+    s = state_ref[:].astype(jnp.uint32)
+    hi = [s[i, :] for i in range(25)]
+    lo = [s[_LO_OFF + i, :] for i in range(25)]
+    for rnd in range(24):
+        # theta
+        ch = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20] for x in range(5)]
+        cl = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20] for x in range(5)]
+        for x in range(5):
+            r1h, r1l = _rotl_pair(ch[(x + 1) % 5], cl[(x + 1) % 5], 1)
+            dh = ch[(x + 4) % 5] ^ r1h
+            dl = cl[(x + 4) % 5] ^ r1l
+            for y in range(5):
+                hi[x + 5 * y] = hi[x + 5 * y] ^ dh
+                lo[x + 5 * y] = lo[x + 5 * y] ^ dl
+        # rho + pi
+        bh = [None] * 25
+        bl = [None] * 25
+        for dst in range(25):
+            src = int(_PI_SRC[dst])
+            bh[dst], bl[dst] = _rotl_pair(hi[src], lo[src], int(_RHO[src]))
+        # chi
+        for y in range(5):
+            row_h = [bh[x + 5 * y] for x in range(5)]
+            row_l = [bl[x + 5 * y] for x in range(5)]
+            for x in range(5):
+                hi[x + 5 * y] = row_h[x] ^ (~row_h[(x + 1) % 5] & row_h[(x + 2) % 5])
+                lo[x + 5 * y] = row_l[x] ^ (~row_l[(x + 1) % 5] & row_l[(x + 2) % 5])
+        # iota
+        hi[0] = hi[0] ^ jnp.uint32(int(_RC[rnd, 0]))
+        lo[0] = lo[0] ^ jnp.uint32(int(_RC[rnd, 1]))
+    out = jnp.zeros((_ROWS, _BLOCK_B), jnp.uint32)
+    for i in range(25):
+        out = out.at[i, :].set(hi[i])
+        out = out.at[_LO_OFF + i, :].set(lo[i])
+    out_ref[:] = out.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _permute_blocks(packed: jax.Array, interpret: bool = False) -> jax.Array:
+    """(56, B) int32 with B % 128 == 0 -> permuted, same shape."""
+    nb = packed.shape[1] // _BLOCK_B
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct(packed.shape, jnp.int32),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((_ROWS, _BLOCK_B), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((_ROWS, _BLOCK_B), lambda i: (0, i)),
+        interpret=interpret,
+    )(packed)
+
+
+def keccak_f1600(hi: jax.Array, lo: jax.Array, interpret: bool = False):
+    """Drop-in for core.keccak.keccak_f1600 on 2-D (batch, 25) uint32 pairs.
+
+    Pads the batch up to a multiple of 128 and runs the Pallas kernel; use on
+    TPU (or interpret=True in tests).
+    """
+    if not _HAVE_PALLAS:
+        return _jnp_keccak.keccak_f1600(hi, lo)
+    b = hi.shape[0]
+    bpad = -(-b // _BLOCK_B) * _BLOCK_B
+    packed = jnp.zeros((_ROWS, bpad), jnp.int32)
+    packed = packed.at[:25, :b].set(hi.astype(jnp.int32).T)
+    packed = packed.at[_LO_OFF : _LO_OFF + 25, :b].set(lo.astype(jnp.int32).T)
+    out = _permute_blocks(packed, interpret=interpret)
+    return (
+        out[:25, :b].T.astype(jnp.uint32),
+        out[_LO_OFF : _LO_OFF + 25, :b].T.astype(jnp.uint32),
+    )
+
+
+def use_pallas_on_tpu() -> bool:
+    """True when the default backend is a TPU (where the kernel is worth it)."""
+    try:
+        return _HAVE_PALLAS and jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover
+        return False

@@ -1,0 +1,172 @@
+"""Batched SHA-256 / HMAC / HKDF in JAX — uint32-native, VPU-friendly.
+
+SHA-256 is pure 32-bit arithmetic, so unlike Keccak (64-bit lanes emulated as
+uint32 pairs in ``core.keccak``) it maps directly onto TPU vector lanes: the
+8-word state and 64-round schedule vectorise over an arbitrary leading batch
+shape with no emulation.
+
+All lengths are static Python ints -> fixed-shape XLA programs.  The 64-round
+compression runs under ``lax.fori_loop`` with the 16-word schedule window kept
+as a (..., 16) uint32 array (rotating index, no dynamic shapes).
+
+``midstate`` support: SPHINCS+-SHA2 hashes millions of 64-byte blocks whose
+first block is the constant ``pk_seed || zero-pad``; precomputing that block's
+state once per keypair halves the tree-hash work (FIPS 205 §11.2.1 note).
+
+Replaces (reference): OpenSSL SHA-256/HMAC inside the `cryptography` package —
+HKDF-SHA256 at app/messaging.py:23,372-377 and the SHA2 hashes inside
+liboqs SPHINCS+-SHA2 (crypto/signatures.py:191-315).
+Oracle: hashlib.sha256 / hmac (tests/test_sha256.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Round constants: fractional parts of cube roots of the first 64 primes.
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x: jax.Array, n: int) -> jax.Array:
+    return (x >> n) | (x << (32 - n))
+
+
+def _block_words(block: jax.Array) -> jax.Array:
+    """(..., 64) uint8 -> (..., 16) uint32 big-endian words."""
+    b = block.astype(jnp.uint32).reshape(block.shape[:-1] + (16, 4))
+    return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+
+
+def compress(state: jax.Array, block: jax.Array) -> jax.Array:
+    """One SHA-256 compression: state (..., 8) uint32, block (..., 64) uint8."""
+    w0 = _block_words(block)
+    k = jnp.asarray(_K)
+
+    def round_fn(t, carry):
+        v, w = carry  # v: (..., 8) working vars, w: (..., 16) schedule window
+        wt = w[..., 0]
+        a, b, c, d, e, f, g, h = (v[..., i] for i in range(8))
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k[t] + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        v = jnp.stack([t1 + t2, a, b, c, d + t1, e, f, g], axis=-1)
+        # extend schedule: w16 = sig1(w14) + w9 + sig0(w1) + w0
+        w1, w9, w14 = w[..., 1], w[..., 9], w[..., 14]
+        sig0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> 3)
+        sig1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> 10)
+        w16 = sig1 + w9 + sig0 + wt
+        w = jnp.concatenate([w[..., 1:], w16[..., None]], axis=-1)
+        return v, w
+
+    v, _ = lax.fori_loop(0, 64, round_fn, (state, w0))
+    return state + v
+
+
+def _pad(data: jax.Array, prefix_blocks: int = 0) -> jax.Array:
+    """FIPS 180-4 padding; total bit length includes prefix_blocks * 512."""
+    msg_len = data.shape[-1]
+    total_bits = (prefix_blocks * 64 + msg_len) * 8
+    pad_len = (55 - msg_len) % 64 + 9
+    tail = np.zeros(pad_len, dtype=np.uint8)
+    tail[0] = 0x80
+    tail[-8:] = np.frombuffer(np.uint64(total_bits).byteswap().tobytes(), np.uint8)
+    tail_b = jnp.broadcast_to(jnp.asarray(tail), data.shape[:-1] + (pad_len,))
+    return jnp.concatenate([data, tail_b], axis=-1)
+
+
+def _absorb(state: jax.Array, padded: jax.Array) -> jax.Array:
+    for i in range(padded.shape[-1] // 64):
+        state = compress(state, padded[..., i * 64 : (i + 1) * 64])
+    return state
+
+
+def _digest(state: jax.Array) -> jax.Array:
+    """(..., 8) uint32 -> (..., 32) uint8 big-endian."""
+    parts = [(state >> 24) & 0xFF, (state >> 16) & 0xFF, (state >> 8) & 0xFF, state & 0xFF]
+    out = jnp.stack(parts, axis=-1).astype(jnp.uint8)
+    return out.reshape(out.shape[:-2] + (-1,))
+
+
+def init_state(batch_shape: tuple[int, ...] = ()) -> jax.Array:
+    return jnp.broadcast_to(jnp.asarray(_H0), batch_shape + (8,))
+
+
+def sha256(data: jax.Array) -> jax.Array:
+    """(..., L) uint8 -> (..., 32) uint8; L static."""
+    data = jnp.asarray(data, jnp.uint8)
+    state = init_state(data.shape[:-1])
+    return _digest(_absorb(state, _pad(data)))
+
+
+def sha256_from_midstate(state: jax.Array, data: jax.Array, prefix_blocks: int) -> jax.Array:
+    """Finish SHA-256 from a precomputed state over ``prefix_blocks`` blocks."""
+    data = jnp.asarray(data, jnp.uint8)
+    return _digest(_absorb(state, _pad(data, prefix_blocks)))
+
+
+def midstate(prefix: jax.Array) -> jax.Array:
+    """State after absorbing a (..., 64k) uint8 prefix (no padding)."""
+    prefix = jnp.asarray(prefix, jnp.uint8)
+    if prefix.shape[-1] % 64:
+        raise ValueError("midstate prefix must be a multiple of 64 bytes")
+    return _absorb(init_state(prefix.shape[:-1]), prefix)
+
+
+# --------------------------------------------------------------------------
+# HMAC-SHA256 and HKDF (RFC 2104 / RFC 5869), batched, static lengths
+# --------------------------------------------------------------------------
+
+
+def hmac_sha256(key: jax.Array, data: jax.Array) -> jax.Array:
+    """key (..., kl<=64) uint8, data (..., L) uint8 -> (..., 32) uint8."""
+    key = jnp.asarray(key, jnp.uint8)
+    data = jnp.asarray(data, jnp.uint8)
+    if key.shape[-1] > 64:
+        key = sha256(key)
+    pad_k = jnp.zeros(key.shape[:-1] + (64 - key.shape[-1],), jnp.uint8)
+    k64 = jnp.concatenate([key, pad_k], axis=-1)
+    inner = sha256(jnp.concatenate([k64 ^ 0x36, data], axis=-1))
+    return sha256(jnp.concatenate([k64 ^ 0x5C, inner], axis=-1))
+
+
+def hkdf_sha256(
+    ikm: jax.Array, salt: jax.Array, info: jax.Array, length: int = 32
+) -> jax.Array:
+    """RFC 5869 extract+expand; length <= 8160, all shapes static."""
+    prk = hmac_sha256(salt, ikm)
+    n = -(-length // 32)
+    okm = []
+    t = jnp.zeros(ikm.shape[:-1] + (0,), jnp.uint8)
+    for i in range(1, n + 1):
+        ctr = jnp.broadcast_to(jnp.uint8(i), ikm.shape[:-1] + (1,))
+        t = hmac_sha256(prk, jnp.concatenate([t, info, ctr], axis=-1))
+        okm.append(t)
+    out = jnp.concatenate(okm, axis=-1) if len(okm) > 1 else okm[0]
+    return out[..., :length]

@@ -1,0 +1,187 @@
+"""Batched SHA-512 in JAX — 64-bit words emulated as uint32 (hi, lo) pairs.
+
+Same emulation strategy as ``core.keccak`` (TPUs have no 64-bit lanes): each
+of the 8 state words and 16 schedule words is a pair of uint32 arrays; 64-bit
+addition is add-with-carry, rotations are shift/or pairs (or swaps for
+n >= 32).  All lengths static -> fixed-shape XLA programs over any leading
+batch shape.
+
+Needed by sig.sphincs for the 192/256-bit SPHINCS+-SHA2 parameter sets, whose
+H / T_l / H_msg use SHA-512 (FIPS 205 §11.2; reference behavior inside liboqs,
+crypto/signatures.py:208-212).  Oracle: hashlib.sha512.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_K64 = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+_KH = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+_KL = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
+
+_H64 = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+_H0H = np.array([h >> 32 for h in _H64], dtype=np.uint32)
+_H0L = np.array([h & 0xFFFFFFFF for h in _H64], dtype=np.uint32)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _rotr64(h, l, n: int):
+    if n >= 32:
+        h, l = l, h
+        n -= 32
+    if n == 0:
+        return h, l
+    return (h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n))
+
+
+def _shr64(h, l, n: int):
+    if n >= 32:
+        return jnp.zeros_like(h), h >> (n - 32)
+    return h >> n, (l >> n) | (h << (32 - n))
+
+
+def _xor3(a, b, c):
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _block_words(block: jax.Array):
+    """(..., 128) uint8 -> ((..., 16), (..., 16)) uint32 BE word pairs."""
+    b = block.astype(jnp.uint32).reshape(block.shape[:-1] + (16, 8))
+    hi = (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    lo = (b[..., 4] << 24) | (b[..., 5] << 16) | (b[..., 6] << 8) | b[..., 7]
+    return hi, lo
+
+
+def compress(state, block: jax.Array):
+    """state ((..., 8), (..., 8)) uint32 pair, block (..., 128) uint8."""
+    sh, sl = state
+    wh, wl = _block_words(block)
+    kh, kl = jnp.asarray(_KH), jnp.asarray(_KL)
+
+    def round_fn(t, carry):
+        vh, vl, wh, wl = carry
+        a = (vh[..., 0], vl[..., 0]); b = (vh[..., 1], vl[..., 1])
+        c = (vh[..., 2], vl[..., 2]); d = (vh[..., 3], vl[..., 3])
+        e = (vh[..., 4], vl[..., 4]); f = (vh[..., 5], vl[..., 5])
+        g = (vh[..., 6], vl[..., 6]); h = (vh[..., 7], vl[..., 7])
+        s1 = _xor3(_rotr64(*e, 14), _rotr64(*e, 18), _rotr64(*e, 41))
+        ch = (e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1])
+        t1 = _add64(*h, *s1)
+        t1 = _add64(*t1, *ch)
+        t1 = _add64(*t1, kh[t], kl[t])
+        t1 = _add64(*t1, wh[..., 0], wl[..., 0])
+        s0 = _xor3(_rotr64(*a, 28), _rotr64(*a, 34), _rotr64(*a, 39))
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t2 = _add64(*s0, *maj)
+        new_a = _add64(*t1, *t2)
+        new_e = _add64(*d, *t1)
+        vh = jnp.stack([new_a[0], a[0], b[0], c[0], new_e[0], e[0], f[0], g[0]], axis=-1)
+        vl = jnp.stack([new_a[1], a[1], b[1], c[1], new_e[1], e[1], f[1], g[1]], axis=-1)
+        # schedule: w16 = sig1(w14) + w9 + sig0(w1) + w0
+        w1 = (wh[..., 1], wl[..., 1]); w9 = (wh[..., 9], wl[..., 9])
+        w14 = (wh[..., 14], wl[..., 14])
+        sig0 = _xor3(_rotr64(*w1, 1), _rotr64(*w1, 8), _shr64(*w1, 7))
+        sig1 = _xor3(_rotr64(*w14, 19), _rotr64(*w14, 61), _shr64(*w14, 6))
+        w16 = _add64(*sig1, *w9)
+        w16 = _add64(*w16, *sig0)
+        w16 = _add64(*w16, wh[..., 0], wl[..., 0])
+        wh = jnp.concatenate([wh[..., 1:], w16[0][..., None]], axis=-1)
+        wl = jnp.concatenate([wl[..., 1:], w16[1][..., None]], axis=-1)
+        return vh, vl, wh, wl
+
+    vh, vl, _, _ = lax.fori_loop(0, 80, round_fn, (sh, sl, wh, wl))
+    return _add64(sh, sl, vh, vl)
+
+
+def init_state(batch_shape: tuple[int, ...] = ()):
+    return (
+        jnp.broadcast_to(jnp.asarray(_H0H), batch_shape + (8,)),
+        jnp.broadcast_to(jnp.asarray(_H0L), batch_shape + (8,)),
+    )
+
+
+def _pad(data: jax.Array, prefix_blocks: int = 0) -> jax.Array:
+    msg_len = data.shape[-1]
+    total_bits = (prefix_blocks * 128 + msg_len) * 8
+    pad_len = (111 - msg_len) % 128 + 17
+    tail = np.zeros(pad_len, dtype=np.uint8)
+    tail[0] = 0x80
+    tail[-8:] = np.frombuffer(np.uint64(total_bits).byteswap().tobytes(), np.uint8)
+    tail_b = jnp.broadcast_to(jnp.asarray(tail), data.shape[:-1] + (pad_len,))
+    return jnp.concatenate([data, tail_b], axis=-1)
+
+
+def _absorb(state, padded: jax.Array):
+    for i in range(padded.shape[-1] // 128):
+        state = compress(state, padded[..., i * 128 : (i + 1) * 128])
+    return state
+
+
+def _digest(state) -> jax.Array:
+    sh, sl = state
+    parts = []
+    for word in (sh, sl):
+        parts.append(
+            jnp.stack(
+                [(word >> 24) & 0xFF, (word >> 16) & 0xFF, (word >> 8) & 0xFF, word & 0xFF],
+                axis=-1,
+            )
+        )
+    # interleave: for each of 8 words -> hi 4 bytes then lo 4 bytes
+    out = jnp.concatenate(parts, axis=-1).astype(jnp.uint8)  # (..., 8, 8)
+    return out.reshape(out.shape[:-2] + (-1,))
+
+
+def sha512(data: jax.Array) -> jax.Array:
+    """(..., L) uint8 -> (..., 64) uint8; L static."""
+    data = jnp.asarray(data, jnp.uint8)
+    state = init_state(data.shape[:-1])
+    return _digest(_absorb(state, _pad(data)))
+
+
+def midstate(prefix: jax.Array):
+    """State after absorbing a (..., 128k) uint8 prefix (no padding)."""
+    prefix = jnp.asarray(prefix, jnp.uint8)
+    if prefix.shape[-1] % 128:
+        raise ValueError("midstate prefix must be a multiple of 128 bytes")
+    return _absorb(init_state(prefix.shape[:-1]), prefix)
+
+
+def sha512_from_midstate(state, data: jax.Array, prefix_blocks: int) -> jax.Array:
+    data = jnp.asarray(data, jnp.uint8)
+    return _digest(_absorb(state, _pad(data, prefix_blocks)))

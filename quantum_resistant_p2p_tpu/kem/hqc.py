@@ -1,0 +1,377 @@
+"""Batched HQC in JAX — quasi-cyclic GF(2) codes on the VPU.
+
+TPU-native design
+-----------------
+HQC is the least matmul-shaped algorithm in the suite (SURVEY.md §7.4 ranks
+it hardest to map); the decomposition here:
+
+* Code vectors live as dense (batch, n) uint8 bit arrays — no 64-bit packing
+  (TPUs have no 64-bit lanes and XLA vectorises byte lanes fine).  The
+  sparse-by-dense cyclic product x^p * a mod (x^n - 1) is a gather with
+  rotated indices; a fixed-weight product is a ``fori_loop`` of w <= 149 such
+  gathers accumulated in int32 and reduced mod 2.
+* The inner RM(1,7) decoder is a batched fast Hadamard transform (7 static
+  butterfly stages) over soft-combined duplicates — exactly the
+  structure TPUs like.
+* The outer Reed-Solomon decoder runs entirely in-graph: syndrome evaluation
+  and Chien search are GF(256) table lookups (log/exp gathers) contracted
+  over static index grids; Berlekamp-Massey is a 2*delta-step scan with
+  masked (branch-free) L/b/m updates.
+* Fisher-Yates fixed-weight sampling follows the same downward-scan dedup as
+  the oracle (sequential fori_loop over w slots, vectorised compares).
+
+Bit-exactness oracle: ``pyref.hqc_ref`` — see that module's compatibility
+note: with liboqs stripped from the reference checkout, the PRNG seam is this
+framework's own; cpu and tpu backends are bit-exact against each other.
+Replaces (reference): HQCKeyExchange (crypto/key_exchange.py:189-309).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import keccak
+from ..pyref.hqc_ref import (
+    _GF_EXP,
+    _GF_LOG,
+    _RM_ENC_TABLE,
+    RM_N,
+    HQCParams,
+    PARAMS,
+    _rs_gen_poly,
+)
+
+_EXP = np.asarray(_GF_EXP, dtype=np.int32)  # length 512
+_LOG = np.asarray(_GF_LOG, dtype=np.int32)
+
+# RM(1,7) encode table as a (256, 128) bit matrix
+_RM_BITS = np.array(
+    [[(cw >> j) & 1 for j in range(RM_N)] for cw in _RM_ENC_TABLE], dtype=np.int32
+)
+
+
+def _gf_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    exp = jnp.asarray(_EXP)
+    log = jnp.asarray(_LOG)
+    prod = jnp.take(exp, jnp.take(log, a) + jnp.take(log, b))
+    return jnp.where((a == 0) | (b == 0), 0, prod)
+
+
+def _xor_reduce(x: jax.Array, axis: int) -> jax.Array:
+    return lax.reduce(x, np.int32(0), lax.bitwise_xor, (axis % x.ndim,))
+
+
+# -- bit/byte helpers ---------------------------------------------------------
+
+
+def _bytes_to_bits(b: jax.Array, nbits: int) -> jax.Array:
+    bits = (b[..., :, None].astype(jnp.int32) >> np.arange(8)) & 1
+    return bits.reshape(b.shape[:-1] + (-1,))[..., :nbits].astype(jnp.uint8)
+
+
+def _bits_to_bytes(bits: jax.Array) -> jax.Array:
+    nbits = bits.shape[-1]
+    pad = (-nbits) % 8
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (pad,), bits.dtype)], axis=-1
+        )
+    grp = bits.reshape(bits.shape[:-1] + (-1, 8)).astype(jnp.int32)
+    return jnp.sum(grp << np.arange(8), axis=-1).astype(jnp.uint8)
+
+
+# -- sampling -----------------------------------------------------------------
+
+
+def _prng_u32s(seed: jax.Array, count: int, domain: int) -> jax.Array:
+    dom = jnp.broadcast_to(jnp.uint8(domain), seed.shape[:-1] + (1,))
+    buf = keccak.shake256(jnp.concatenate([seed, dom], axis=-1), 4 * count)
+    b = buf.astype(jnp.uint32).reshape(buf.shape[:-1] + (count, 4))
+    return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+
+
+def _sample_fixed_weight_support(p: HQCParams, seed: jax.Array, weight: int,
+                                 domain: int) -> jax.Array:
+    """-> (batch, weight) int32 distinct positions (oracle-identical dedup)."""
+    rand = _prng_u32s(seed, weight, domain)  # uint32
+    mod = jnp.asarray(np.arange(weight), jnp.uint32)
+    sup = (jnp.arange(weight, dtype=jnp.uint32) + rand % (p.n - mod)).astype(jnp.int32)
+
+    idx = jnp.arange(weight)
+
+    def fix(k, s):
+        i = weight - 1 - k
+        si = jnp.take_along_axis(s, jnp.full(s.shape[:-1] + (1,), i), axis=-1)
+        clash = jnp.any((s == si) & (idx > i), axis=-1, keepdims=True)
+        si_new = jnp.where(clash, i, si)
+        return jnp.put_along_axis(
+            s, jnp.full(s.shape[:-1] + (1,), i), si_new, axis=-1, inplace=False
+        )
+
+    return lax.fori_loop(0, weight, fix, sup)
+
+
+def _support_to_bits(p: HQCParams, sup: jax.Array) -> jax.Array:
+    """(batch, w) positions -> (batch, n) uint8 bits."""
+    v = jnp.zeros(sup.shape[:-1] + (p.n,), jnp.uint8)
+    return jnp.put_along_axis(v, sup, jnp.uint8(1), axis=-1, inplace=False)
+
+
+def _sample_random_bits(p: HQCParams, seed: jax.Array, domain: int) -> jax.Array:
+    dom = jnp.broadcast_to(jnp.uint8(domain), seed.shape[:-1] + (1,))
+    buf = keccak.shake256(jnp.concatenate([seed, dom], axis=-1), p.n_bytes)
+    return _bytes_to_bits(buf, p.n)
+
+
+# -- cyclic arithmetic --------------------------------------------------------
+
+
+def _cyclic_mul_sparse(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:
+    """dense (batch, n) bits x support (batch, w) -> (batch, n) bits.
+
+    out[i] = XOR_k dense[(i - p_k) mod n]: one rotated gather per support
+    element, accumulated in int32, parity at the end.
+    """
+    n = p.n
+    w = sup.shape[-1]
+    base = jnp.arange(n)
+
+    def step(k, acc):
+        pk = jnp.take_along_axis(sup, jnp.full(sup.shape[:-1] + (1,), k), axis=-1)
+        idx = (base - pk) % n
+        return acc + jnp.take_along_axis(dense.astype(jnp.int32), idx, axis=-1)
+
+    acc = lax.fori_loop(0, w, step, jnp.zeros(dense.shape, jnp.int32))
+    return (acc & 1).astype(jnp.uint8)
+
+
+# -- Reed-Solomon over GF(2^8), in-graph --------------------------------------
+
+
+def _rs_encode(p: HQCParams, msg: jax.Array) -> jax.Array:
+    """(batch, k) int32 bytes -> (batch, n1) codeword."""
+    g = jnp.asarray(np.asarray(_rs_gen_poly(p)[: 2 * p.delta], np.int32))
+    red = 2 * p.delta
+    rem0 = jnp.zeros(msg.shape[:-1] + (red,), jnp.int32)
+
+    def step(j, rem):
+        byte = jnp.take_along_axis(
+            msg, jnp.full(msg.shape[:-1] + (1,), p.k - 1 - j), axis=-1
+        )[..., 0]
+        coef = byte ^ rem[..., -1]
+        rem = jnp.concatenate([jnp.zeros_like(rem[..., :1]), rem[..., :-1]], axis=-1)
+        return rem ^ _gf_mul(g, coef[..., None])
+
+    rem = lax.fori_loop(0, p.k, step, rem0)
+    return jnp.concatenate([rem, msg], axis=-1)
+
+
+def _rs_syndromes(p: HQCParams, cw: jax.Array) -> jax.Array:
+    red = 2 * p.delta
+    ij = np.outer(np.arange(1, red + 1), np.arange(p.n1)) % 255
+    alpha_ij = jnp.asarray(_EXP[ij])  # (red, n1)
+    terms = _gf_mul(cw[..., None, :], jnp.broadcast_to(alpha_ij, cw.shape[:-1] + (red, p.n1)))
+    return _xor_reduce(terms, -1)  # (batch, red)
+
+
+def _rs_bm(p: HQCParams, synd: jax.Array) -> jax.Array:
+    """Branch-free Berlekamp-Massey -> sigma (batch, red+1) int32."""
+    red = 2 * p.delta
+    batch = synd.shape[:-1]
+    deg = red + 1
+    sigma0 = jnp.zeros(batch + (deg,), jnp.int32).at[..., 0].set(1)
+    b0 = sigma0
+    state = (sigma0, b0, jnp.zeros(batch, jnp.int32), jnp.ones(batch, jnp.int32),
+             jnp.ones(batch, jnp.int32))  # sigma, b, L, bb, m
+
+    spad = jnp.concatenate([jnp.zeros(batch + (deg,), jnp.int32), synd], axis=-1)
+
+    def step(n_it, st):
+        sigma, b, L, bb, m = st
+        # d = XOR_i sigma[i] * S[n_it - i]  (S index via padded gather)
+        sidx = (deg + n_it) - jnp.arange(deg)  # positions in spad
+        s_slice = jnp.take(spad, sidx, axis=-1) if spad.ndim == 1 else jnp.take_along_axis(
+            spad, jnp.broadcast_to(sidx, batch + (deg,)), axis=-1
+        )
+        d = _xor_reduce(_gf_mul(sigma, s_slice), -1)
+        dz = d == 0
+        inv_bb = jnp.take(jnp.asarray(_EXP), (255 - jnp.take(jnp.asarray(_LOG), bb)) % 255)
+        coef = _gf_mul(d, inv_bb)
+        # shifted = x^m * b  (gather with negative-index mask)
+        tgt = jnp.arange(deg) - m[..., None]
+        shifted = jnp.where(
+            tgt >= 0,
+            jnp.take_along_axis(b, jnp.maximum(tgt, 0), axis=-1),
+            0,
+        )
+        sigma_new = sigma ^ _gf_mul(coef[..., None], shifted)
+        grow = (~dz) & (2 * L <= n_it)
+        sigma_out = jnp.where(dz[..., None], sigma, sigma_new)
+        b_out = jnp.where(grow[..., None], sigma, b)
+        L_out = jnp.where(grow, n_it + 1 - L, L)
+        bb_out = jnp.where(grow, d, bb)
+        m_out = jnp.where(grow, 1, m + 1)
+        return sigma_out, b_out, L_out, bb_out, m_out
+
+    sigma, *_ = lax.fori_loop(0, red, step, state)
+    return sigma
+
+
+def _rs_decode(p: HQCParams, cw: jax.Array) -> jax.Array:
+    """(batch, n1) int32 -> (batch, k) message bytes (corrects <= delta errors)."""
+    red = 2 * p.delta
+    deg = red + 1
+    synd = _rs_syndromes(p, cw)
+    sigma = _rs_bm(p, synd)
+    # Chien over all positions: sigma(alpha^{-j})
+    ij = np.outer(np.arange(deg), (255 - np.arange(p.n1)) % 255) % 255
+    xpow = jnp.asarray(_EXP[ij])  # (deg, n1): (alpha^{-j})^i
+    ev = _xor_reduce(_gf_mul(sigma[..., :, None], xpow), -2)  # (batch, n1)
+    is_err = ev == 0
+    # omega = S(x) * sigma(x) mod x^red, one static contraction per degree
+    omega = []
+    for i in range(red):
+        terms = []
+        for j in range(min(i + 1, deg)):
+            terms.append((j, i - j))
+        idx_sig = np.array([t[0] for t in terms])
+        idx_s = np.array([t[1] for t in terms])
+        prod = _gf_mul(sigma[..., idx_sig], synd[..., idx_s])
+        omega.append(_xor_reduce(prod, -1))
+    omega = jnp.stack(omega, axis=-1)  # (batch, red)
+    # Forney at every position (masked by is_err): num = omega(alpha^{-j})
+    ijo = np.outer(np.arange(red), (255 - np.arange(p.n1)) % 255) % 255
+    xpo = jnp.asarray(_EXP[ijo])  # (red, n1)
+    num = _xor_reduce(_gf_mul(omega[..., :, None], xpo), -2)
+    # den = sigma'(alpha^{-j}) = sum over odd i of sigma[i] (alpha^{-j})^{i-1}
+    odd = np.arange(1, deg, 2)
+    ijd = np.outer(odd - 1, (255 - np.arange(p.n1)) % 255) % 255
+    xpd = jnp.asarray(_EXP[ijd])  # (len(odd), n1)
+    den = _xor_reduce(_gf_mul(sigma[..., odd, None], xpd), -2)
+    log = jnp.asarray(_LOG)
+    exp = jnp.asarray(_EXP)
+    inv_den = jnp.where(den == 0, 0, jnp.take(exp, (255 - jnp.take(log, den)) % 255))
+    mag = _gf_mul(num, inv_den)
+    corrected = cw ^ jnp.where(is_err & (den != 0), mag, 0)
+    return corrected[..., red:]
+
+
+# -- duplicated RM(1,7) -------------------------------------------------------
+
+
+def _rm_encode(p: HQCParams, rs_cw: jax.Array) -> jax.Array:
+    """(batch, n1) bytes -> (batch, n1*n2) bits."""
+    table = jnp.asarray(_RM_BITS, jnp.uint8)
+    cw = jnp.take(table, rs_cw, axis=0)  # (batch, n1, 128)
+    dup = jnp.repeat(cw[..., None, :], p.dup, axis=-2)  # (batch, n1, dup, 128)
+    return dup.reshape(rs_cw.shape[:-1] + (p.n1 * p.n2,))
+
+
+def _rm_decode(p: HQCParams, bits: jax.Array) -> jax.Array:
+    """(batch, n1*n2) bits -> (batch, n1) decoded bytes (soft FHT)."""
+    x = bits.reshape(bits.shape[:-1] + (p.n1, p.dup, RM_N)).astype(jnp.int32)
+    f = jnp.sum(1 - 2 * x, axis=-2)  # (batch, n1, 128) soft counts
+    h = 1
+    while h < RM_N:
+        fr = f.reshape(f.shape[:-1] + (RM_N // (2 * h), 2, h))
+        a, b = fr[..., 0, :], fr[..., 1, :]
+        f = jnp.stack([a + b, a - b], axis=-2).reshape(f.shape)
+        h *= 2
+    best = jnp.argmax(jnp.abs(f), axis=-1)  # (batch, n1)
+    fbest = jnp.take_along_axis(f, best[..., None], axis=-1)[..., 0]
+    b0 = (fbest < 0).astype(jnp.int32)
+    return (best << 1) | b0
+
+
+# -- hashes -------------------------------------------------------------------
+
+
+def _hash_dom(data: jax.Array, domain: int, out_len: int = 64) -> jax.Array:
+    pfx = jnp.broadcast_to(jnp.uint8(domain), data.shape[:-1] + (1,))
+    return keccak.shake256(jnp.concatenate([pfx, data], axis=-1), out_len)
+
+
+# -- KEM ----------------------------------------------------------------------
+
+
+def keygen(p: HQCParams, sk_seed: jax.Array, sigma: jax.Array, pk_seed: jax.Array):
+    """sk_seed (..., 40), sigma (..., k), pk_seed (..., 40) -> (pk, sk)."""
+    sk_seed = jnp.asarray(sk_seed, jnp.uint8)
+    sigma = jnp.asarray(sigma, jnp.uint8)
+    pk_seed = jnp.asarray(pk_seed, jnp.uint8)
+    h = _sample_random_bits(p, pk_seed, 0)
+    x_sup = _sample_fixed_weight_support(p, sk_seed, p.w, 1)
+    y_sup = _sample_fixed_weight_support(p, sk_seed, p.w, 2)
+    x = _support_to_bits(p, x_sup)
+    s = x ^ _cyclic_mul_sparse(p, h, y_sup)
+    pk = jnp.concatenate([pk_seed, _bits_to_bytes(s)], axis=-1)
+    sk = jnp.concatenate([sk_seed, sigma, pk], axis=-1)
+    return pk, sk
+
+
+def _encrypt(p: HQCParams, pk: jax.Array, m: jax.Array, theta: jax.Array):
+    pk_seed = pk[..., :40]
+    s = _bytes_to_bits(pk[..., 40:], p.n)
+    h = _sample_random_bits(p, pk_seed, 0)
+    r1_sup = _sample_fixed_weight_support(p, theta, p.wr, 3)
+    r2_sup = _sample_fixed_weight_support(p, theta, p.wr, 4)
+    e_sup = _sample_fixed_weight_support(p, theta, p.wr, 5)
+    u = _support_to_bits(p, r1_sup) ^ _cyclic_mul_sparse(p, h, r2_sup)
+    code = _rm_encode(p, _rs_encode(p, m.astype(jnp.int32)))
+    t = _cyclic_mul_sparse(p, s, r2_sup) ^ _support_to_bits(p, e_sup)
+    v = code ^ t[..., : p.n1 * p.n2]
+    return u, v
+
+
+def encaps(p: HQCParams, pk: jax.Array, m: jax.Array, salt: jax.Array):
+    """pk, m (..., k), salt (..., 16) -> (ct (..., ct_len), ss (..., 64))."""
+    pk = jnp.asarray(pk, jnp.uint8)
+    m = jnp.asarray(m, jnp.uint8)
+    salt = jnp.asarray(salt, jnp.uint8)
+    theta = _hash_dom(jnp.concatenate([m, pk[..., :32], salt], axis=-1), 3)
+    u, v = _encrypt(p, pk, m, theta)
+    u_b = _bits_to_bytes(u)
+    v_b = _bits_to_bytes(v)
+    ct = jnp.concatenate([u_b, v_b, salt], axis=-1)
+    ss = _hash_dom(jnp.concatenate([m, u_b, v_b], axis=-1), 4)
+    return ct, ss
+
+
+def decaps(p: HQCParams, sk: jax.Array, ct: jax.Array):
+    sk = jnp.asarray(sk, jnp.uint8)
+    ct = jnp.asarray(ct, jnp.uint8)
+    sk_seed = sk[..., :40]
+    sigma = sk[..., 40 : 40 + p.k]
+    pk = sk[..., 40 + p.k :]
+    u_b = ct[..., : p.n_bytes]
+    v_b = ct[..., p.n_bytes : p.n_bytes + p.n1n2_bytes]
+    salt = ct[..., p.n_bytes + p.n1n2_bytes :]
+    u = _bytes_to_bits(u_b, p.n)
+    v = _bytes_to_bits(v_b, p.n1 * p.n2)
+    y_sup = _sample_fixed_weight_support(p, sk_seed, p.w, 2)
+    uy = _cyclic_mul_sparse(p, u, y_sup)
+    m_p = _rs_decode(p, _rm_decode(p, v ^ uy[..., : p.n1 * p.n2])).astype(jnp.uint8)
+    theta_p = _hash_dom(jnp.concatenate([m_p, pk[..., :32], salt], axis=-1), 3)
+    u2, v2 = _encrypt(p, pk, m_p, theta_p)
+    ok = jnp.all(_bits_to_bytes(u2) == u_b, axis=-1) & jnp.all(
+        _bits_to_bytes(v2) == v_b, axis=-1
+    )
+    good = _hash_dom(jnp.concatenate([m_p, u_b, v_b], axis=-1), 4)
+    bad = _hash_dom(jnp.concatenate([sigma, u_b, v_b], axis=-1), 4)
+    return jnp.where(ok[..., None], good, bad)
+
+
+@functools.cache
+def get(name: str):
+    """Jitted (keygen, encaps, decaps) triple for a parameter-set name."""
+    p = PARAMS[name]
+    return (
+        jax.jit(functools.partial(keygen, p)),
+        jax.jit(functools.partial(encaps, p)),
+        jax.jit(functools.partial(decaps, p)),
+    )

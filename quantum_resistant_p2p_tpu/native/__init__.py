@@ -1,0 +1,141 @@
+"""ctypes loader for the C++ host crypto core (native/qrp_native.cpp).
+
+Fills the role liboqs plays for the reference app (vendored .so loaded via
+ctypes, reference vendor/__init__.py:12-57 + vendor/oqs.py:122-183): a native
+CPU fast path for Keccak and ML-KEM, compiled on demand with g++ (pybind11 is
+not available in this environment; plain extern "C" + ctypes is the binding).
+
+``load()`` returns None when no compiler/library is available — callers fall
+back to the pure-Python pyref implementations, which remain the oracles.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "qrp_native.cpp"
+_CACHE_DIR = Path(
+    os.environ.get("QRP_NATIVE_CACHE", Path.home() / ".cache" / "qrp2p_tpu")
+)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> Path | None:
+    _CACHE_DIR.mkdir(parents=True, exist_ok=True)
+    so = _CACHE_DIR / "libqrp_native.so"
+    if so.exists() and so.stat().st_mtime >= _SRC.stat().st_mtime:
+        return so
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", str(so), str(_SRC)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return so
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native build failed (falling back to pure Python): %s", e)
+        return None
+
+
+def load() -> ctypes.CDLL | None:
+    """Build-if-needed and load the native library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _SRC.exists():
+            return None
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(str(so))
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        for name, argtypes in (
+            ("qrp_shake128", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]),
+            ("qrp_shake256", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]),
+            ("qrp_sha3_256", [u8p, ctypes.c_size_t, u8p]),
+            ("qrp_sha3_512", [u8p, ctypes.c_size_t, u8p]),
+            ("qrp_zeroize", [u8p, ctypes.c_size_t]),
+            ("qrp_mlkem_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p]),
+            ("qrp_mlkem_encaps", [ctypes.c_int, u8p, u8p, u8p, u8p]),
+            ("qrp_mlkem_decaps", [ctypes.c_int, u8p, u8p, u8p]),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = None
+        lib.qrp_version.restype = ctypes.c_int
+        _lib = lib
+        logger.info("loaded native crypto core v%d from %s", lib.qrp_version(), so)
+        return _lib
+
+
+def _buf(data: bytes):
+    return (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+
+
+def _out(n: int):
+    return (ctypes.c_uint8 * n)()
+
+
+class NativeMLKEM:
+    """Scalar ML-KEM over the native core (same seams as pyref.mlkem_ref)."""
+
+    _K = {"ML-KEM-512": 2, "ML-KEM-768": 3, "ML-KEM-1024": 4}
+
+    def __init__(self, name: str):
+        self.lib = load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable")
+        self.k = self._K[name]
+        self.ek_len = 384 * self.k + 32
+        self.dk_len = 768 * self.k + 96
+        du, dv = (10, 4) if self.k < 4 else (11, 5)
+        self.ct_len = 32 * (du * self.k + dv)
+
+    def keygen(self, d: bytes, z: bytes) -> tuple[bytes, bytes]:
+        ek, dk = _out(self.ek_len), _out(self.dk_len)
+        self.lib.qrp_mlkem_keygen(self.k, _buf(d), _buf(z), ek, dk)
+        return bytes(ek), bytes(dk)
+
+    def encaps(self, ek: bytes, m: bytes) -> tuple[bytes, bytes]:
+        key, ct = _out(32), _out(self.ct_len)
+        self.lib.qrp_mlkem_encaps(self.k, _buf(ek), _buf(m), key, ct)
+        return bytes(key), bytes(ct)
+
+    def decaps(self, dk: bytes, ct: bytes) -> bytes:
+        key = _out(32)
+        self.lib.qrp_mlkem_decaps(self.k, _buf(dk), _buf(ct), key)
+        return bytes(key)
+
+
+def shake256(data: bytes, out_len: int) -> bytes:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native core unavailable")
+    out = _out(out_len)
+    lib.qrp_shake256(_buf(data), len(data), out, out_len)
+    return bytes(out)
+
+
+def zeroize(buf: bytearray) -> None:
+    """Best-effort secure wipe of a mutable buffer (reference analog:
+    OQS_MEM_cleanse via vendor/oqs.py:383-390)."""
+    lib = load()
+    if lib is None:
+        for i in range(len(buf)):
+            buf[i] = 0
+        return
+    c = (ctypes.c_uint8 * len(buf)).from_buffer(buf)
+    lib.qrp_zeroize(c, len(buf))

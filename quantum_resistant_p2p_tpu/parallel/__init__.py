@@ -1,0 +1,16 @@
+"""Multi-chip scale-out: device mesh + sharded batch crypto ops.
+
+The reference has no device parallelism (SURVEY.md §2.3) — each KEM/signature
+op is one serial FFI call into liboqs (reference: crypto/key_exchange.py:155).
+Here the batch axis is the scaling axis: independent handshakes shard across
+chips over ICI with `jax.sharding.NamedSharding`, and only tiny collectives
+(psum of success counts) cross chips.
+"""
+
+from .mesh import (  # noqa: F401
+    BATCH_AXIS,
+    handshake_step,
+    make_mesh,
+    make_sharded_handshake,
+    shard_batch,
+)

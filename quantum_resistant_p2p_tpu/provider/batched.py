@@ -1,0 +1,274 @@
+"""Async batching queue — the host<->TPU boundary (the north-star refactor).
+
+The reference performs one blocking liboqs FFI call per handshake op
+(crypto/key_exchange.py:155,178).  Here, concurrent handshakes enqueue their
+crypto ops as futures; a flusher collects them into one padded batch and
+dispatches a single jitted TPU program, then resolves every future.  Flush
+policy: immediately at ``max_batch``, otherwise ``max_wait_ms`` after the
+first enqueue — bounding added p50 latency while amortising dispatch overhead
+(SURVEY.md §7.4 item 6).
+
+The dispatch itself runs in a worker thread (``run_in_executor``) so the
+asyncio loop — which is also serving TCP peers (net.p2p_node) — never blocks
+on device compute.
+
+Wrapper classes expose the same op names as the plugin boundary
+(KeyExchangeAlgorithm / SignatureAlgorithm, provider.base) but as coroutines;
+``SecureMessaging`` awaits them on its handshake path (app/messaging.py here;
+reference flow app/messaging.py:546-1134).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from .base import KeyExchangeAlgorithm, SignatureAlgorithm
+
+
+@dataclass
+class QueueStats:
+    """Per-op-queue counters (surfaced in metrics; SURVEY.md §5 tracing gap)."""
+
+    ops: int = 0
+    flushes: int = 0
+    max_batch_seen: int = 0
+    total_wait_s: float = 0.0
+    total_dispatch_s: float = 0.0
+    #: per-flush batch sizes, most recent last (bounded)
+    batch_sizes: list[int] = field(default_factory=list)
+    BATCH_SIZE_HISTORY = 1024
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "ops": self.ops,
+            "flushes": self.flushes,
+            "max_batch_seen": self.max_batch_seen,
+            "avg_batch": (self.ops / self.flushes) if self.flushes else 0.0,
+            "avg_dispatch_ms": (
+                1e3 * self.total_dispatch_s / self.flushes if self.flushes else 0.0
+            ),
+        }
+
+
+class OpQueue:
+    """Accumulates (item -> future) pairs; flushes through a batch function.
+
+    ``batch_fn(items) -> list[results]`` is called with at most ``max_batch``
+    items, inside the default executor.
+    """
+
+    def __init__(
+        self,
+        batch_fn: Callable[[list[Any]], list[Any]],
+        max_batch: int = 4096,
+        max_wait_ms: float = 2.0,
+    ):
+        self.batch_fn = batch_fn
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self.stats = QueueStats()
+        self._items: list[Any] = []
+        self._futures: list[asyncio.Future] = []
+        self._timer: asyncio.TimerHandle | None = None
+        self._first_enqueue_t = 0.0
+
+    async def submit(self, item: Any) -> Any:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._items.append(item)
+        self._futures.append(fut)
+        self.stats.ops += 1
+        if len(self._items) == 1:
+            self._first_enqueue_t = time.perf_counter()
+            self._timer = loop.call_later(self.max_wait_s, self._flush_soon)
+        if len(self._items) >= self.max_batch:
+            self._flush_soon()
+        return await fut
+
+    def _flush_soon(self) -> None:
+        """Detach pending items synchronously (so late submits can't bloat a
+        batch past max_batch) and dispatch them as a task."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        loop = asyncio.get_running_loop()
+        while self._items:
+            items = self._items[: self.max_batch]
+            futs = self._futures[: self.max_batch]
+            del self._items[: self.max_batch]
+            del self._futures[: self.max_batch]
+            loop.create_task(self._dispatch(items, futs, self._first_enqueue_t))
+
+    async def _dispatch(self, items: list[Any], futs: list[asyncio.Future],
+                        first_t: float) -> None:
+        self.stats.flushes += 1
+        self.stats.max_batch_seen = max(self.stats.max_batch_seen, len(items))
+        self.stats.batch_sizes.append(len(items))
+        del self.stats.batch_sizes[: -QueueStats.BATCH_SIZE_HISTORY]
+        self.stats.total_wait_s += time.perf_counter() - first_t
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(None, self.batch_fn, items)
+            self.stats.total_dispatch_s += time.perf_counter() - t0
+            for f, r in zip(futs, results):
+                if f.cancelled():
+                    continue
+                # batch fns report per-item failures as Exception instances so
+                # one bad item doesn't poison its batch mates
+                if isinstance(r, Exception):
+                    f.set_exception(r)
+                else:
+                    f.set_result(r)
+        except Exception as exc:  # propagate to every waiter
+            for f in futs:
+                if not f.cancelled():
+                    f.set_exception(exc)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+def _pad_rows(rows: np.ndarray, target: int) -> np.ndarray:
+    """Pad the batch dim to ``target`` by repeating the last row.
+
+    Device batches are padded to power-of-two buckets so XLA compiles at most
+    log2(max_batch) program variants per op instead of one per batch size —
+    without this, a cold queue spends tens of seconds per novel size.
+    """
+    n = rows.shape[0]
+    if n == target:
+        return rows
+    pad = np.broadcast_to(rows[-1:], (target - n,) + rows.shape[1:])
+    return np.concatenate([rows, pad], axis=0)
+
+
+class BatchedKEM:
+    """Async facade over a KeyExchangeAlgorithm's batch ops."""
+
+    def __init__(self, algo: KeyExchangeAlgorithm, max_batch: int = 4096,
+                 max_wait_ms: float = 2.0):
+        self.algo = algo
+        self.name = algo.name
+        self._kg = OpQueue(self._kg_batch, max_batch, max_wait_ms)
+        self._enc = OpQueue(self._enc_batch, max_batch, max_wait_ms)
+        self._dec = OpQueue(self._dec_batch, max_batch, max_wait_ms)
+
+    def _kg_batch(self, items: list[None]) -> list[tuple[bytes, bytes]]:
+        n = len(items)
+        pks, sks = self.algo.generate_keypair_batch(_next_pow2(n))
+        return [(bytes(pk), bytes(sk)) for pk, sk in zip(pks[:n], sks[:n])]
+
+    def _enc_batch(self, items: list[bytes]):
+        valid_idx = [i for i, pk in enumerate(items)
+                     if len(pk) == self.algo.public_key_len]
+        results: list = [ValueError("bad public-key length") for _ in items]
+        if valid_idx:
+            tgt = _next_pow2(len(valid_idx))
+            pks = _pad_rows(
+                np.stack([np.frombuffer(items[i], np.uint8) for i in valid_idx]), tgt
+            )
+            cts, sss = self.algo.encapsulate_batch(pks)
+            for j, i in enumerate(valid_idx):
+                results[i] = (bytes(cts[j]), bytes(sss[j]))
+        return results
+
+    def _dec_batch(self, items: list[tuple[bytes, bytes]]):
+        # Per-item length validation BEFORE stacking: one attacker-supplied
+        # ragged ciphertext must not poison the whole batch (np.stack raises
+        # batch-wide otherwise).  Invalid items get their own error result.
+        valid_idx = [
+            i for i, (sk, ct) in enumerate(items)
+            if len(sk) == self.algo.secret_key_len and len(ct) == self.algo.ciphertext_len
+        ]
+        results: list = [
+            ValueError("bad secret-key/ciphertext length") for _ in items
+        ]
+        if valid_idx:
+            tgt = _next_pow2(len(valid_idx))
+            sks = _pad_rows(
+                np.stack([np.frombuffer(items[i][0], np.uint8) for i in valid_idx]), tgt
+            )
+            cts = _pad_rows(
+                np.stack([np.frombuffer(items[i][1], np.uint8) for i in valid_idx]), tgt
+            )
+            sss = self.algo.decapsulate_batch(sks, cts)
+            for j, i in enumerate(valid_idx):
+                results[i] = bytes(sss[j])
+        return results
+
+    async def generate_keypair(self) -> tuple[bytes, bytes]:
+        return await self._kg.submit(None)
+
+    async def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
+        return await self._enc.submit(public_key)
+
+    async def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
+        return await self._dec.submit((secret_key, ciphertext))
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "keygen": self._kg.stats.as_dict(),
+            "encaps": self._enc.stats.as_dict(),
+            "decaps": self._dec.stats.as_dict(),
+        }
+
+
+class BatchedSignature:
+    """Async facade over a SignatureAlgorithm's batch ops."""
+
+    def __init__(self, algo: SignatureAlgorithm, max_batch: int = 4096,
+                 max_wait_ms: float = 2.0):
+        self.algo = algo
+        self.name = algo.name
+        self._sign = OpQueue(self._sign_batch, max_batch, max_wait_ms)
+        self._verify = OpQueue(self._verify_batch, max_batch, max_wait_ms)
+
+    def _sign_batch(self, items: list[tuple[bytes, bytes]]) -> list[bytes]:
+        n = len(items)
+        tgt = _next_pow2(n)
+        sks = _pad_rows(np.stack([np.frombuffer(sk, np.uint8) for sk, _ in items]), tgt)
+        msgs = [m for _, m in items] + [items[-1][1]] * (tgt - n)
+        return self.algo.sign_batch(sks, msgs)[:n]
+
+    def _verify_batch(self, items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
+        # Per the verify contract, malformed input means False — never raise —
+        # and must not poison batch mates with a ragged np.stack.
+        valid_idx = [
+            i for i, (pk, _, s) in enumerate(items)
+            if len(pk) == self.algo.public_key_len and len(s) == self.algo.signature_len
+        ]
+        results = [False] * len(items)
+        if valid_idx:
+            tgt = _next_pow2(len(valid_idx))
+            pks = _pad_rows(
+                np.stack([np.frombuffer(items[i][0], np.uint8) for i in valid_idx]), tgt
+            )
+            last = items[valid_idx[-1]]
+            msgs = [items[i][1] for i in valid_idx] + [last[1]] * (tgt - len(valid_idx))
+            sigs = [items[i][2] for i in valid_idx] + [last[2]] * (tgt - len(valid_idx))
+            try:
+                oks = self.algo.verify_batch(pks, msgs, sigs)
+            except Exception:
+                oks = [False] * tgt
+            for j, i in enumerate(valid_idx):
+                results[i] = bool(oks[j])
+        return results
+
+    async def sign(self, secret_key: bytes, message: bytes) -> bytes:
+        return await self._sign.submit((secret_key, message))
+
+    async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        return await self._verify.submit((public_key, message, signature))
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "sign": self._sign.stats.as_dict(),
+            "verify": self._verify.stats.as_dict(),
+        }

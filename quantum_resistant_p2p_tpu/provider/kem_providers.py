@@ -18,10 +18,19 @@ import os
 
 import numpy as np
 
-from ..pyref import mlkem_ref
+from ..pyref import frodo_ref, hqc_ref, mlkem_ref
 from .base import KeyExchangeAlgorithm
 
 _LEVEL_TO_MLKEM = {1: mlkem_ref.MLKEM512, 3: mlkem_ref.MLKEM768, 5: mlkem_ref.MLKEM1024}
+
+_LEVEL_TO_FRODO = {
+    (1, True): frodo_ref.FRODO640AES,
+    (1, False): frodo_ref.FRODO640SHAKE,
+    (3, True): frodo_ref.FRODO976AES,
+    (3, False): frodo_ref.FRODO976SHAKE,
+    (5, True): frodo_ref.FRODO1344AES,
+    (5, False): frodo_ref.FRODO1344SHAKE,
+}
 
 
 class MLKEMKeyExchange(KeyExchangeAlgorithm):
@@ -46,6 +55,16 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
             from ..kem import mlkem as _jax_mlkem  # deferred: pulls in jax
 
             self._kg, self._enc, self._dec = _jax_mlkem.get(self.params.name)
+        self._native = None
+        if backend == "cpu":
+            # Native C++ fast path (the role liboqs plays for the reference);
+            # pyref remains the fallback and the oracle.
+            try:
+                from .. import native as _native
+
+                self._native = _native.NativeMLKEM(self.params.name)
+            except Exception:
+                self._native = None
 
     # -- scalar API (batch-of-1 on the tpu backend) -------------------------
 
@@ -71,8 +90,11 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         if self.backend == "tpu":
             ek, dk = self._kg(d, z)
             return np.asarray(ek), np.asarray(dk)
+        impl = self._native if self._native is not None else None
         pairs = [
-            mlkem_ref.keygen(self.params, d[i].tobytes(), z[i].tobytes()) for i in range(n)
+            (impl.keygen(d[i].tobytes(), z[i].tobytes()) if impl
+             else mlkem_ref.keygen(self.params, d[i].tobytes(), z[i].tobytes()))
+            for i in range(n)
         ]
         return (
             np.stack([np.frombuffer(ek, np.uint8) for ek, _ in pairs]),
@@ -85,8 +107,10 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         if self.backend == "tpu":
             key, ct = self._enc(public_keys, m)
             return np.asarray(ct), np.asarray(key)
+        impl = self._native
         outs = [
-            mlkem_ref.encaps(self.params, public_keys[i].tobytes(), m[i].tobytes())
+            (impl.encaps(public_keys[i].tobytes(), m[i].tobytes()) if impl
+             else mlkem_ref.encaps(self.params, public_keys[i].tobytes(), m[i].tobytes()))
             for i in range(n)
         ]
         return (
@@ -97,12 +121,201 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
     def decapsulate_batch(self, secret_keys: np.ndarray, ciphertexts: np.ndarray) -> np.ndarray:
         if self.backend == "tpu":
             return np.asarray(self._dec(secret_keys, ciphertexts))
+        impl = self._native
         return np.stack(
             [
                 np.frombuffer(
-                    mlkem_ref.decaps(
-                        self.params, secret_keys[i].tobytes(), ciphertexts[i].tobytes()
-                    ),
+                    (impl.decaps(secret_keys[i].tobytes(), ciphertexts[i].tobytes())
+                     if impl
+                     else mlkem_ref.decaps(
+                         self.params, secret_keys[i].tobytes(), ciphertexts[i].tobytes()
+                     )),
+                    np.uint8,
+                )
+                for i in range(secret_keys.shape[0])
+            ]
+        )
+
+
+class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
+    """FrodoKEM at NIST level 1, 3 or 5, AES or SHAKE matrix-gen variant.
+
+    Mirrors the reference's FrodoKEMKeyExchange (crypto/key_exchange.py:312-449),
+    including its use_aes flag; BASELINE.json config 3 targets the AES variant.
+    """
+
+    def __init__(self, security_level: int = 1, backend: str = "cpu", use_aes: bool = True):
+        key = (security_level, use_aes)
+        if key not in _LEVEL_TO_FRODO:
+            raise ValueError(f"FrodoKEM level must be 1/3/5, got {security_level}")
+        self.params = _LEVEL_TO_FRODO[key]
+        self.security_level = security_level
+        self.backend = backend
+        self.use_aes = use_aes
+        self.name = self.params.name
+        self.display_name = f"{self.params.name} ({backend})"
+        self.description = (
+            f"Dense-LWE KEM (FrodoKEM round 3), NIST level {security_level}, "
+            f"{'AES' if use_aes else 'SHAKE'} matrix generation, "
+            f"{'batched JAX/TPU (MXU matmul)' if backend == 'tpu' else 'pure-Python CPU'} backend"
+        )
+        self.public_key_len = self.params.pk_len
+        self.secret_key_len = self.params.sk_len
+        self.ciphertext_len = self.params.ct_len
+        self.shared_secret_len = self.params.len_sec
+        if backend == "tpu":
+            from ..kem import frodo as _jax_frodo  # deferred: pulls in jax
+
+            self._kg, self._enc, self._dec = _jax_frodo.get(self.params.name)
+
+    def generate_keypair(self) -> tuple[bytes, bytes]:
+        pk, sk = self.generate_keypair_batch(1)
+        return bytes(pk[0]), bytes(sk[0])
+
+    def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
+        ct, ss = self.encapsulate_batch(np.frombuffer(public_key, np.uint8)[None])
+        return bytes(ct[0]), bytes(ss[0])
+
+    def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
+        sk = np.frombuffer(secret_key, np.uint8)[None]
+        ct = np.frombuffer(ciphertext, np.uint8)[None]
+        return bytes(self.decapsulate_batch(sk, ct)[0])
+
+    def generate_keypair_batch(self, n: int):
+        p = self.params
+        sec = p.len_sec
+        seeds = np.frombuffer(os.urandom(3 * sec * n), np.uint8).reshape(3, n, sec)
+        if self.backend == "tpu":
+            pk, sk = self._kg(seeds[0], seeds[1], seeds[2])
+            return np.asarray(pk), np.asarray(sk)
+        pairs = [
+            frodo_ref.keygen(p, seeds[0, i].tobytes(), seeds[1, i].tobytes(),
+                             seeds[2, i].tobytes())
+            for i in range(n)
+        ]
+        return (
+            np.stack([np.frombuffer(pk, np.uint8) for pk, _ in pairs]),
+            np.stack([np.frombuffer(sk, np.uint8) for _, sk in pairs]),
+        )
+
+    def encapsulate_batch(self, public_keys: np.ndarray):
+        p = self.params
+        n = public_keys.shape[0]
+        mu = np.frombuffer(os.urandom(p.len_sec * n), np.uint8).reshape(n, p.len_sec)
+        if self.backend == "tpu":
+            ct, ss = self._enc(public_keys, mu)
+            return np.asarray(ct), np.asarray(ss)
+        outs = [
+            frodo_ref.encaps(p, public_keys[i].tobytes(), mu[i].tobytes())
+            for i in range(n)
+        ]
+        return (
+            np.stack([np.frombuffer(c, np.uint8) for c, _ in outs]),
+            np.stack([np.frombuffer(s, np.uint8) for _, s in outs]),
+        )
+
+    def decapsulate_batch(self, secret_keys: np.ndarray, ciphertexts: np.ndarray):
+        p = self.params
+        if self.backend == "tpu":
+            return np.asarray(self._dec(secret_keys, ciphertexts))
+        return np.stack(
+            [
+                np.frombuffer(
+                    frodo_ref.decaps(p, secret_keys[i].tobytes(), ciphertexts[i].tobytes()),
+                    np.uint8,
+                )
+                for i in range(secret_keys.shape[0])
+            ]
+        )
+
+
+class HQCKeyExchange(KeyExchangeAlgorithm):
+    """HQC at NIST level 1, 3 or 5.
+
+    Mirrors the reference's HQCKeyExchange (crypto/key_exchange.py:189-309).
+    See pyref.hqc_ref's compatibility note: the PRNG seam is this framework's
+    own (no liboqs binary exists in this environment to KAT against); cpu and
+    tpu backends are bit-exact against each other.
+    """
+
+    def __init__(self, security_level: int = 1, backend: str = "cpu"):
+        levels = {1: hqc_ref.HQC128, 3: hqc_ref.HQC192, 5: hqc_ref.HQC256}
+        if security_level not in levels:
+            raise ValueError(f"HQC level must be 1/3/5, got {security_level}")
+        self.params = levels[security_level]
+        self.security_level = security_level
+        self.backend = backend
+        self.name = self.params.name
+        self.display_name = f"{self.params.name} ({backend})"
+        self.description = (
+            f"Quasi-cyclic code-based KEM (HQC round 4 shape), NIST level "
+            f"{security_level}, "
+            f"{'batched JAX/TPU' if backend == 'tpu' else 'pure-Python CPU'} backend"
+        )
+        self.public_key_len = self.params.pk_len
+        self.secret_key_len = self.params.sk_len
+        self.ciphertext_len = self.params.ct_len
+        self.shared_secret_len = self.params.ss_len
+        if backend == "tpu":
+            from ..kem import hqc as _jax_hqc  # deferred: pulls in jax
+
+            self._kg, self._enc, self._dec = _jax_hqc.get(self.params.name)
+
+    def generate_keypair(self) -> tuple[bytes, bytes]:
+        pk, sk = self.generate_keypair_batch(1)
+        return bytes(pk[0]), bytes(sk[0])
+
+    def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
+        ct, ss = self.encapsulate_batch(np.frombuffer(public_key, np.uint8)[None])
+        return bytes(ct[0]), bytes(ss[0])
+
+    def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
+        sk = np.frombuffer(secret_key, np.uint8)[None]
+        ct = np.frombuffer(ciphertext, np.uint8)[None]
+        return bytes(self.decapsulate_batch(sk, ct)[0])
+
+    def generate_keypair_batch(self, n: int):
+        p = self.params
+        sk_seed = np.frombuffer(os.urandom(40 * n), np.uint8).reshape(n, 40)
+        sigma = np.frombuffer(os.urandom(p.k * n), np.uint8).reshape(n, p.k)
+        pk_seed = np.frombuffer(os.urandom(40 * n), np.uint8).reshape(n, 40)
+        if self.backend == "tpu":
+            pk, sk = self._kg(sk_seed, sigma, pk_seed)
+            return np.asarray(pk), np.asarray(sk)
+        pairs = [
+            hqc_ref.keygen(p, sk_seed[i].tobytes(), sigma[i].tobytes(), pk_seed[i].tobytes())
+            for i in range(n)
+        ]
+        return (
+            np.stack([np.frombuffer(pk, np.uint8) for pk, _ in pairs]),
+            np.stack([np.frombuffer(sk, np.uint8) for _, sk in pairs]),
+        )
+
+    def encapsulate_batch(self, public_keys: np.ndarray):
+        p = self.params
+        n = public_keys.shape[0]
+        m = np.frombuffer(os.urandom(p.k * n), np.uint8).reshape(n, p.k)
+        salt = np.frombuffer(os.urandom(16 * n), np.uint8).reshape(n, 16)
+        if self.backend == "tpu":
+            ct, ss = self._enc(public_keys, m, salt)
+            return np.asarray(ct), np.asarray(ss)
+        outs = [
+            hqc_ref.encaps(p, public_keys[i].tobytes(), m[i].tobytes(), salt[i].tobytes())
+            for i in range(n)
+        ]
+        return (
+            np.stack([np.frombuffer(c, np.uint8) for c, _ in outs]),
+            np.stack([np.frombuffer(s, np.uint8) for _, s in outs]),
+        )
+
+    def decapsulate_batch(self, secret_keys: np.ndarray, ciphertexts: np.ndarray):
+        p = self.params
+        if self.backend == "tpu":
+            return np.asarray(self._dec(secret_keys, ciphertexts))
+        return np.stack(
+            [
+                np.frombuffer(
+                    hqc_ref.decaps(p, secret_keys[i].tobytes(), ciphertexts[i].tobytes()),
                     np.uint8,
                 )
                 for i in range(secret_keys.shape[0])

@@ -5,14 +5,15 @@ back into classes (app/messaging.py:1893-2011).  Here every algorithm has a
 canonical name in an explicit registry; lookups accept canonical names and the
 backend is an orthogonal axis ("cpu" | "tpu" | "auto").
 
-Registered families (target: full parity with the reference's Crypto Settings
-matrix of 9 KEMs x 2 AEADs x 6 signatures, ui/settings_dialog.py:108-172):
+Registered families (full parity with the reference's Crypto Settings matrix
+of 9 KEMs x 2 AEADs x 6 signatures, ui/settings_dialog.py:108-172 — plus the
+AES/SHAKE FrodoKEM split exposed as distinct names):
 
-  KEM:  ML-KEM-512/768/1024        (cpu + tpu)
-        FrodoKEM-640/976/1344-AES  (cpu + tpu)        [pending]
-        HQC-128/192/256            (cpu + tpu)        [pending]
-  SIG:  ML-DSA-44/65/87            (cpu + tpu)        [pending]
-        SPHINCS+-SHA2-128f/192f/256f-simple           [pending]
+  KEM:  ML-KEM-512/768/1024                 (cpu + tpu)
+        FrodoKEM-640/976/1344-{AES,SHAKE}   (cpu + tpu)
+        HQC-128/192/256                     (cpu + tpu)
+  SIG:  ML-DSA-44/65/87                     (cpu + tpu)
+        SPHINCS+-SHA2-128f/192f/256f-simple (cpu + tpu)
   AEAD: AES-256-GCM, ChaCha20-Poly1305 (host)
 """
 
@@ -83,8 +84,8 @@ def list_symmetrics() -> list[str]:
 # -- default registrations ---------------------------------------------------
 
 def _register_defaults() -> None:
-    from .kem_providers import MLKEMKeyExchange
-    from .sig_providers import MLDSASignature
+    from .kem_providers import FrodoKEMKeyExchange, HQCKeyExchange, MLKEMKeyExchange
+    from .sig_providers import MLDSASignature, SPHINCSSignature
 
     for level, name in ((1, "ML-KEM-512"), (3, "ML-KEM-768"), (5, "ML-KEM-1024")):
         register_kem(
@@ -92,11 +93,36 @@ def _register_defaults() -> None:
             lambda backend, _level=level: MLKEMKeyExchange(_level, backend),
             ("cpu", "tpu"),
         )
+    for level, size in ((1, 640), (3, 976), (5, 1344)):
+        for aes in (True, False):
+            register_kem(
+                f"FrodoKEM-{size}-{'AES' if aes else 'SHAKE'}",
+                lambda backend, _level=level, _aes=aes: FrodoKEMKeyExchange(
+                    _level, backend, use_aes=_aes
+                ),
+                ("cpu", "tpu"),
+            )
+    for level, size in ((1, 128), (3, 192), (5, 256)):
+        register_kem(
+            f"HQC-{size}",
+            lambda backend, _level=level: HQCKeyExchange(_level, backend),
+            ("cpu", "tpu"),
+        )
     for level, name in ((2, "ML-DSA-44"), (3, "ML-DSA-65"), (5, "ML-DSA-87")):
         register_signature(
             name,
             lambda backend, _level=level: MLDSASignature(_level, backend),
-            ("cpu",),  # tpu backend lands with sig/mldsa.py
+            ("cpu", "tpu"),
+        )
+    for level, name in (
+        (1, "SPHINCS+-SHA2-128f-simple"),
+        (3, "SPHINCS+-SHA2-192f-simple"),
+        (5, "SPHINCS+-SHA2-256f-simple"),
+    ):
+        register_signature(
+            name,
+            lambda backend, _level=level: SPHINCSSignature(_level, backend),
+            ("cpu", "tpu"),
         )
 
 

@@ -3,10 +3,15 @@
 Mirrors the role of the reference's MLDSASignature / SPHINCSSignature classes
 (crypto/signatures.py:58-315), parameterized by NIST level 2/3/5, with
 verify returning False on any failure (crypto/signatures.py:186-188).
+
+Host/device split for the tpu backend: variable-length messages are hashed to
+the fixed 64-byte ``mu = SHAKE256(tr || M', 64)`` on the host (public data,
+cheap); the lattice math runs as fixed-shape batched JAX programs.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 import numpy as np
@@ -15,6 +20,20 @@ from ..pyref import mldsa_ref
 from .base import SignatureAlgorithm
 
 _LEVEL_TO_MLDSA = {2: mldsa_ref.MLDSA44, 3: mldsa_ref.MLDSA65, 5: mldsa_ref.MLDSA87}
+
+from ..pyref import slhdsa_ref  # noqa: E402
+
+_LEVEL_TO_SLH = {
+    1: slhdsa_ref.SLH128F,
+    3: slhdsa_ref.SLH192F,
+    5: slhdsa_ref.SLH256F,
+}
+
+
+def _mu(tr: bytes, message: bytes, ctx: bytes = b"") -> bytes:
+    """mu = SHAKE256(tr || M', 64) with M' = 0x00 || len(ctx) || ctx || M."""
+    m_prime = bytes([0, len(ctx)]) + ctx + message
+    return hashlib.shake_256(tr + m_prime).digest(64)
 
 
 class MLDSASignature(SignatureAlgorithm):
@@ -38,35 +57,155 @@ class MLDSASignature(SignatureAlgorithm):
         if backend == "tpu":
             from ..sig import mldsa as _jax_mldsa  # deferred: pulls in jax
 
-            self._tpu = _jax_mldsa.get(self.params.name)
+            self._kg, self._sign_mu, self._verify_mu = _jax_mldsa.get(self.params.name)
 
     def generate_keypair(self) -> tuple[bytes, bytes]:
         xi = os.urandom(32)
         if self.backend == "tpu":
-            pk, sk = self._tpu.keygen(np.frombuffer(xi, np.uint8)[None])
+            pk, sk = self._kg(np.frombuffer(xi, np.uint8)[None])
             return bytes(np.asarray(pk)[0]), bytes(np.asarray(sk)[0])
         return mldsa_ref.keygen(self.params, xi)
 
     def sign(self, secret_key: bytes, message: bytes) -> bytes:
         rnd = os.urandom(32)  # hedged variant
         if self.backend == "tpu":
-            sig = self._tpu.sign(
-                np.frombuffer(secret_key, np.uint8)[None],
-                np.frombuffer(message, np.uint8)[None],
-                np.frombuffer(rnd, np.uint8)[None],
-            )
-            return bytes(np.asarray(sig)[0])
+            sk = np.frombuffer(secret_key, np.uint8)[None]
+            return bytes(self.sign_batch(sk, [message], rnd=[rnd])[0])
         return mldsa_ref.sign(self.params, secret_key, message, rnd=rnd)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         try:
+            if len(signature) != self.params.sig_len or len(public_key) != self.params.pk_len:
+                return False
             if self.backend == "tpu":
-                ok = self._tpu.verify(
-                    np.frombuffer(public_key, np.uint8)[None],
-                    np.frombuffer(message, np.uint8)[None],
-                    np.frombuffer(signature, np.uint8)[None],
-                )
-                return bool(np.asarray(ok)[0])
+                pk = np.frombuffer(public_key, np.uint8)[None]
+                sig = np.frombuffer(signature, np.uint8)[None]
+                return bool(self.verify_batch(pk, [message], [sig])[0])
             return mldsa_ref.verify(self.params, public_key, message, signature)
         except Exception:
             return False
+
+    # -- batch API (tpu-native; cpu falls back to base-class loop) ----------
+
+    def sign_batch(self, secret_keys: np.ndarray, messages: list[bytes], rnd=None):
+        if self.backend != "tpu":
+            return super().sign_batch(secret_keys, messages)
+        n = len(messages)
+        if rnd is None:
+            rnd = [os.urandom(32) for _ in range(n)]
+        trs = [bytes(sk[64:128]) for sk in secret_keys]
+        mus = np.stack(
+            [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, messages)]
+        )
+        rnds = np.stack([np.frombuffer(r, np.uint8) for r in rnd])
+        sigs = np.asarray(self._sign_mu(np.asarray(secret_keys), mus, rnds))
+        return [bytes(s) for s in sigs]
+
+    def verify_batch(self, public_keys: np.ndarray, messages: list[bytes], signatures):
+        if self.backend != "tpu":
+            return super().verify_batch(public_keys, messages, signatures)
+        trs = [hashlib.shake_256(bytes(pk)).digest(64) for pk in public_keys]
+        mus = np.stack(
+            [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, messages)]
+        )
+        sigs = np.stack([np.frombuffer(bytes(s), np.uint8) for s in signatures])
+        return np.asarray(self._verify_mu(np.asarray(public_keys), mus, sigs))
+
+
+class SPHINCSSignature(SignatureAlgorithm):
+    """SPHINCS+-SHA2 'f' simple (FIPS 205 SLH-DSA) at NIST level 1, 3 or 5.
+
+    Host/device split for the tpu backend: PRF_msg and the variable-length
+    H_msg digest run host-side (hashlib/hmac, public data); the FORS +
+    hypertree hashing — the actual work — runs as batched JAX programs.
+    """
+
+    def __init__(self, security_level: int = 1, backend: str = "cpu"):
+        if security_level not in _LEVEL_TO_SLH:
+            raise ValueError(f"SPHINCS+ level must be 1/3/5, got {security_level}")
+        self.params = _LEVEL_TO_SLH[security_level]
+        self.security_level = security_level
+        self.backend = backend
+        self.name = self.params.name
+        self.display_name = f"{self.params.name} ({backend})"
+        self.description = (
+            f"Stateless hash-based signature, FIPS 205, NIST level {security_level}, "
+            f"{'batched JAX/TPU' if backend == 'tpu' else 'pure-Python CPU'} backend"
+        )
+        self.public_key_len = self.params.pk_len
+        self.secret_key_len = self.params.sk_len
+        self.signature_len = self.params.sig_len
+        if backend == "tpu":
+            from ..sig import sphincs as _jax_slh  # deferred: pulls in jax
+
+            self._kg, self._sign_digest, self._verify_digest = _jax_slh.get(self.params.name)
+
+    def generate_keypair(self) -> tuple[bytes, bytes]:
+        p = self.params
+        seeds = os.urandom(3 * p.n)
+        sk_seed, sk_prf, pk_seed = seeds[: p.n], seeds[p.n : 2 * p.n], seeds[2 * p.n :]
+        if self.backend == "tpu":
+            pk, sk = self._kg(
+                np.frombuffer(sk_seed, np.uint8)[None],
+                np.frombuffer(sk_prf, np.uint8)[None],
+                np.frombuffer(pk_seed, np.uint8)[None],
+            )
+            return bytes(np.asarray(pk)[0]), bytes(np.asarray(sk)[0])
+        return slhdsa_ref.keygen(p, sk_seed, sk_prf, pk_seed)
+
+    def sign(self, secret_key: bytes, message: bytes) -> bytes:
+        if self.backend == "tpu":
+            sk = np.frombuffer(secret_key, np.uint8)[None]
+            return bytes(self.sign_batch(sk, [message])[0])
+        return slhdsa_ref.sign(self.params, secret_key, message)
+
+    def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        try:
+            if len(signature) != self.params.sig_len or len(public_key) != self.params.pk_len:
+                return False
+            if self.backend == "tpu":
+                pk = np.frombuffer(public_key, np.uint8)[None]
+                sig = np.frombuffer(signature, np.uint8)[None]
+                return bool(self.verify_batch(pk, [message], [sig])[0])
+            return slhdsa_ref.verify(self.params, public_key, message, signature)
+        except Exception:
+            return False
+
+    # -- batch API ----------------------------------------------------------
+
+    def sign_batch(self, secret_keys: np.ndarray, messages: list[bytes]):
+        if self.backend != "tpu":
+            return super().sign_batch(secret_keys, messages)
+        p = self.params
+        rs, digests = [], []
+        for sk, m in zip(secret_keys, messages):
+            skb = bytes(sk)
+            sk_prf = skb[p.n : 2 * p.n]
+            pk_seed, pk_root = skb[2 * p.n : 3 * p.n], skb[3 * p.n :]
+            r = slhdsa_ref.prf_msg(p, sk_prf, pk_seed, m)  # deterministic variant
+            rs.append(np.frombuffer(r, np.uint8))
+            digests.append(
+                np.frombuffer(slhdsa_ref.h_msg(p, r, pk_seed, pk_root, m), np.uint8)
+            )
+        sigs = np.asarray(
+            self._sign_digest(np.asarray(secret_keys), np.stack(rs), np.stack(digests))
+        )
+        return [bytes(s) for s in sigs]
+
+    def verify_batch(self, public_keys: np.ndarray, messages: list[bytes], signatures):
+        if self.backend != "tpu":
+            return super().verify_batch(public_keys, messages, signatures)
+        p = self.params
+        sigs = np.stack([np.frombuffer(bytes(s), np.uint8) for s in signatures])
+        digests = []
+        for pk, m, sig in zip(public_keys, messages, signatures):
+            pkb = bytes(pk)
+            r = bytes(sig[: p.n])
+            digests.append(
+                np.frombuffer(
+                    slhdsa_ref.h_msg(p, r, pkb[: p.n], pkb[p.n :], m), np.uint8
+                )
+            )
+        return np.asarray(
+            self._verify_digest(np.asarray(public_keys), np.stack(digests), sigs)
+        )

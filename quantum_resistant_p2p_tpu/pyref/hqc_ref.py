@@ -1,0 +1,398 @@
+"""Pure-Python HQC (round-4 shaped) — clean-room reference.
+
+Hamming Quasi-Cyclic KEM: syndrome decoding on a concatenated code —
+an outer Reed-Solomon code over GF(2^8) and an inner duplicated
+Reed-Muller RM(1,7) code — with quasi-cyclic products in
+GF(2)[x]/(x^n - 1) (big-int carryless arithmetic here).
+
+IMPORTANT COMPATIBILITY NOTE: the vendored liboqs binary is stripped from the
+reference checkout (.MISSING_LARGE_BLOBS), so no native HQC oracle exists in
+this environment.  This implementation is *structurally* faithful to the
+round-4 HQC design (parameter sets, code construction, fixed-weight sampling,
+salted FO transform with implicit rejection) but its exact byte-level PRNG
+call sequence is this framework's own documented seam — it is NOT claimed
+KAT-compatible with liboqs.  Both backends (this oracle and the batched JAX
+implementation in ``kem.hqc``) are bit-exact against each other, which is the
+property the application protocol needs (reference behavior:
+crypto/key_exchange.py:189-309 HQCKeyExchange).
+
+Determinism seam: keygen takes (sk_seed, sigma, pk_seed); encaps takes
+(m, salt).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+RM_N = 128  # inner RM(1,7) length
+
+
+@dataclass(frozen=True)
+class HQCParams:
+    name: str
+    n: int
+    n1: int  # RS length (bytes)
+    k: int  # message bytes
+    delta: int  # RS correction capability
+    dup: int  # RM duplication (n2 = 128 * dup)
+    w: int
+    wr: int
+
+    @property
+    def n2(self) -> int:
+        return RM_N * self.dup
+
+    @property
+    def n_bytes(self) -> int:
+        return (self.n + 7) // 8
+
+    @property
+    def n1n2_bytes(self) -> int:
+        return self.n1 * self.n2 // 8
+
+    @property
+    def pk_len(self) -> int:
+        return 40 + self.n_bytes
+
+    @property
+    def sk_len(self) -> int:
+        return 40 + self.k + self.pk_len
+
+    @property
+    def ct_len(self) -> int:
+        return self.n_bytes + self.n1n2_bytes + 16
+
+    @property
+    def ss_len(self) -> int:
+        return 64
+
+
+HQC128 = HQCParams("HQC-128", n=17669, n1=46, k=16, delta=15, dup=3, w=66, wr=75)
+HQC192 = HQCParams("HQC-192", n=35851, n1=56, k=24, delta=16, dup=5, w=100, wr=114)
+HQC256 = HQCParams("HQC-256", n=57637, n1=90, k=32, delta=29, dup=5, w=131, wr=149)
+
+PARAMS = {p.name: p for p in (HQC128, HQC192, HQC256)}
+
+
+# -- GF(2^8) arithmetic (poly 0x11D, generator alpha = 2) --------------------
+
+
+def _build_gf():
+    exp = [0] * 512
+    log = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= 0x11D
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    return exp, log
+
+
+_GF_EXP, _GF_LOG = _build_gf()
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return _GF_EXP[_GF_LOG[a] + _GF_LOG[b]]
+
+
+def gf_inv(a: int) -> int:
+    return _GF_EXP[255 - _GF_LOG[a]]
+
+
+# -- Reed-Solomon [n1, k] over GF(2^8), corrects delta errors ----------------
+
+
+def _rs_gen_poly(p: HQCParams) -> list[int]:
+    """g(x) = prod_{i=1..2delta} (x - alpha^i); low-degree-first coeffs."""
+    g = [1]
+    for i in range(1, 2 * p.delta + 1):
+        root = _GF_EXP[i]
+        ng = [0] * (len(g) + 1)
+        for j, c in enumerate(g):
+            ng[j] ^= gf_mul(c, root)
+            ng[j + 1] ^= c
+        g = ng
+    return g
+
+
+def rs_encode(p: HQCParams, msg: bytes) -> bytes:
+    """Systematic RS encode: msg (k bytes) -> codeword (n1 bytes).
+
+    Codeword = [parity || msg] with parity = x^(2delta) * m(x) mod g(x).
+    """
+    g = _rs_gen_poly(p)
+    red = 2 * p.delta
+    assert p.n1 - p.k == red
+    rem = [0] * red
+    # long division of m(x)*x^red by g(x); msg[k-1] is the highest-degree coeff
+    for byte in reversed(msg):
+        coef = byte ^ rem[-1]
+        rem = [0] + rem[:-1]
+        if coef:
+            for j in range(red):
+                rem[j] ^= gf_mul(g[j], coef)
+    return bytes(rem) + msg
+
+
+def rs_decode(p: HQCParams, cw: bytes) -> bytes:
+    """Syndrome decode (Berlekamp-Massey + Chien + Forney) -> k message bytes."""
+    red = 2 * p.delta
+    c = list(cw)
+    # syndromes S_i = c(alpha^i), i = 1..2delta, with coefficient j at x^j
+    synd = []
+    for i in range(1, red + 1):
+        s = 0
+        for j, cj in enumerate(c):
+            if cj:
+                s ^= _GF_EXP[(_GF_LOG[cj] + i * j) % 255]
+        synd.append(s)
+    if not any(synd):
+        return cw[red:]
+    # Berlekamp-Massey
+    sigma = [1]
+    b = [1]
+    L = 0
+    m = 1
+    bb = 1
+    for n_it in range(red):
+        d = synd[n_it]
+        for i in range(1, L + 1):
+            if i < len(sigma) and sigma[i] and synd[n_it - i]:
+                d ^= gf_mul(sigma[i], synd[n_it - i])
+        if d == 0:
+            m += 1
+        elif 2 * L <= n_it:
+            t = list(sigma)
+            coef = gf_mul(d, gf_inv(bb))
+            shifted = [0] * m + b
+            sigma = [
+                (sigma[i] if i < len(sigma) else 0)
+                ^ (gf_mul(coef, shifted[i]) if i < len(shifted) else 0)
+                for i in range(max(len(sigma), len(shifted)))
+            ]
+            L = n_it + 1 - L
+            b = t
+            bb = d
+            m = 1
+        else:
+            coef = gf_mul(d, gf_inv(bb))
+            shifted = [0] * m + b
+            sigma = [
+                (sigma[i] if i < len(sigma) else 0)
+                ^ (gf_mul(coef, shifted[i]) if i < len(shifted) else 0)
+                for i in range(max(len(sigma), len(shifted)))
+            ]
+            m += 1
+    # Chien search: roots alpha^{-j} <-> error at position j
+    err_pos = []
+    for j in range(p.n1):
+        val = 0
+        for i, s in enumerate(sigma):
+            if s:
+                val ^= _GF_EXP[(_GF_LOG[s] + i * ((255 - j) % 255)) % 255]
+        if val == 0:
+            err_pos.append(j)
+    if len(err_pos) != max(0, len(sigma) - 1 - sigma.count(0)):
+        pass  # best effort: proceed with found roots
+    # Forney: error values via omega(x) = S(x) sigma(x) mod x^red
+    s_poly = synd
+    omega = [0] * red
+    for i in range(len(sigma)):
+        for j in range(len(s_poly)):
+            if i + j < red and sigma[i] and s_poly[j]:
+                omega[i + j] ^= gf_mul(sigma[i], s_poly[j])
+    # sigma'(x): formal derivative (odd-degree terms)
+    for j in err_pos:
+        xinv = _GF_EXP[(255 - j) % 255]  # alpha^{-j}
+        num = 0
+        xp = 1
+        for i in range(red):
+            if omega[i]:
+                num ^= gf_mul(omega[i], xp)
+            xp = gf_mul(xp, xinv)
+        den = 0
+        xp = 1  # (alpha^{-j})^0
+        for i in range(1, len(sigma), 2):
+            if sigma[i]:
+                den ^= gf_mul(sigma[i], xp)
+            xp = gf_mul(xp, gf_mul(xinv, xinv))
+        if den == 0:
+            continue
+        # error magnitude e_j = omega(alpha^-j) / sigma'(alpha^-j)
+        # (no X_l factor: with S(x) = sum S_{i+1} x^i, omega(X^-1) = e*X*prod
+        #  and sigma'(X^-1) = X*prod, so the X cancels in char 2)
+        c[j] ^= gf_mul(num, gf_inv(den))
+    return bytes(c[red:])
+
+
+# -- duplicated Reed-Muller RM(1,7) ------------------------------------------
+
+
+def rm_encode_byte(b: int) -> int:
+    """byte -> 128-bit RM(1,7) codeword (int, bit j = position j)."""
+    cw = 0
+    for j in range(RM_N):
+        bit = b & 1  # b0 on the all-ones basis vector
+        for t in range(7):
+            if (b >> (t + 1)) & 1 and (j >> t) & 1:
+                bit ^= 1
+        cw |= bit << j
+    return cw
+
+
+_RM_ENC_TABLE = [rm_encode_byte(b) for b in range(256)]
+
+
+def rm_decode_block(p: HQCParams, bits: list[int]) -> int:
+    """dup*128 received bits -> decoded byte via soft FHT correlation."""
+    # soft-combine duplicates: counts in {-dup..dup}
+    f = [0] * RM_N
+    for j in range(RM_N):
+        acc = 0
+        for d in range(p.dup):
+            acc += 1 - 2 * bits[d * RM_N + j]  # 0 -> +1, 1 -> -1
+        f[j] = acc
+    # fast Hadamard transform
+    h = 1
+    while h < RM_N:
+        for i in range(0, RM_N, 2 * h):
+            for j in range(i, i + h):
+                a, b2 = f[j], f[j + h]
+                f[j], f[j + h] = a + b2, a - b2
+        h *= 2
+    best = max(range(RM_N), key=lambda i: abs(f[i]))
+    b0 = 1 if f[best] < 0 else 0
+    return (best << 1) | b0
+
+
+def code_encode(p: HQCParams, msg: bytes) -> int:
+    """k message bytes -> n1*n2-bit codeword (as int)."""
+    rs = rs_encode(p, msg)
+    out = 0
+    for i, byte in enumerate(rs):
+        cw = _RM_ENC_TABLE[byte]
+        for d in range(p.dup):
+            out |= cw << (i * p.n2 + d * RM_N)
+    return out
+
+
+def code_decode(p: HQCParams, v: int) -> bytes:
+    rs_bytes = []
+    for i in range(p.n1):
+        block = [(v >> (i * p.n2 + j)) & 1 for j in range(p.n2)]
+        rs_bytes.append(rm_decode_block(p, block))
+    return rs_decode(p, bytes(rs_bytes))
+
+
+# -- fixed-weight sampling + cyclic arithmetic -------------------------------
+
+
+def _prng_u32s(seed: bytes, count: int, domain: int) -> list[int]:
+    buf = hashlib.shake_256(seed + bytes([domain])).digest(4 * count)
+    return [int.from_bytes(buf[4 * i : 4 * i + 4], "little") for i in range(count)]
+
+
+def sample_fixed_weight(p: HQCParams, seed: bytes, weight: int, domain: int) -> int:
+    """Fisher-Yates-style fixed-weight vector (Sendrier SampleFixedWeight)."""
+    rand = _prng_u32s(seed, weight, domain)
+    support = [0] * weight
+    for i in range(weight):
+        support[i] = i + rand[i] % (p.n - i)
+    for i in range(weight - 1, -1, -1):
+        for j in range(i + 1, weight):
+            if support[j] == support[i]:
+                support[i] = i
+    v = 0
+    for pos in support:
+        v |= 1 << pos
+    return v
+
+
+def sample_random_vector(p: HQCParams, seed: bytes, domain: int) -> int:
+    buf = hashlib.shake_256(seed + bytes([domain])).digest(p.n_bytes)
+    v = int.from_bytes(buf, "little")
+    return v & ((1 << p.n) - 1)
+
+
+def cyclic_mul(p: HQCParams, a: int, b_support_int: int) -> int:
+    """a * b in GF(2)[x]/(x^n - 1); b given as bit-vector int (any weight)."""
+    mask = (1 << p.n) - 1
+    out = 0
+    b = b_support_int
+    while b:
+        low = b & -b
+        pos = low.bit_length() - 1
+        out ^= a << pos
+        b ^= low
+    return (out & mask) ^ (out >> p.n)
+
+
+def _hash_g(data: bytes) -> bytes:
+    return hashlib.shake_256(b"\x03" + data).digest(64)
+
+
+def _hash_k(data: bytes) -> bytes:
+    return hashlib.shake_256(b"\x04" + data).digest(64)
+
+
+# -- KEM ---------------------------------------------------------------------
+
+
+def keygen(p: HQCParams, sk_seed: bytes, sigma: bytes, pk_seed: bytes):
+    """sk_seed (40), sigma (k), pk_seed (40) -> (pk, sk)."""
+    h = sample_random_vector(p, pk_seed, 0)
+    x = sample_fixed_weight(p, sk_seed, p.w, 1)
+    y = sample_fixed_weight(p, sk_seed, p.w, 2)
+    s = x ^ cyclic_mul(p, h, y)
+    pk = pk_seed + s.to_bytes(p.n_bytes, "little")
+    sk = sk_seed + sigma + pk
+    return pk, sk
+
+
+def _encrypt(p: HQCParams, pk: bytes, m: bytes, theta: bytes):
+    pk_seed = pk[:40]
+    s = int.from_bytes(pk[40:], "little")
+    h = sample_random_vector(p, pk_seed, 0)
+    r1 = sample_fixed_weight(p, theta, p.wr, 3)
+    r2 = sample_fixed_weight(p, theta, p.wr, 4)
+    e = sample_fixed_weight(p, theta, p.wr, 5)
+    u = r1 ^ cyclic_mul(p, h, r2)
+    t = code_encode(p, m) ^ cyclic_mul(p, s, r2) ^ e
+    v = t & ((1 << (p.n1 * p.n2)) - 1)  # truncate to the code length
+    return u, v
+
+
+def encaps(p: HQCParams, pk: bytes, m: bytes, salt: bytes):
+    """pk, m (k bytes), salt (16) -> (ct, ss)."""
+    theta = _hash_g(m + pk[:32] + salt)
+    u, v = _encrypt(p, pk, m, theta)
+    u_b = u.to_bytes(p.n_bytes, "little")
+    v_b = v.to_bytes(p.n1n2_bytes, "little")
+    ct = u_b + v_b + salt
+    ss = _hash_k(m + u_b + v_b)
+    return ct, ss
+
+
+def decaps(p: HQCParams, sk: bytes, ct: bytes) -> bytes:
+    sk_seed, sigma = sk[:40], sk[40 : 40 + p.k]
+    pk = sk[40 + p.k :]
+    u_b = ct[: p.n_bytes]
+    v_b = ct[p.n_bytes : p.n_bytes + p.n1n2_bytes]
+    salt = ct[p.n_bytes + p.n1n2_bytes :]
+    u = int.from_bytes(u_b, "little")
+    v = int.from_bytes(v_b, "little")
+    y = sample_fixed_weight(p, sk_seed, p.w, 2)
+    uy = cyclic_mul(p, u, y)
+    m_p = code_decode(p, v ^ (uy & ((1 << (p.n1 * p.n2)) - 1)))
+    theta_p = _hash_g(m_p + pk[:32] + salt)
+    u2, v2 = _encrypt(p, pk, m_p, theta_p)
+    if u2 == u and v2 == v:
+        return _hash_k(m_p + u_b + v_b)
+    return _hash_k(sigma + u_b + v_b)

@@ -1,0 +1,468 @@
+"""Pure-Python SLH-DSA / SPHINCS+-SHA2 (FIPS 205) — clean-room reference.
+
+Written directly from the FIPS 205 specification (SHA2 'simple'
+instantiations, §11.2) with ``hashlib``/``hmac``.  Serves as the bit-exactness
+oracle for the batched JAX implementation in ``sig.sphincs`` and as the CPU
+provider backend (the role liboqs SPHINCS+ plays for the reference app's
+crypto/signatures.py:191-315 SPHINCSSignature).
+
+Determinism seam: keygen takes (sk_seed, sk_prf, pk_seed); signing takes
+``addrnd`` (None = deterministic, addrnd = pk_seed per spec default).
+
+Security-category hash split (FIPS 205 §11.2): F/PRF/PRF_msg-inner use
+SHA-256 everywhere; H/T_l/H_msg use SHA-256 for the 128-bit sets and SHA-512
+for the 192/256-bit sets.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+from dataclasses import dataclass
+
+LG_W = 4
+W = 16
+
+
+@dataclass(frozen=True)
+class SLHDSAParams:
+    name: str
+    n: int
+    h: int
+    d: int
+    hp: int  # h' = h/d
+    a: int
+    k: int
+    m: int
+
+    @property
+    def len1(self) -> int:
+        return 2 * self.n
+
+    @property
+    def len2(self) -> int:
+        return 3
+
+    @property
+    def wots_len(self) -> int:
+        return self.len1 + self.len2
+
+    @property
+    def pk_len(self) -> int:
+        return 2 * self.n
+
+    @property
+    def sk_len(self) -> int:
+        return 4 * self.n
+
+    @property
+    def sig_len(self) -> int:
+        # R + FORS(k*(1+a)*n) + HT(d*(wots_len+hp)*n)
+        return self.n * (1 + self.k * (1 + self.a) + self.d * (self.wots_len + self.hp))
+
+    @property
+    def big_hash(self) -> bool:
+        """True -> H/T/H_msg/PRF_msg use SHA-512 (security categories 3, 5)."""
+        return self.n > 16
+
+
+SLH128S = SLHDSAParams("SPHINCS+-SHA2-128s-simple", n=16, h=63, d=7, hp=9, a=12, k=14, m=30)
+SLH128F = SLHDSAParams("SPHINCS+-SHA2-128f-simple", n=16, h=66, d=22, hp=3, a=6, k=33, m=34)
+SLH192F = SLHDSAParams("SPHINCS+-SHA2-192f-simple", n=24, h=66, d=22, hp=3, a=8, k=33, m=42)
+SLH256F = SLHDSAParams("SPHINCS+-SHA2-256f-simple", n=32, h=68, d=17, hp=4, a=9, k=35, m=49)
+
+PARAMS = {p.name: p for p in (SLH128S, SLH128F, SLH192F, SLH256F)}
+
+assert SLH128F.sig_len == 17088 and SLH128S.sig_len == 7856
+assert SLH192F.sig_len == 35664 and SLH256F.sig_len == 49856
+
+
+# -- ADRS (FIPS 205 §4.2-4.3; compressed 22-byte form for SHA2, §11.2) -------
+
+WOTS_HASH, WOTS_PK, TREE, FORS_TREE, FORS_ROOTS, WOTS_PRF, FORS_PRF = range(7)
+
+
+class ADRS:
+    __slots__ = ("layer", "tree", "type", "w1", "w2", "w3")
+
+    def __init__(self):
+        self.layer = 0
+        self.tree = 0
+        self.type = 0
+        self.w1 = self.w2 = self.w3 = 0
+
+    def copy(self) -> "ADRS":
+        a = ADRS()
+        a.layer, a.tree, a.type = self.layer, self.tree, self.type
+        a.w1, a.w2, a.w3 = self.w1, self.w2, self.w3
+        return a
+
+    def set_type_and_clear(self, t: int) -> None:
+        self.type = t
+        self.w1 = self.w2 = self.w3 = 0
+
+    def compressed(self) -> bytes:
+        return (
+            self.layer.to_bytes(1, "big")
+            + self.tree.to_bytes(8, "big")
+            + self.type.to_bytes(1, "big")
+            + self.w1.to_bytes(4, "big")
+            + self.w2.to_bytes(4, "big")
+            + self.w3.to_bytes(4, "big")
+        )
+
+
+# -- hash functions (SHA2 'simple', FIPS 205 §11.2.1-11.2.2) -----------------
+
+
+def _sha(big: bool, data: bytes) -> bytes:
+    return (hashlib.sha512 if big else hashlib.sha256)(data).digest()
+
+
+def _mgf1(big: bool, seed: bytes, length: int) -> bytes:
+    hlen = 64 if big else 32
+    out = b""
+    for c in range((length + hlen - 1) // hlen):
+        out += _sha(big, seed + c.to_bytes(4, "big"))
+    return out[:length]
+
+
+def f_hash(p: SLHDSAParams, pk_seed: bytes, adrs: ADRS, m: bytes) -> bytes:
+    """F / PRF / T_l for the small-hash engine (always SHA-256)."""
+    return hashlib.sha256(
+        pk_seed + b"\0" * (64 - p.n) + adrs.compressed() + m
+    ).digest()[: p.n]
+
+
+def t_hash(p: SLHDSAParams, pk_seed: bytes, adrs: ADRS, m: bytes) -> bytes:
+    """H / T_l — SHA-256 (cat 1) or SHA-512 (cats 3, 5) with block-pad seed."""
+    if not p.big_hash:
+        return f_hash(p, pk_seed, adrs, m)
+    return hashlib.sha512(
+        pk_seed + b"\0" * (128 - p.n) + adrs.compressed() + m
+    ).digest()[: p.n]
+
+
+def prf_msg(p: SLHDSAParams, sk_prf: bytes, opt_rand: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha512 if p.big_hash else hashlib.sha256
+    return hmac_mod.new(sk_prf, opt_rand + msg, h).digest()[: p.n]
+
+
+def h_msg(p: SLHDSAParams, r: bytes, pk_seed: bytes, pk_root: bytes, msg: bytes) -> bytes:
+    inner = _sha(p.big_hash, r + pk_seed + pk_root + msg)
+    return _mgf1(p.big_hash, r + pk_seed + inner, p.m)
+
+
+# -- base-w / checksum (FIPS 205 §5, lg_w = 4: nibbles, big-endian) ----------
+
+
+def _to_nibbles(b: bytes) -> list[int]:
+    out = []
+    for byte in b:
+        out.append(byte >> 4)
+        out.append(byte & 0xF)
+    return out
+
+
+def _wots_digits(p: SLHDSAParams, m: bytes) -> list[int]:
+    msg = _to_nibbles(m)  # len1 digits
+    csum = sum(W - 1 - d for d in msg)
+    csum <<= 4  # (8 - ((len2 * LG_W) % 8)) % 8
+    return msg + _to_nibbles(csum.to_bytes(2, "big"))[: p.len2]
+
+
+# -- WOTS+ (FIPS 205 §5) -----------------------------------------------------
+
+
+def _chain(p: SLHDSAParams, x: bytes, i: int, s: int, pk_seed: bytes, adrs: ADRS) -> bytes:
+    for j in range(i, i + s):
+        adrs.w3 = j
+        x = f_hash(p, pk_seed, adrs, x)
+    return x
+
+
+def wots_pkgen(p: SLHDSAParams, sk_seed: bytes, pk_seed: bytes, adrs: ADRS) -> bytes:
+    sk_adrs = adrs.copy()
+    sk_adrs.set_type_and_clear(WOTS_PRF)
+    sk_adrs.w1 = adrs.w1
+    tmp = b""
+    for i in range(p.wots_len):
+        sk_adrs.w2 = i
+        sk = f_hash(p, pk_seed, sk_adrs, sk_seed)
+        adrs.w2 = i
+        adrs.w3 = 0
+        tmp += _chain(p, sk, 0, W - 1, pk_seed, adrs)
+    pk_adrs = adrs.copy()
+    pk_adrs.set_type_and_clear(WOTS_PK)
+    pk_adrs.w1 = adrs.w1
+    return t_hash(p, pk_seed, pk_adrs, tmp)
+
+
+def wots_sign(p: SLHDSAParams, m: bytes, sk_seed: bytes, pk_seed: bytes, adrs: ADRS) -> bytes:
+    digits = _wots_digits(p, m)
+    sk_adrs = adrs.copy()
+    sk_adrs.set_type_and_clear(WOTS_PRF)
+    sk_adrs.w1 = adrs.w1
+    sig = b""
+    for i, d in enumerate(digits):
+        sk_adrs.w2 = i
+        sk = f_hash(p, pk_seed, sk_adrs, sk_seed)
+        adrs.w2 = i
+        adrs.w3 = 0
+        sig += _chain(p, sk, 0, d, pk_seed, adrs)
+    return sig
+
+
+def wots_pk_from_sig(p: SLHDSAParams, sig: bytes, m: bytes, pk_seed: bytes, adrs: ADRS) -> bytes:
+    digits = _wots_digits(p, m)
+    tmp = b""
+    for i, d in enumerate(digits):
+        adrs.w2 = i
+        tmp += _chain(p, sig[i * p.n : (i + 1) * p.n], d, W - 1 - d, pk_seed, adrs)
+    pk_adrs = adrs.copy()
+    pk_adrs.set_type_and_clear(WOTS_PK)
+    pk_adrs.w1 = adrs.w1
+    return t_hash(p, pk_seed, pk_adrs, tmp)
+
+
+# -- XMSS (FIPS 205 §6) ------------------------------------------------------
+
+
+def _xmss_node(p: SLHDSAParams, sk_seed: bytes, i: int, z: int, pk_seed: bytes, adrs: ADRS) -> bytes:
+    if z == 0:
+        adrs.set_type_and_clear(WOTS_HASH)
+        adrs.w1 = i
+        return wots_pkgen(p, sk_seed, pk_seed, adrs)
+    lnode = _xmss_node(p, sk_seed, 2 * i, z - 1, pk_seed, adrs)
+    rnode = _xmss_node(p, sk_seed, 2 * i + 1, z - 1, pk_seed, adrs)
+    adrs.set_type_and_clear(TREE)
+    adrs.w2 = z  # FIPS 205 §4.3: TREE uses (pad, height, index) in words 1-3
+    adrs.w3 = i
+    return t_hash(p, pk_seed, adrs, lnode + rnode)
+
+
+def xmss_sign(p: SLHDSAParams, m: bytes, sk_seed: bytes, idx: int, pk_seed: bytes, adrs: ADRS) -> bytes:
+    auth = b""
+    for j in range(p.hp):
+        k = (idx >> j) ^ 1
+        auth += _xmss_node(p, sk_seed, k, j, pk_seed, adrs.copy())
+    adrs.set_type_and_clear(WOTS_HASH)
+    adrs.w1 = idx
+    return wots_sign(p, m, sk_seed, pk_seed, adrs) + auth
+
+
+def xmss_pk_from_sig(p: SLHDSAParams, idx: int, sig_xmss: bytes, m: bytes, pk_seed: bytes, adrs: ADRS) -> bytes:
+    wots_sig = sig_xmss[: p.wots_len * p.n]
+    auth = sig_xmss[p.wots_len * p.n :]
+    adrs.set_type_and_clear(WOTS_HASH)
+    adrs.w1 = idx
+    node = wots_pk_from_sig(p, wots_sig, m, pk_seed, adrs)
+    adrs.set_type_and_clear(TREE)
+    adrs.w3 = idx
+    for k in range(p.hp):
+        adrs.w2 = k + 1
+        sib = auth[k * p.n : (k + 1) * p.n]
+        if (idx >> k) & 1:
+            adrs.w3 = (adrs.w3 - 1) >> 1
+            node = t_hash(p, pk_seed, adrs, sib + node)
+        else:
+            adrs.w3 = adrs.w3 >> 1
+            node = t_hash(p, pk_seed, adrs, node + sib)
+    return node
+
+
+# -- Hypertree (FIPS 205 §7) -------------------------------------------------
+
+
+def ht_sign(p: SLHDSAParams, m: bytes, sk_seed: bytes, pk_seed: bytes, idx_tree: int, idx_leaf: int) -> bytes:
+    adrs = ADRS()
+    adrs.tree = idx_tree
+    sig = xmss_sign(p, m, sk_seed, idx_leaf, pk_seed, adrs)
+    root = xmss_pk_from_sig(
+        p, idx_leaf, sig, m, pk_seed, _adrs_for(idx_tree, 0)
+    )
+    for j in range(1, p.d):
+        idx_leaf = idx_tree & ((1 << p.hp) - 1)
+        idx_tree >>= p.hp
+        adrs = _adrs_for(idx_tree, j)
+        sig_j = xmss_sign(p, root, sk_seed, idx_leaf, pk_seed, adrs)
+        sig += sig_j
+        if j < p.d - 1:
+            root = xmss_pk_from_sig(p, idx_leaf, sig_j, root, pk_seed, _adrs_for(idx_tree, j))
+    return sig
+
+
+def _adrs_for(tree: int, layer: int) -> ADRS:
+    a = ADRS()
+    a.tree = tree
+    a.layer = layer
+    return a
+
+
+def ht_verify(p: SLHDSAParams, m: bytes, sig_ht: bytes, pk_seed: bytes, idx_tree: int, idx_leaf: int, pk_root: bytes) -> bool:
+    per = (p.wots_len + p.hp) * p.n
+    node = xmss_pk_from_sig(p, idx_leaf, sig_ht[:per], m, pk_seed, _adrs_for(idx_tree, 0))
+    for j in range(1, p.d):
+        idx_leaf = idx_tree & ((1 << p.hp) - 1)
+        idx_tree >>= p.hp
+        node = xmss_pk_from_sig(
+            p, idx_leaf, sig_ht[j * per : (j + 1) * per], node, pk_seed, _adrs_for(idx_tree, j)
+        )
+    return node == pk_root
+
+
+# -- FORS (FIPS 205 §8) ------------------------------------------------------
+
+
+def _fors_sk(p: SLHDSAParams, sk_seed: bytes, pk_seed: bytes, adrs: ADRS, idx: int) -> bytes:
+    sk_adrs = adrs.copy()
+    sk_adrs.set_type_and_clear(FORS_PRF)
+    sk_adrs.w1 = adrs.w1
+    sk_adrs.w3 = idx
+    return f_hash(p, pk_seed, sk_adrs, sk_seed)
+
+
+def _fors_node(p: SLHDSAParams, sk_seed: bytes, i: int, z: int, pk_seed: bytes, adrs: ADRS) -> bytes:
+    if z == 0:
+        sk = _fors_sk(p, sk_seed, pk_seed, adrs, i)
+        adrs.w2 = 0
+        adrs.w3 = i
+        return f_hash(p, pk_seed, adrs, sk)
+    lnode = _fors_node(p, sk_seed, 2 * i, z - 1, pk_seed, adrs)
+    rnode = _fors_node(p, sk_seed, 2 * i + 1, z - 1, pk_seed, adrs)
+    adrs.w2 = z
+    adrs.w3 = i
+    return t_hash(p, pk_seed, adrs, lnode + rnode)
+
+
+def _msg_indices(p: SLHDSAParams, md: bytes) -> list[int]:
+    """base_2^a digits of the FORS message digest."""
+    out = []
+    bits = 0
+    acc = 0
+    pos = 0
+    for _ in range(p.k):
+        while bits < p.a:
+            acc = (acc << 8) | md[pos]
+            pos += 1
+            bits += 8
+        bits -= p.a
+        out.append((acc >> bits) & ((1 << p.a) - 1))
+        acc &= (1 << bits) - 1
+    return out
+
+
+def fors_sign(p: SLHDSAParams, md: bytes, sk_seed: bytes, pk_seed: bytes, adrs: ADRS) -> bytes:
+    indices = _msg_indices(p, md)
+    sig = b""
+    for i, idx in enumerate(indices):
+        sig += _fors_sk(p, sk_seed, pk_seed, adrs, (i << p.a) + idx)
+        for j in range(p.a):
+            s = (idx >> j) ^ 1
+            sig += _fors_node(p, sk_seed, (i << (p.a - j)) + s, j, pk_seed, adrs.copy())
+    return sig
+
+
+def fors_pk_from_sig(p: SLHDSAParams, sig: bytes, md: bytes, pk_seed: bytes, adrs: ADRS) -> bytes:
+    indices = _msg_indices(p, md)
+    per = (1 + p.a) * p.n
+    roots = b""
+    for i, idx in enumerate(indices):
+        sk = sig[i * per : i * per + p.n]
+        auth = sig[i * per + p.n : (i + 1) * per]
+        adrs.w2 = 0
+        adrs.w3 = (i << p.a) + idx
+        node = f_hash(p, pk_seed, adrs, sk)
+        tree_idx = (i << p.a) + idx
+        for j in range(p.a):
+            sib = auth[j * p.n : (j + 1) * p.n]
+            adrs.w2 = j + 1
+            if (tree_idx >> j) & 1:
+                adrs.w3 = ((i << (p.a - j)) + (idx >> j) - 1) >> 1
+                node = t_hash(p, pk_seed, adrs, sib + node)
+            else:
+                adrs.w3 = ((i << (p.a - j)) + (idx >> j)) >> 1
+                node = t_hash(p, pk_seed, adrs, node + sib)
+        roots += node
+    pk_adrs = adrs.copy()
+    pk_adrs.set_type_and_clear(FORS_ROOTS)
+    pk_adrs.w1 = adrs.w1  # keep the keypair address (FIPS 205 Alg 17 line 25)
+    return t_hash(p, pk_seed, pk_adrs, roots)
+
+
+# -- SLH-DSA top level (FIPS 205 §9-10, internal forms) ----------------------
+
+
+def keygen(p: SLHDSAParams, sk_seed: bytes, sk_prf: bytes, pk_seed: bytes) -> tuple[bytes, bytes]:
+    """Algorithm 18 slh_keygen_internal: three n-byte seeds -> (pk, sk)."""
+    adrs = ADRS()
+    adrs.layer = p.d - 1
+    pk_root = _xmss_node(p, sk_seed, 0, p.hp, pk_seed, adrs)
+    pk = pk_seed + pk_root
+    return pk, sk_seed + sk_prf + pk
+
+
+def _split_digest(p: SLHDSAParams, digest: bytes) -> tuple[bytes, int, int]:
+    ka = (p.k * p.a + 7) // 8
+    t = (p.h - p.hp + 7) // 8
+    u = (p.hp + 7) // 8
+    md = digest[:ka]
+    idx_tree = int.from_bytes(digest[ka : ka + t], "big") & ((1 << (p.h - p.hp)) - 1)
+    idx_leaf = int.from_bytes(digest[ka + t : ka + t + u], "big") & ((1 << p.hp) - 1)
+    return md, idx_tree, idx_leaf
+
+
+def sign_internal(p: SLHDSAParams, msg: bytes, sk: bytes, addrnd: bytes | None = None) -> bytes:
+    """Algorithm 19 slh_sign_internal (addrnd=None -> deterministic variant)."""
+    sk_seed, sk_prf, pk_seed, pk_root = (
+        sk[: p.n], sk[p.n : 2 * p.n], sk[2 * p.n : 3 * p.n], sk[3 * p.n :]
+    )
+    opt_rand = pk_seed if addrnd is None else addrnd
+    r = prf_msg(p, sk_prf, opt_rand, msg)
+    digest = h_msg(p, r, pk_seed, pk_root, msg)
+    md, idx_tree, idx_leaf = _split_digest(p, digest)
+    adrs = ADRS()
+    adrs.tree = idx_tree
+    adrs.set_type_and_clear(FORS_TREE)
+    adrs.w1 = idx_leaf
+    sig_fors = fors_sign(p, md, sk_seed, pk_seed, adrs)
+    pk_fors = fors_pk_from_sig(p, sig_fors, md, pk_seed, _fors_adrs(idx_tree, idx_leaf))
+    sig_ht = ht_sign(p, pk_fors, sk_seed, pk_seed, idx_tree, idx_leaf)
+    return r + sig_fors + sig_ht
+
+
+def _fors_adrs(tree: int, leaf: int) -> ADRS:
+    a = ADRS()
+    a.tree = tree
+    a.set_type_and_clear(FORS_TREE)
+    a.w1 = leaf
+    return a
+
+
+def verify_internal(p: SLHDSAParams, msg: bytes, sig: bytes, pk: bytes) -> bool:
+    """Algorithm 20 slh_verify_internal."""
+    if len(sig) != p.sig_len or len(pk) != p.pk_len:
+        return False
+    pk_seed, pk_root = pk[: p.n], pk[p.n :]
+    r = sig[: p.n]
+    fors_len = p.k * (1 + p.a) * p.n
+    sig_fors = sig[p.n : p.n + fors_len]
+    sig_ht = sig[p.n + fors_len :]
+    digest = h_msg(p, r, pk_seed, pk_root, msg)
+    md, idx_tree, idx_leaf = _split_digest(p, digest)
+    pk_fors = fors_pk_from_sig(p, sig_fors, md, pk_seed, _fors_adrs(idx_tree, idx_leaf))
+    return ht_verify(p, pk_fors, sig_ht, pk_seed, idx_tree, idx_leaf, pk_root)
+
+
+# -- external API (pure M' = M, matching liboqs SPHINCS+ usage) --------------
+
+
+def sign(p: SLHDSAParams, sk: bytes, message: bytes, addrnd: bytes | None = None) -> bytes:
+    return sign_internal(p, message, sk, addrnd)
+
+
+def verify(p: SLHDSAParams, pk: bytes, message: bytes, sig: bytes) -> bool:
+    try:
+        return verify_internal(p, message, sig, pk)
+    except Exception:
+        return False

@@ -1,0 +1,1 @@
+"""Batched TPU signature implementations (ML-DSA, SPHINCS+)."""

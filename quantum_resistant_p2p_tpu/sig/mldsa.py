@@ -1,0 +1,531 @@
+"""Batched ML-DSA (FIPS 204) in JAX — lattice signatures on the TPU VPU.
+
+TPU-native design
+-----------------
+* q = 8380417 < 2**23, so residues fit int32 but products do not; TPUs have no
+  64-bit lanes.  ``_mm`` performs modular multiplication via a Horner split of
+  one operand into 8-bit limbs: every intermediate stays below 2**31, all in
+  int32 — no 64-bit emulation, fully vectorised.
+* The signing rejection loop (reference behavior: liboqs ML-DSA via
+  crypto/signatures.py:157; spec loop in pyref.mldsa_ref.sign_internal) is a
+  ``lax.while_loop`` over whole *batches* with per-lane done masks and
+  per-lane kappa counters: lanes that already produced a valid signature keep
+  their result via ``jnp.where`` while stragglers retry, reproducing each
+  lane's serial kappa sequence exactly (bit-exact vs the oracle).
+* SampleInBall's data-dependent Fisher–Yates is a fixed 1024-step ``lax.scan``
+  over the SHAKE buffer bytes, maintaining (c, i, sign-bit index) state — same
+  fixed-buffer convention as the pyref oracle.
+* ExpandA / ExpandS rejection sampling uses the same fixed-squeeze +
+  stable-argsort compaction trick as kem.mlkem.sample_ntt.
+* Variable-length messages are hashed to ``mu = SHAKE256(tr||M', 64)``
+  host-side (cheap, public data); the device kernels take fixed-shape mu
+  batches.  Key-dependent NTTs (A_hat, s1_hat, s2_hat, t0_hat) are hoisted out
+  of the per-message batch and computed once per key.
+
+Bit-exactness oracle: ``pyref.mldsa_ref`` (tests/test_mldsa.py).
+Replaces (reference): MLDSASignature's per-call liboqs objects
+(crypto/signatures.py:58-188, vendor/oqs.py:506-583).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import keccak
+from ..pyref.mldsa_ref import (
+    D,
+    MLDSA44,
+    MLDSA65,
+    MLDSA87,
+    MLDSAParams,
+    PARAMS,
+    ZETAS,
+)
+
+Q = 8380417
+N = 256
+_N_INV = pow(256, -1, Q)
+_ZETAS = np.asarray(ZETAS, dtype=np.int32)
+
+MAX_SIGN_ITERS = 128  # P[a lane needs >128 attempts] < 1e-12 (avg ~4-6 attempts)
+
+# --------------------------------------------------------------------------
+# int32 modular arithmetic without 64-bit lanes
+# --------------------------------------------------------------------------
+
+
+def _mm(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(a * b) mod q for a, b int32 in [0, q); all intermediates < 2**31.
+
+    Horner over 8-bit limbs of b: a*b2 < 2**30, r<<8 < 2**31, a*b_i < 2**31.
+    """
+    b2 = b >> 16
+    b1 = (b >> 8) & 0xFF
+    b0 = b & 0xFF
+    r = (a * b2) % Q
+    r = (((r << 8) % Q) + (a * b1) % Q) % Q
+    r = (((r << 8) % Q) + (a * b0) % Q) % Q
+    return r
+
+
+def _center(x: jax.Array, m: int = Q) -> jax.Array:
+    """mod± representative in (-m/2, m/2]."""
+    x = x % m
+    return jnp.where(x > m // 2, x - m, x)
+
+
+# --------------------------------------------------------------------------
+# NTT over Z_q[X]/(X^256+1) (FIPS 204 §7.5) — complete 256-point transform
+# --------------------------------------------------------------------------
+
+
+def ntt(f: jax.Array) -> jax.Array:
+    """(..., 256) int32 in [0,q) -> NTT domain."""
+    zetas = jnp.asarray(_ZETAS)
+    k = 1
+    length = 128
+    while length >= 1:
+        groups = N // (2 * length)
+        z = zetas[k : k + groups]
+        fr = f.reshape(f.shape[:-1] + (groups, 2, length))
+        f0, f1 = fr[..., 0, :], fr[..., 1, :]
+        t = _mm(jnp.broadcast_to(z[:, None], f1.shape), f1)
+        f = jnp.stack([(f0 + t) % Q, (f0 - t) % Q], axis=-2).reshape(f.shape)
+        k += groups
+        length //= 2
+    return f
+
+
+def ntt_inv(f: jax.Array) -> jax.Array:
+    zetas = jnp.asarray(_ZETAS)
+    k = 255
+    length = 1
+    while length <= 128:
+        groups = N // (2 * length)
+        z = zetas[k - groups + 1 : k + 1][::-1]
+        fr = f.reshape(f.shape[:-1] + (groups, 2, length))
+        f0, f1 = fr[..., 0, :], fr[..., 1, :]
+        s = (f0 + f1) % Q
+        t = _mm(jnp.broadcast_to(z[:, None], f1.shape), (f1 - f0) % Q)
+        f = jnp.stack([s, t], axis=-2).reshape(f.shape)
+        k -= groups
+        length *= 2
+    return _mm(f, jnp.asarray(np.int32(_N_INV)))
+
+
+def pw_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    a, b = jnp.broadcast_arrays(a, b)
+    return _mm(a, b)
+
+
+# --------------------------------------------------------------------------
+# Bit packing (FIPS 204 §7.1), batched
+# --------------------------------------------------------------------------
+
+
+def simple_bit_pack(vals: jax.Array, bits: int) -> jax.Array:
+    """(..., 256) int32 in [0, 2^bits) -> (..., 32*bits) uint8, LSB-first."""
+    b = (vals[..., :, None] >> jnp.arange(bits)) & 1
+    b = b.reshape(vals.shape[:-1] + (32 * bits, 8))
+    return jnp.sum(b << jnp.arange(8), axis=-1).astype(jnp.uint8)
+
+
+def simple_bit_unpack(b: jax.Array, bits: int) -> jax.Array:
+    """(..., 32*bits) uint8 -> (..., 256) int32."""
+    x = (b[..., :, None].astype(jnp.int32) >> jnp.arange(8)) & 1
+    x = x.reshape(b.shape[:-1] + (N, bits))
+    return jnp.sum(x << jnp.arange(bits), axis=-1)
+
+
+def bit_pack(vals: jax.Array, up: int, bits: int) -> jax.Array:
+    return simple_bit_pack((up - _center(vals)), bits)
+
+
+def bit_unpack(b: jax.Array, up: int, bits: int) -> jax.Array:
+    return (up - simple_bit_unpack(b, bits)) % Q
+
+
+# --------------------------------------------------------------------------
+# Rounding (FIPS 204 §7.4), batched
+# --------------------------------------------------------------------------
+
+
+def power2round(r: jax.Array) -> tuple[jax.Array, jax.Array]:
+    r = r % Q
+    r0 = _center(r, 1 << D)
+    return (r - r0) >> D, r0
+
+
+def decompose(p: MLDSAParams, r: jax.Array) -> tuple[jax.Array, jax.Array]:
+    alpha = 2 * p.gamma2
+    r = r % Q
+    r0 = _center(r, alpha)
+    wrap = (r - r0) == (Q - 1)
+    r1 = jnp.where(wrap, 0, (r - r0) // alpha)
+    r0 = jnp.where(wrap, r0 - 1, r0)
+    return r1, r0
+
+
+def use_hint(p: MLDSAParams, h: jax.Array, r: jax.Array) -> jax.Array:
+    m = (Q - 1) // (2 * p.gamma2)
+    r1, r0 = decompose(p, r)
+    up = jnp.where(r0 > 0, (r1 + 1) % m, (r1 - 1) % m)
+    return jnp.where(h != 0, up, r1)
+
+
+# --------------------------------------------------------------------------
+# Samplers (FIPS 204 §7.3), batched fixed-shape
+# --------------------------------------------------------------------------
+
+_REJ_NTT_BYTES = 168 * 7  # 392 candidates for 256 slots (matches oracle buffer)
+_REJ_BOUNDED_BYTES = 136 * 4  # 1088 nibbles for 256 slots
+
+
+def rej_ntt_poly(seeds: jax.Array) -> jax.Array:
+    """(..., 34) uint8 -> (..., 256) int32 NTT-domain uniform polys."""
+    buf = keccak.shake128(seeds, _REJ_NTT_BYTES).astype(jnp.int32)
+    t = buf.reshape(buf.shape[:-1] + (-1, 3))
+    cand = t[..., 0] | (t[..., 1] << 8) | ((t[..., 2] & 0x7F) << 16)
+    reject = (cand >= Q).astype(jnp.int8)
+    order = jnp.argsort(reject, axis=-1, stable=True)
+    return jnp.take_along_axis(cand, order, axis=-1)[..., :N]
+
+
+def rej_bounded_poly(eta: int, seeds: jax.Array) -> jax.Array:
+    """(..., 66) uint8 -> (..., 256) int32 coefficients in {q-eta..q+eta mod q}."""
+    buf = keccak.shake256(seeds, _REJ_BOUNDED_BYTES).astype(jnp.int32)
+    z = jnp.stack([buf & 0xF, buf >> 4], axis=-1).reshape(buf.shape[:-1] + (-1,))
+    if eta == 2:
+        ok = z < 15
+        val = (2 - z % 5) % Q
+    else:
+        ok = z < 9
+        val = (4 - z) % Q
+    order = jnp.argsort(jnp.logical_not(ok).astype(jnp.int8), axis=-1, stable=True)
+    return jnp.take_along_axis(val, order, axis=-1)[..., :N]
+
+
+def expand_a(p: MLDSAParams, rho: jax.Array) -> jax.Array:
+    """rho (..., 32) -> A_hat (..., k, l, 256); A[r,s] = RejNTTPoly(rho||s||r)."""
+    sr = np.array([[s, r] for r in range(p.k) for s in range(p.l)], dtype=np.uint8)
+    rho_rep = jnp.broadcast_to(rho[..., None, :], rho.shape[:-1] + (p.k * p.l, 32))
+    sr_rep = jnp.broadcast_to(jnp.asarray(sr), rho.shape[:-1] + (p.k * p.l, 2))
+    a = rej_ntt_poly(jnp.concatenate([rho_rep, sr_rep], axis=-1))
+    return a.reshape(rho.shape[:-1] + (p.k, p.l, N))
+
+
+def expand_s(p: MLDSAParams, rhop: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """rhop (..., 64) -> s1 (..., l, 256), s2 (..., k, 256)."""
+    total = p.l + p.k
+    n16 = np.zeros((total, 2), dtype=np.uint8)
+    n16[:, 0] = np.arange(total) & 0xFF
+    rep = jnp.broadcast_to(rhop[..., None, :], rhop.shape[:-1] + (total, 64))
+    seeds = jnp.concatenate(
+        [rep, jnp.broadcast_to(jnp.asarray(n16), rhop.shape[:-1] + (total, 2))], axis=-1
+    )
+    s = rej_bounded_poly(p.eta, seeds)
+    return s[..., : p.l, :], s[..., p.l :, :]
+
+
+def expand_mask(p: MLDSAParams, rhopp: jax.Array, kappa: jax.Array) -> jax.Array:
+    """rhopp (..., 64), kappa (...,) int32 -> y (..., l, 256).
+
+    kappa is traced data (per-lane counters differ), so the 2-byte LE suffix is
+    built from arithmetic on the traced value.
+    """
+    kr = kappa[..., None] + jnp.arange(p.l)  # (..., l)
+    suffix = jnp.stack([kr & 0xFF, (kr >> 8) & 0xFF], axis=-1).astype(jnp.uint8)
+    rep = jnp.broadcast_to(rhopp[..., None, :], rhopp.shape[:-1] + (p.l, 64))
+    buf = keccak.shake256(jnp.concatenate([rep, suffix], axis=-1), 32 * p.z_bits)
+    return bit_unpack(buf, p.gamma1, p.z_bits)
+
+
+_BALL_BYTES = 8 + 1024  # fixed SHAKE squeeze, same convention as the oracle
+
+
+def sample_in_ball(p: MLDSAParams, ctilde: jax.Array) -> jax.Array:
+    """(..., lambda/4) uint8 -> (..., 256) int32 with tau ±1 coefficients.
+
+    Fixed 1024-step scan over the rejection bytes: state (c, i, nacc); a byte
+    j is consumed as a swap position when i < N and j <= i.
+    """
+    buf = keccak.shake256(ctilde, _BALL_BYTES)
+    signs = buf[..., :8]
+    # 64 sign bits as two uint32 words
+    s_lo = jnp.sum(
+        signs[..., :4].astype(jnp.uint32) << (8 * jnp.arange(4, dtype=jnp.uint32)), axis=-1
+    )
+    s_hi = jnp.sum(
+        signs[..., 4:8].astype(jnp.uint32) << (8 * jnp.arange(4, dtype=jnp.uint32)), axis=-1
+    )
+    rejb = buf[..., 8:].astype(jnp.int32)
+    batch = ctilde.shape[:-1]
+
+    c0 = jnp.zeros(batch + (N,), dtype=jnp.int32)
+    i0 = jnp.full(batch, N - p.tau, dtype=jnp.int32)
+    nacc0 = jnp.zeros(batch, dtype=jnp.int32)
+
+    def step(state, j):
+        c, i, nacc = state
+        take = (i < N) & (j <= i)
+        cj = jnp.take_along_axis(c, j[..., None], axis=-1)[..., 0]
+        bit_word = jnp.where(nacc < 32, s_lo, s_hi)
+        bit = (bit_word >> (nacc % 32).astype(jnp.uint32)) & 1
+        sign_val = jnp.where(bit == 0, 1, Q - 1).astype(jnp.int32)
+        # c[i] = c[j]; c[j] = sign — only where take
+        iw = jnp.where(take, i, N)  # N = out-of-range sentinel (dropped)
+        jw = jnp.where(take, j, N)
+        cpad = jnp.concatenate([c, jnp.zeros(batch + (1,), jnp.int32)], axis=-1)
+        cpad = jnp.put_along_axis(cpad, iw[..., None], cj[..., None], axis=-1, inplace=False)
+        cpad = jnp.put_along_axis(cpad, jw[..., None], sign_val[..., None], axis=-1, inplace=False)
+        c = cpad[..., :N]
+        i = jnp.where(take, i + 1, i)
+        nacc = jnp.where(take, nacc + 1, nacc)
+        return (c, i, nacc), None
+
+    (c, _, _), _ = lax.scan(step, (c0, i0, nacc0), jnp.moveaxis(rejb, -1, 0))
+    return c
+
+
+# --------------------------------------------------------------------------
+# Hint packing (FIPS 204 §7.1 HintBitPack / HintBitUnpack), batched
+# --------------------------------------------------------------------------
+
+
+def hint_bit_pack(p: MLDSAParams, h: jax.Array) -> jax.Array:
+    """h (..., k, 256) in {0,1} -> (..., omega + k) uint8."""
+    batch = h.shape[:-2]
+    # positions of ones within each row, compacted to the front (stable order)
+    order = jnp.argsort(1 - h, axis=-1, stable=True)  # ones first, index order
+    counts = jnp.sum(h, axis=-1)  # (..., k)
+    ends = jnp.cumsum(counts, axis=-1)  # running totals -> trailing bytes
+    starts = ends - counts
+    npos = jnp.arange(N)
+    valid = npos < counts[..., None]  # (..., k, 256)
+    dest = jnp.where(valid, starts[..., None] + npos, p.omega + p.k)  # sentinel: dropped
+    out = jnp.zeros(batch + (p.omega + p.k + 1,), dtype=jnp.int32)
+    out = out.at[..., p.omega : p.omega + p.k].set(ends.astype(jnp.int32))
+    flat_dest = dest.reshape(batch + (-1,))
+    flat_val = jnp.where(valid, order, 0).reshape(batch + (-1,))
+    out = jnp.put_along_axis(out, flat_dest, flat_val, axis=-1, inplace=False)
+    return out[..., : p.omega + p.k].astype(jnp.uint8)
+
+
+def hint_bit_unpack(p: MLDSAParams, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(..., omega + k) uint8 -> (h (..., k, 256), ok (...,) bool)."""
+    pos = b[..., : p.omega].astype(jnp.int32)  # (..., omega)
+    ends = b[..., p.omega :].astype(jnp.int32)  # (..., k)
+    starts = jnp.concatenate([jnp.zeros_like(ends[..., :1]), ends[..., :-1]], axis=-1)
+    ok = jnp.all(ends >= starts, axis=-1) & jnp.all(ends <= p.omega, axis=-1)
+    widx = jnp.arange(p.omega)
+    in_row = (widx >= starts[..., None]) & (widx < ends[..., None])  # (..., k, omega)
+    # strictly increasing within each row
+    prev_same_row = in_row & (widx > starts[..., None])
+    inc_ok = jnp.where(
+        prev_same_row,
+        pos[..., None, :] > jnp.roll(pos, 1, axis=-1)[..., None, :],
+        True,
+    )
+    ok = ok & jnp.all(inc_ok, axis=(-1, -2))
+    total = ends[..., -1]
+    ok = ok & jnp.all(jnp.where(widx >= total[..., None], pos == 0, True), axis=-1)
+    # scatter ones: h[r, pos[w]] = 1 for w in [starts[r], ends[r])
+    h = jnp.zeros(b.shape[:-1] + (p.k, N + 1), dtype=jnp.int32)
+    dest = jnp.where(in_row, pos[..., None, :], N)  # sentinel column dropped
+    h = jnp.put_along_axis(h, dest, jnp.where(in_row, 1, 0), axis=-1, inplace=False)
+    return h[..., :N], ok
+
+
+# --------------------------------------------------------------------------
+# KeyGen (FIPS 204 Algorithm 6), batched
+# --------------------------------------------------------------------------
+
+
+def _matvec(a_hat: jax.Array, v_hat: jax.Array) -> jax.Array:
+    """(..., k, l, 256) ∘ (..., l, 256) -> (..., k, 256) pointwise-NTT matvec."""
+    return jnp.sum(pw_mul(a_hat, v_hat[..., None, :, :]), axis=-2) % Q
+
+
+def keygen(p: MLDSAParams, xi: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """xi (..., 32) uint8 -> (pk (..., pk_len), sk (..., sk_len)) uint8."""
+    xi = jnp.asarray(xi, jnp.uint8)
+    batch = xi.shape[:-1]
+    kl = jnp.broadcast_to(jnp.asarray([p.k, p.l], jnp.uint8), batch + (2,))
+    seed = keccak.shake256(jnp.concatenate([xi, kl], axis=-1), 128)
+    rho, rhop, cap_k = seed[..., :32], seed[..., 32:96], seed[..., 96:]
+    a_hat = expand_a(p, rho)
+    s1, s2 = expand_s(p, rhop)
+    s1_hat = ntt(s1)
+    t = (ntt_inv(_matvec(a_hat, s1_hat)) + s2) % Q
+    t1, t0 = power2round(t)
+    pk = jnp.concatenate(
+        [rho, simple_bit_pack(t1, 23 - D).reshape(batch + (-1,))], axis=-1
+    )
+    tr = keccak.shake256(pk, 64)
+    sk = jnp.concatenate(
+        [
+            rho,
+            cap_k,
+            tr,
+            bit_pack(s1, p.eta, p.s_bits).reshape(batch + (-1,)),
+            bit_pack(s2, p.eta, p.s_bits).reshape(batch + (-1,)),
+            bit_pack(t0, 1 << (D - 1), D).reshape(batch + (-1,)),
+        ],
+        axis=-1,
+    )
+    return pk, sk
+
+
+# --------------------------------------------------------------------------
+# Sign (FIPS 204 Algorithm 7), batched with masked retry loop
+# --------------------------------------------------------------------------
+
+
+def _unpack_sk(p: MLDSAParams, sk: jax.Array):
+    batch = sk.shape[:-1]
+    rho, cap_k, tr = sk[..., :32], sk[..., 32:64], sk[..., 64:128]
+    off = 128
+    sb = 32 * p.s_bits
+    s1 = bit_unpack(sk[..., off : off + p.l * sb].reshape(batch + (p.l, sb)), p.eta, p.s_bits)
+    off += p.l * sb
+    s2 = bit_unpack(sk[..., off : off + p.k * sb].reshape(batch + (p.k, sb)), p.eta, p.s_bits)
+    off += p.k * sb
+    tb = 32 * D
+    t0 = bit_unpack(
+        sk[..., off : off + p.k * tb].reshape(batch + (p.k, tb)), 1 << (D - 1), D
+    )
+    return rho, cap_k, tr, s1, s2, t0
+
+
+def _inf_norm(x: jax.Array, axes) -> jax.Array:
+    return jnp.max(jnp.abs(_center(x)), axis=axes)
+
+
+def sign_mu(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array) -> jax.Array:
+    """Core of Algorithm 7 given mu = SHAKE256(tr||M', 64).
+
+    sk (..., sk_len), mu (..., 64), rnd (..., 32) -> sigma (..., sig_len).
+    """
+    sk = jnp.asarray(sk, jnp.uint8)
+    mu = jnp.asarray(mu, jnp.uint8)
+    rnd = jnp.asarray(rnd, jnp.uint8)
+    batch = mu.shape[:-1]
+    rho, cap_k, tr, s1, s2, t0 = _unpack_sk(p, sk)
+    del tr
+    a_hat = expand_a(p, rho)
+    s1_hat, s2_hat, t0_hat = ntt(s1), ntt(s2), ntt(t0)
+    rhopp = keccak.shake256(jnp.concatenate([cap_k, rnd, mu], axis=-1), 64)
+
+    zb = 32 * p.z_bits
+    sig_len = p.sig_len
+    done0 = jnp.zeros(batch, dtype=bool)
+    kappa0 = jnp.zeros(batch, dtype=jnp.int32)
+    sig0 = jnp.zeros(batch + (sig_len,), dtype=jnp.uint8)
+
+    def attempt(kappa):
+        """One rejection-loop iteration for every lane; returns (ok, sigma)."""
+        y = expand_mask(p, rhopp, kappa)
+        w = ntt_inv(_matvec(a_hat, ntt(y)))
+        w1, _ = decompose(p, w)
+        w1_enc = simple_bit_pack(w1, p.w1_bits).reshape(batch + (-1,))
+        ctilde = keccak.shake256(
+            jnp.concatenate([mu, w1_enc], axis=-1), p.ctilde_len
+        )
+        c_hat = ntt(sample_in_ball(p, ctilde))
+        cs1 = ntt_inv(pw_mul(c_hat[..., None, :], s1_hat))
+        z = (y + cs1) % Q
+        ok = _inf_norm(z, (-1, -2)) < p.gamma1 - p.beta
+        cs2 = ntt_inv(pw_mul(c_hat[..., None, :], s2_hat))
+        r_minus = (w - cs2) % Q
+        _, r0 = decompose(p, r_minus)
+        ok &= jnp.max(jnp.abs(r0), axis=(-1, -2)) < p.gamma2 - p.beta
+        ct0 = ntt_inv(pw_mul(c_hat[..., None, :], t0_hat))
+        ok &= _inf_norm(ct0, (-1, -2)) < p.gamma2
+        h_arg = (_center(r_minus) + _center(ct0)) % Q
+        hi_with = decompose(p, h_arg)[0]
+        hi_base = decompose(p, r_minus)[0]
+        h = (hi_with != hi_base).astype(jnp.int32)
+        ok &= jnp.sum(h, axis=(-1, -2)) <= p.omega
+        sigma = jnp.concatenate(
+            [
+                ctilde,
+                bit_pack(z, p.gamma1, p.z_bits).reshape(batch + (-1,)),
+                hint_bit_pack(p, h),
+            ],
+            axis=-1,
+        )
+        return ok, sigma
+
+    def cond(state):
+        done, _, _, it = state
+        return (~jnp.all(done)) & (it < MAX_SIGN_ITERS)
+
+    def body(state):
+        done, kappa, sig, it = state
+        ok, sigma = attempt(kappa)
+        newly = (~done) & ok
+        sig = jnp.where(newly[..., None], sigma, sig)
+        kappa = jnp.where(done | ok, kappa, kappa + p.l)
+        done = done | ok
+        return done, kappa, sig, it + 1
+
+    _, _, sig, _ = lax.while_loop(cond, body, (done0, kappa0, sig0, jnp.int32(0)))
+    return sig
+
+
+# --------------------------------------------------------------------------
+# Verify (FIPS 204 Algorithm 8), batched
+# --------------------------------------------------------------------------
+
+
+def verify_mu(p: MLDSAParams, pk: jax.Array, mu: jax.Array, sigma: jax.Array) -> jax.Array:
+    """Core of Algorithm 8 given mu. pk (..., pk_len), mu (..., 64),
+    sigma (..., sig_len) -> bool (...,)."""
+    pk = jnp.asarray(pk, jnp.uint8)
+    mu = jnp.asarray(mu, jnp.uint8)
+    sigma = jnp.asarray(sigma, jnp.uint8)
+    batch = mu.shape[:-1]
+    rho = pk[..., :32]
+    t1 = simple_bit_unpack(
+        pk[..., 32:].reshape(batch + (p.k, 32 * (23 - D))), 23 - D
+    )
+    ctilde = sigma[..., : p.ctilde_len]
+    zb = 32 * p.z_bits
+    off = p.ctilde_len
+    z = bit_unpack(
+        sigma[..., off : off + p.l * zb].reshape(batch + (p.l, zb)), p.gamma1, p.z_bits
+    )
+    h, ok = hint_bit_unpack(p, sigma[..., off + p.l * zb :])
+    ok &= _inf_norm(z, (-1, -2)) < p.gamma1 - p.beta
+    a_hat = expand_a(p, rho)
+    c_hat = ntt(sample_in_ball(p, ctilde))
+    az = _matvec(a_hat, ntt(z))
+    t1_shift = (t1.astype(jnp.int32) << D) % Q
+    ct1 = pw_mul(c_hat[..., None, :], ntt(t1_shift))
+    w_approx = ntt_inv((az - ct1) % Q)
+    w1 = use_hint(p, h, w_approx)
+    w1_enc = simple_bit_pack(w1, p.w1_bits).reshape(batch + (-1,))
+    ctilde2 = keccak.shake256(jnp.concatenate([mu, w1_enc], axis=-1), p.ctilde_len)
+    ok &= jnp.all(ctilde == ctilde2, axis=-1)
+    return ok
+
+
+# --------------------------------------------------------------------------
+# Jitted per-parameter-set entry points
+# --------------------------------------------------------------------------
+
+
+@functools.cache
+def get(name: str):
+    """Jitted (keygen, sign_mu, verify_mu) triple for a parameter-set name."""
+    p = PARAMS[name]
+    return (
+        jax.jit(functools.partial(keygen, p)),
+        jax.jit(functools.partial(sign_mu, p)),
+        jax.jit(functools.partial(verify_mu, p)),
+    )

@@ -1,0 +1,1 @@
+"""Host-side utilities: profiling/tracing hooks."""

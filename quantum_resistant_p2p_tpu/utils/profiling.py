@@ -1,0 +1,71 @@
+"""Tracing / profiling hooks — the observability layer SURVEY.md §5 notes the
+reference lacks (its only timing is ad-hoc time.time() deltas in the test
+harness).
+
+Two tools:
+* ``device_trace``: context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable trace of the batched crypto dispatches.
+* ``LatencyHistogram``: lock-free-ish percentile tracker used by the batch
+  queue stats and the swarm benchmark.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str = "/tmp/qrp2p_trace"):
+    """Profile everything inside the block; view with TensorBoard."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class LatencyHistogram:
+    """Bounded sorted sample reservoir with percentile queries."""
+
+    def __init__(self, cap: int = 10000):
+        self.cap = cap
+        self._sorted: list[float] = []
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        if len(self._sorted) < self.cap:
+            bisect.insort(self._sorted, seconds)
+        else:  # reservoir: replace a deterministic slot to stay bounded
+            idx = self.count % self.cap
+            del self._sorted[idx]
+            bisect.insort(self._sorted, seconds)
+
+    @contextlib.contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(time.perf_counter() - t0)
+
+    def percentile(self, p: float) -> float | None:
+        if not self._sorted:
+            return None
+        idx = min(len(self._sorted) - 1, int(p / 100.0 * len(self._sorted)))
+        return self._sorted[idx]
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_s": self.total / self.count if self.count else None,
+            "p50_s": self.percentile(50),
+            "p95_s": self.percentile(95),
+            "p99_s": self.percentile(99),
+        }

@@ -1,13 +1,30 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+"""Test configuration: force an 8-device virtual CPU mesh before JAX use.
 
 Real-TPU execution is exercised by bench.py / __graft_entry__.py (run by the
 driver); the test suite runs on a virtual 8-device CPU platform so sharding
 paths (pjit over a Mesh) are testable without multi-chip hardware.
+
+Note: this environment's TPU bootstrap (sitecustomize) force-prepends the
+remote-TPU platform to ``jax.config.jax_platforms`` regardless of the
+JAX_PLATFORMS env var, so the config must be overridden explicitly — env vars
+alone are ignored.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the crypto kernels are compile-heavy; caching
+# cuts repeat suite runs from tens of minutes to minutes.  Set via config (not
+# env): this image's TPU bootstrap imports jax at interpreter start, before
+# conftest env vars could be seen.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_qrp2p")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

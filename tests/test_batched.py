@@ -1,0 +1,88 @@
+"""BatchedProvider: futures resolve correctly, ops coalesce into batches."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.provider import get_kem, get_signature
+from quantum_resistant_p2p_tpu.provider.batched import BatchedKEM, BatchedSignature, OpQueue
+
+
+def test_opqueue_coalesces_and_resolves():
+    calls = []
+
+    def batch_fn(items):
+        calls.append(len(items))
+        return [x * 2 for x in items]
+
+    async def run():
+        q = OpQueue(batch_fn, max_batch=64, max_wait_ms=5.0)
+        outs = await asyncio.gather(*(q.submit(i) for i in range(10)))
+        return outs
+
+    outs = asyncio.run(run())
+    assert outs == [i * 2 for i in range(10)]
+    assert sum(calls) == 10
+    assert len(calls) <= 2  # coalesced, not one flush per op
+
+
+def test_opqueue_max_batch_triggers_immediate_flush():
+    calls = []
+
+    def batch_fn(items):
+        calls.append(len(items))
+        return items
+
+    async def run():
+        q = OpQueue(batch_fn, max_batch=4, max_wait_ms=1000.0)  # rely on size trigger
+        await asyncio.gather(*(q.submit(i) for i in range(8)))
+
+    asyncio.run(run())
+    assert calls and max(calls) <= 4 and sum(calls) == 8
+
+
+def test_opqueue_propagates_errors():
+    def batch_fn(items):
+        raise RuntimeError("boom")
+
+    async def run():
+        q = OpQueue(batch_fn, max_batch=4, max_wait_ms=1.0)
+        with pytest.raises(RuntimeError):
+            await q.submit(1)
+
+    asyncio.run(run())
+
+
+def test_batched_kem_end_to_end():
+    kem = BatchedKEM(get_kem("ML-KEM-768", backend="tpu"), max_batch=8, max_wait_ms=2.0)
+
+    async def run():
+        pairs = await asyncio.gather(*(kem.generate_keypair() for _ in range(4)))
+        encs = await asyncio.gather(*(kem.encapsulate(pk) for pk, _ in pairs))
+        decs = await asyncio.gather(
+            *(kem.decapsulate(sk, ct) for (_, sk), (ct, _) in zip(pairs, encs))
+        )
+        return encs, decs
+
+    encs, decs = asyncio.run(run())
+    for (ct, ss), ss2 in zip(encs, decs):
+        assert ss == ss2
+    st = kem.stats()
+    assert st["encaps"]["ops"] == 4 and st["encaps"]["flushes"] >= 1
+
+
+def test_batched_signature_end_to_end():
+    sig = BatchedSignature(get_signature("ML-DSA-44", backend="tpu"),
+                           max_batch=8, max_wait_ms=2.0)
+    pk, sk = sig.algo.generate_keypair()
+
+    async def run():
+        msgs = [b"m%d" % i for i in range(3)]
+        sigs = await asyncio.gather(*(sig.sign(sk, m) for m in msgs))
+        oks = await asyncio.gather(*(sig.verify(pk, m, s) for m, s in zip(msgs, sigs)))
+        bad = await sig.verify(pk, b"other", sigs[0])
+        return oks, bad
+
+    oks, bad = asyncio.run(run())
+    assert all(oks) and not bad

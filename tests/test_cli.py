@@ -1,0 +1,78 @@
+"""CLI: login gate + command surface driven programmatically (no stdin)."""
+
+import asyncio
+import io
+
+import pytest
+
+from quantum_resistant_p2p_tpu.cli import CLI
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+def _mk(tmp_path, name, port=0):
+    out = io.StringIO()
+    cli = CLI(
+        vault_path=str(tmp_path / f"{name}.vault.json"),
+        port=port,
+        backend="cpu",
+        enable_discovery=False,
+        out=out,
+    )
+    assert cli.login("pw-" + name)
+    return cli, out
+
+
+def test_two_clis_chat(run, tmp_path):
+    async def main():
+        a, a_out = _mk(tmp_path, "a")
+        b, b_out = _mk(tmp_path, "b")
+        await a.start()
+        await b.start()
+        assert await a.handle(f"/connect 127.0.0.1 {b.node.port}")
+        await asyncio.sleep(0.05)
+        peer_b = a.node.get_peers()[0]
+        assert await a.handle(f"/key {peer_b[:8]}")
+        assert "shared key established" in a_out.getvalue()
+        assert await a.handle(f"/send {peer_b[:8]} hello from cli")
+        for _ in range(100):
+            if "hello from cli" in b_out.getvalue():
+                break
+            await asyncio.sleep(0.02)
+        assert "hello from cli" in b_out.getvalue()
+
+        # settings / metrics / logs / keyhistory surfaces all respond
+        assert await a.handle("/settings")
+        assert "ML-KEM-768" in a_out.getvalue()
+        assert await a.handle("/metrics")
+        assert await a.handle("/logs")
+        assert "key_exchange" in a_out.getvalue()
+        assert await a.handle("/keyhistory")
+        assert "peer=" in a_out.getvalue()
+        assert await a.handle("/set aead ChaCha20-Poly1305")
+        assert a.messaging.symmetric.name == "ChaCha20-Poly1305"
+        assert await a.handle("/peers")
+        assert not await a.handle("/quit")
+        await b.stop()
+
+    run(main())
+
+
+def test_unknown_command_and_bad_args_keep_repl_alive(run, tmp_path):
+    async def main():
+        a, out = _mk(tmp_path, "solo")
+        await a.start()
+        assert await a.handle("/nope")
+        assert "unknown command" in out.getvalue()
+        assert await a.handle("/connect")  # IndexError -> caught
+        assert "error:" in out.getvalue()
+        assert await a.handle("not-a-command")
+        await a.stop()
+
+    run(main())

@@ -1,0 +1,36 @@
+"""Config precedence: kwargs > env > file > defaults."""
+
+import json
+
+from quantum_resistant_p2p_tpu.config import Config
+
+
+def test_defaults():
+    c = Config.load(path="/nonexistent/config.json")
+    assert c.kem == "ML-KEM-768" and c.backend == "auto" and c.port == 8000
+
+
+def test_file_env_override(tmp_path, monkeypatch):
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps({"kem": "HQC-128", "port": 9000, "unknown_key": 1}))
+    monkeypatch.setenv("QRP2P_PORT", "9100")
+    monkeypatch.setenv("QRP2P_USE_BATCHING", "true")
+    c = Config.load(path=p, port=9200)
+    assert c.kem == "HQC-128"  # file
+    assert c.use_batching is True  # env bool
+    assert c.port == 9200  # kwarg beats env beats file
+
+
+def test_malformed_file_falls_back(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text("{not json")
+    c = Config.load(path=p)
+    assert c.kem == "ML-KEM-768"
+
+
+def test_save_roundtrip(tmp_path):
+    c = Config.load(path="/nonexistent")
+    c.kem = "FrodoKEM-640-AES"
+    out = c.save(tmp_path / "cfg.json")
+    c2 = Config.load(path=out)
+    assert c2.kem == "FrodoKEM-640-AES"

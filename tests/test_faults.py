@@ -1,0 +1,155 @@
+"""Fault injection — what SURVEY.md §5 notes the reference lacks entirely.
+
+Network faults (dropped handshake messages, mid-session disconnect) and
+crypto faults (corrupted encapsulation) injected into the live two-node
+stack; the protocol must fail closed: typed errors / timeouts, no plaintext
+delivery, state reset for retry.
+"""
+
+import asyncio
+
+import pytest
+
+from quantum_resistant_p2p_tpu.app import messaging as messaging_mod
+from quantum_resistant_p2p_tpu.app.messaging import KeyExchangeState, SecureMessaging
+from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+@pytest.fixture(autouse=True)
+def fast_timeout(monkeypatch):
+    monkeypatch.setattr(messaging_mod, "KEY_EXCHANGE_TIMEOUT", 1.5)
+
+
+async def _pair():
+    a_node = P2PNode(node_id="alice", host="127.0.0.1", port=0)
+    b_node = P2PNode(node_id="bob", host="127.0.0.1", port=0)
+    await a_node.start()
+    await b_node.start()
+    a = SecureMessaging(a_node)
+    b = SecureMessaging(b_node)
+    assert await a_node.connect_to_peer("127.0.0.1", b_node.port) == "bob"
+    for _ in range(100):
+        if b_node.is_connected("alice"):
+            break
+        await asyncio.sleep(0.01)
+    return a, b
+
+
+def test_dropped_response_times_out_then_retry_succeeds(run):
+    async def main():
+        a, b = await _pair()
+        # drop bob's ke_response exactly once
+        orig = b.node.send_message
+        dropped = {"n": 0}
+
+        async def flaky(peer_id, msg_type, **kw):
+            if msg_type == "ke_response" and dropped["n"] == 0:
+                dropped["n"] += 1
+                return True  # swallowed by the network
+            return await orig(peer_id, msg_type, **kw)
+
+        b.node.send_message = flaky
+        ok = await a.initiate_key_exchange("bob")
+        assert not ok
+        assert a.ke_state["bob"] is KeyExchangeState.NONE  # reset for retry
+        ok2 = await a.initiate_key_exchange("bob")
+        assert ok2 and a.verify_key_exchange_state("bob")
+        await a.node.stop()
+        await b.node.stop()
+
+    run(main())
+
+
+def test_disconnect_mid_session_fails_closed(run):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        await b.node.stop()
+        for _ in range(100):
+            if not a.node.is_connected("bob"):
+                break
+            await asyncio.sleep(0.02)
+        assert not a.verify_key_exchange_state("bob")  # liveness check fails
+        sent = await a.send_message("bob", b"into the void")
+        assert sent is None
+        await a.node.stop()
+
+    run(main())
+
+
+def test_corrupted_encapsulation_never_delivers_plaintext(run):
+    """KAT-failure injection: the responder's encapsulation is corrupted in
+    flight; both sides end with different keys and no message decrypts."""
+
+    async def main():
+        a, b = await _pair()
+        orig = b.node.send_message
+
+        async def corrupt(peer_id, msg_type, **kw):
+            if msg_type == "ke_response":
+                ct = bytearray(bytes.fromhex(kw["ke_data"]["ciphertext"]))
+                ct[0] ^= 0xFF
+                kw["ke_data"]["ciphertext"] = bytes(ct).hex()
+                # signature now stale -> alice must reject it
+            return await orig(peer_id, msg_type, **kw)
+
+        b.node.send_message = corrupt
+        ok = await a.initiate_key_exchange("bob")
+        assert not ok  # invalid signature on the tampered response
+        assert "bob" not in a.shared_keys or a.shared_keys.get("bob") != b.shared_keys.get("alice")
+        await a.node.stop()
+        await b.node.stop()
+
+    run(main())
+
+
+def test_replayed_init_rejected(run):
+    """Replay window: a ke_init with an old timestamp is rejected typed."""
+
+    async def main():
+        a, b = await _pair()
+        rejections = []
+
+        async def on_reject(peer_id, msg):
+            rejections.append(msg.get("reason"))
+
+        a.node.register_message_handler("ke_reject", on_reject)
+        import json
+        import time
+        import uuid
+
+        pk, _ = a.kem.generate_keypair()
+        stale = {
+            "message_id": str(uuid.uuid4()),
+            "kem": a.kem.name,
+            "aead": a.symmetric.name,
+            "public_key": pk.hex(),
+            "sender": "alice",
+            "recipient": "bob",
+            "timestamp": time.time() - 3600,
+        }
+        sig = a.signature.sign(
+            a._sig_keypair[1],
+            json.dumps(stale, sort_keys=True, separators=(",", ":")).encode(),
+        )
+        await a.node.send_message(
+            "bob", "ke_init", ke_data=stale, sig=sig,
+            sig_algo=a.signature.name, sig_pk=a._sig_keypair[0],
+        )
+        for _ in range(100):
+            if rejections:
+                break
+            await asyncio.sleep(0.02)
+        assert rejections == ["timestamp_invalid"]
+        await a.node.stop()
+        await b.node.stop()
+
+    run(main())

@@ -1,0 +1,82 @@
+"""FrodoKEM batched JAX vs pure-Python oracle + AES kernel checks."""
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.pyref import frodo_ref as fr
+
+RNG = np.random.default_rng(640)
+
+
+def test_aes_kernel_matches_cryptography():
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    from quantum_resistant_p2p_tpu.core import aes as jaes
+
+    keys = RNG.integers(0, 256, size=(3, 16), dtype=np.uint8)
+    blocks = RNG.integers(0, 256, size=(3, 5, 16), dtype=np.uint8)
+    rk = jaes.key_schedule(keys)
+    out = np.asarray(jaes.encrypt_blocks(rk, blocks))
+    for i in range(3):
+        enc = Cipher(algorithms.AES(keys[i].tobytes()), modes.ECB()).encryptor()
+        ref = enc.update(blocks[i].tobytes())
+        assert out[i].tobytes() == ref
+
+
+@pytest.mark.parametrize("name", ["FrodoKEM-640-AES", "FrodoKEM-640-SHAKE"])
+def test_matches_oracle(name):
+    from quantum_resistant_p2p_tpu.kem import frodo as jfr
+
+    p = fr.PARAMS[name]
+    batch = 2
+    kg, enc, dec = jfr.get(name)
+    s = RNG.integers(0, 256, size=(batch, p.len_sec), dtype=np.uint8)
+    se = RNG.integers(0, 256, size=(batch, p.len_sec), dtype=np.uint8)
+    z = RNG.integers(0, 256, size=(batch, p.len_sec), dtype=np.uint8)
+    mu = RNG.integers(0, 256, size=(batch, p.len_sec), dtype=np.uint8)
+    pk, sk = np.asarray(kg(s, se, z)[0]), np.asarray(kg(s, se, z)[1])
+    ct, ss = enc(pk, mu)
+    ct, ss = np.asarray(ct), np.asarray(ss)
+    ss_dec = np.asarray(dec(sk, ct))
+    for i in range(batch):
+        rpk, rsk = fr.keygen(p, s[i].tobytes(), se[i].tobytes(), z[i].tobytes())
+        assert bytes(pk[i]) == rpk
+        assert bytes(sk[i]) == rsk
+        rct, rss = fr.encaps(p, rpk, mu[i].tobytes())
+        assert bytes(ct[i]) == rct
+        assert bytes(ss[i]) == rss
+        assert bytes(ss_dec[i]) == rss
+    # implicit rejection on tampered ct
+    bad = ct.copy()
+    bad[:, 3] ^= 0xFF
+    ss_bad = np.asarray(dec(sk, bad))
+    assert not (ss_bad == ss).all(axis=-1).any()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["FrodoKEM-976-SHAKE", "FrodoKEM-1344-AES"])
+def test_large_sets_roundtrip(name):
+    """976/1344: JAX self-consistency (pyref too slow at these sizes)."""
+    from quantum_resistant_p2p_tpu.kem import frodo as jfr
+
+    p = fr.PARAMS[name]
+    kg, enc, dec = jfr.get(name)
+    s = RNG.integers(0, 256, size=(1, p.len_sec), dtype=np.uint8)
+    se = RNG.integers(0, 256, size=(1, p.len_sec), dtype=np.uint8)
+    z = RNG.integers(0, 256, size=(1, p.len_sec), dtype=np.uint8)
+    mu = RNG.integers(0, 256, size=(1, p.len_sec), dtype=np.uint8)
+    pk, sk = kg(s, se, z)
+    assert pk.shape[-1] == p.pk_len and sk.shape[-1] == p.sk_len
+    ct, ss = enc(np.asarray(pk), mu)
+    assert ct.shape[-1] == p.ct_len
+    assert (np.asarray(dec(np.asarray(sk), np.asarray(ct))) == np.asarray(ss)).all()
+
+
+def test_provider_cross_backend():
+    from quantum_resistant_p2p_tpu.provider import get_kem
+
+    tpu = get_kem("FrodoKEM-640-AES", backend="tpu")
+    cpu = get_kem("FrodoKEM-640-AES", backend="cpu")
+    pk, sk = tpu.generate_keypair()
+    ct, ss = cpu.encapsulate(pk)
+    assert tpu.decapsulate(sk, ct) == ss

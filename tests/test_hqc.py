@@ -1,0 +1,58 @@
+"""HQC: batched JAX vs pure-Python oracle (bit-exact) + KEM properties."""
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.pyref import hqc_ref as hq
+
+RNG = np.random.default_rng(17669)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["HQC-128", pytest.param("HQC-192", marks=pytest.mark.slow)],
+)
+def test_matches_oracle(name):
+    from quantum_resistant_p2p_tpu.kem import hqc as jhq
+
+    p = hq.PARAMS[name]
+    batch = 2
+    kg, enc, dec = jhq.get(name)
+    sk_seed = RNG.integers(0, 256, size=(batch, 40), dtype=np.uint8)
+    sigma = RNG.integers(0, 256, size=(batch, p.k), dtype=np.uint8)
+    pk_seed = RNG.integers(0, 256, size=(batch, 40), dtype=np.uint8)
+    m = RNG.integers(0, 256, size=(batch, p.k), dtype=np.uint8)
+    salt = RNG.integers(0, 256, size=(batch, 16), dtype=np.uint8)
+    pk, sk = kg(sk_seed, sigma, pk_seed)
+    pk, sk = np.asarray(pk), np.asarray(sk)
+    ct, ss = enc(pk, m, salt)
+    ct, ss = np.asarray(ct), np.asarray(ss)
+    ss_dec = np.asarray(dec(sk, ct))
+    for i in range(batch):
+        rpk, rsk = hq.keygen(p, sk_seed[i].tobytes(), sigma[i].tobytes(), pk_seed[i].tobytes())
+        assert bytes(pk[i]) == rpk
+        assert bytes(sk[i]) == rsk
+        rct, rss = hq.encaps(p, rpk, m[i].tobytes(), salt[i].tobytes())
+        assert bytes(ct[i]) == rct
+        assert bytes(ss[i]) == rss
+        assert bytes(ss_dec[i]) == rss
+    # implicit rejection
+    bad = ct.copy()
+    bad[:, 7] ^= 0xFF
+    assert not (np.asarray(dec(sk, bad)) == ss).all(axis=-1).any()
+
+
+@pytest.mark.slow
+def test_hqc256_roundtrip_jax():
+    from quantum_resistant_p2p_tpu.kem import hqc as jhq
+
+    p = hq.PARAMS["HQC-256"]
+    kg, enc, dec = jhq.get("HQC-256")
+    sk_seed = RNG.integers(0, 256, size=(1, 40), dtype=np.uint8)
+    sigma = RNG.integers(0, 256, size=(1, p.k), dtype=np.uint8)
+    pk_seed = RNG.integers(0, 256, size=(1, 40), dtype=np.uint8)
+    m = RNG.integers(0, 256, size=(1, p.k), dtype=np.uint8)
+    salt = RNG.integers(0, 256, size=(1, 16), dtype=np.uint8)
+    pk, sk = kg(sk_seed, sigma, pk_seed)
+    ct, ss = enc(np.asarray(pk), m, salt)
+    assert (np.asarray(dec(np.asarray(sk), np.asarray(ct))) == np.asarray(ss)).all()

@@ -1,0 +1,20 @@
+"""Pallas Keccak kernel (interpret mode) vs the jnp implementation."""
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.core import keccak as jk
+from quantum_resistant_p2p_tpu.core import keccak_pallas as kp
+
+pytestmark = pytest.mark.skipif(not kp._HAVE_PALLAS, reason="no pallas")
+
+
+@pytest.mark.parametrize("batch", [1, 128, 200])
+def test_matches_jnp(batch):
+    rng = np.random.default_rng(batch)
+    hi = rng.integers(0, 2**32, size=(batch, 25), dtype=np.uint32)
+    lo = rng.integers(0, 2**32, size=(batch, 25), dtype=np.uint32)
+    ph, plo = kp.keccak_f1600(hi, lo, interpret=True)
+    jh, jlo = jk.keccak_f1600(hi, lo)
+    assert (np.asarray(ph) == np.asarray(jh)).all()
+    assert (np.asarray(plo) == np.asarray(jlo)).all()

@@ -177,3 +177,30 @@ def test_message_store():
     assert store.get_messages("a")[0].content == b"x"
     d = m.to_dict()
     assert Message.from_dict(d).content == b"x"
+
+
+def test_handshake_with_batched_tpu_provider(run, tmp_path):
+    """North-star path: handshake crypto routed through the batch queue."""
+
+    async def main():
+        kw = dict(backend="tpu", use_batching=True, max_batch=64, max_wait_ms=2.0)
+        a, b = await _connected_pair(tmp_path, **kw)
+        assert a.messaging._bkem is not None
+        ok = await a.messaging.initiate_key_exchange("bob")
+        assert ok
+        assert a.messaging.shared_keys["bob"] == b.messaging.shared_keys["alice"]
+        assert await a.messaging.send_message("bob", b"batched hello") is not None
+        for _ in range(200):
+            if any(m.content == b"batched hello" for _, m in b.inbox):
+                break
+            await asyncio.sleep(0.02)
+        assert any(m.content == b"batched hello" for _, m in b.inbox)
+        # the queue actually coalesced device work
+        st = a.messaging._bkem.stats()
+        assert st["keygen"]["ops"] >= 1
+        sig_st = a.messaging._bsig.stats()
+        assert sig_st["sign"]["ops"] >= 2  # ke_init + confirm + message
+        await a.stop()
+        await b.stop()
+
+    run(main())

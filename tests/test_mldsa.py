@@ -1,0 +1,94 @@
+"""ML-DSA: batched JAX implementation bit-exact vs the pure-Python oracle."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.pyref import mldsa_ref
+from quantum_resistant_p2p_tpu.sig import mldsa as jmldsa
+
+RNG = np.random.default_rng(20260729)
+
+
+def _mu(tr: bytes, message: bytes) -> bytes:
+    return hashlib.shake_256(tr + bytes([0, 0]) + message).digest(64)
+
+
+@pytest.mark.parametrize("name", ["ML-DSA-44", "ML-DSA-65", "ML-DSA-87"])
+def test_keygen_matches_oracle(name):
+    p = mldsa_ref.PARAMS[name]
+    xi = RNG.integers(0, 256, size=(3, 32), dtype=np.uint8)
+    kg, _, _ = jmldsa.get(name)
+    pk, sk = kg(xi)
+    for i in range(3):
+        rpk, rsk = mldsa_ref.keygen(p, xi[i].tobytes())
+        assert bytes(np.asarray(pk)[i]) == rpk
+        assert bytes(np.asarray(sk)[i]) == rsk
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["ML-DSA-44", "ML-DSA-65", pytest.param("ML-DSA-87", marks=pytest.mark.slow)],
+)
+def test_sign_matches_oracle_and_verifies(name):
+    p = mldsa_ref.PARAMS[name]
+    batch = 3
+    xi = RNG.integers(0, 256, size=(batch, 32), dtype=np.uint8)
+    rnd = RNG.integers(0, 256, size=(batch, 32), dtype=np.uint8)
+    msgs = [bytes(RNG.integers(0, 256, size=40 + 13 * i, dtype=np.uint8)) for i in range(batch)]
+
+    kg, sign_mu, verify_mu = jmldsa.get(name)
+    pk, sk = np.asarray(kg(xi)[0]), np.asarray(jmldsa.keygen(p, xi)[1])
+    mus = np.stack(
+        [np.frombuffer(_mu(bytes(sk[i][64:128]), msgs[i]), np.uint8) for i in range(batch)]
+    )
+    sigs = np.asarray(sign_mu(sk, mus, rnd))
+    for i in range(batch):
+        ref_sig = mldsa_ref.sign(p, bytes(sk[i]), msgs[i], rnd=bytes(rnd[i]))
+        assert bytes(sigs[i]) == ref_sig, f"lane {i} diverges from oracle"
+        assert mldsa_ref.verify(p, bytes(pk[i]), msgs[i], bytes(sigs[i]))
+
+    ok = np.asarray(verify_mu(pk, mus, sigs))
+    assert ok.all()
+
+    # tampered message must fail
+    bad_mus = mus.copy()
+    bad_mus[:, 0] ^= 1
+    assert not np.asarray(verify_mu(pk, bad_mus, sigs)).any()
+
+    # tampered signature must fail
+    bad_sigs = sigs.copy()
+    bad_sigs[:, -1] ^= 0xFF
+    assert not np.asarray(verify_mu(pk, mus, bad_sigs)).any()
+
+
+def test_provider_tpu_backend_roundtrip():
+    from quantum_resistant_p2p_tpu.provider import get_signature
+
+    alg = get_signature("ML-DSA-44", backend="tpu")
+    pk, sk = alg.generate_keypair()
+    assert len(pk) == alg.public_key_len and len(sk) == alg.secret_key_len
+    msg = b"tpu-native ml-dsa provider"
+    sig = alg.sign(sk, msg)
+    assert alg.verify(pk, msg, sig)
+    assert not alg.verify(pk, msg + b"!", sig)
+    # cross-backend: cpu verifies tpu signature and vice versa
+    cpu = get_signature("ML-DSA-44", backend="cpu")
+    assert cpu.verify(pk, msg, sig)
+    cpu_sig = cpu.sign(sk, msg)
+    assert alg.verify(pk, msg, cpu_sig)
+
+
+def test_batch_sign_verify():
+    from quantum_resistant_p2p_tpu.provider import get_signature
+
+    alg = get_signature("ML-DSA-44", backend="tpu")
+    pk, sk = alg.generate_keypair()
+    n = 4
+    sks = np.broadcast_to(np.frombuffer(sk, np.uint8), (n, len(sk)))
+    pks = np.broadcast_to(np.frombuffer(pk, np.uint8), (n, len(pk)))
+    msgs = [b"msg-%d" % i for i in range(n)]
+    sigs = alg.sign_batch(sks, msgs)
+    assert alg.verify_batch(pks, msgs, sigs).all()
+    assert not alg.verify_batch(pks, [m + b"x" for m in msgs], sigs).any()

@@ -1,0 +1,27 @@
+"""SHA-512 (uint32-pair emulated) vs hashlib oracle."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.core import sha512 as jsha
+
+
+@pytest.mark.parametrize("length", [0, 1, 54, 111, 112, 127, 128, 129, 300])
+def test_sha512_matches_hashlib(length):
+    rng = np.random.default_rng(length)
+    data = rng.integers(0, 256, size=(3, length), dtype=np.uint8)
+    out = np.asarray(jsha.sha512(data))
+    for i in range(3):
+        assert bytes(out[i]) == hashlib.sha512(data[i].tobytes()).digest()
+
+
+def test_midstate_equals_full_hash():
+    rng = np.random.default_rng(9)
+    prefix = rng.integers(0, 256, size=(2, 128), dtype=np.uint8)
+    tail = rng.integers(0, 256, size=(2, 22 + 48), dtype=np.uint8)
+    st = jsha.midstate(prefix)
+    out = np.asarray(jsha.sha512_from_midstate(st, tail, prefix_blocks=1))
+    for i in range(2):
+        assert bytes(out[i]) == hashlib.sha512(prefix[i].tobytes() + tail[i].tobytes()).digest()

@@ -1,0 +1,63 @@
+"""SLH-DSA pure-Python oracle: self-consistency + structural checks.
+
+Note: with the vendored liboqs binary stripped from the reference checkout
+(.MISSING_LARGE_BLOBS), no native SPHINCS+ oracle exists in this environment;
+correctness rests on spec-derived structure tests here plus bit-exact
+agreement between the two independent implementations (pyref vs JAX) in
+test_sphincs.py.
+"""
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.pyref import slhdsa_ref as slh
+
+RNG = np.random.default_rng(42)
+
+
+def _seeds(p):
+    s = [bytes(RNG.integers(0, 256, size=p.n, dtype=np.uint8)) for _ in range(3)]
+    return s[0], s[1], s[2]
+
+
+@pytest.mark.parametrize("name", ["SPHINCS+-SHA2-128f-simple"])
+def test_sign_verify_roundtrip(name):
+    p = slh.PARAMS[name]
+    sk_seed, sk_prf, pk_seed = _seeds(p)
+    pk, sk = slh.keygen(p, sk_seed, sk_prf, pk_seed)
+    assert len(pk) == p.pk_len and len(sk) == p.sk_len
+    msg = b"slh-dsa oracle roundtrip"
+    sig = slh.sign(p, sk, msg)
+    assert len(sig) == p.sig_len
+    assert slh.verify(p, pk, msg, sig)
+    assert not slh.verify(p, pk, msg + b"!", sig)
+    # corrupt each section: randomizer, FORS, HT
+    for off in (0, p.n + 5, p.sig_len - 1):
+        bad = bytearray(sig)
+        bad[off] ^= 0xFF
+        assert not slh.verify(p, pk, msg, bytes(bad))
+
+
+def test_deterministic_and_hedged():
+    p = slh.PARAMS["SPHINCS+-SHA2-128f-simple"]
+    sk_seed, sk_prf, pk_seed = _seeds(p)
+    pk, sk = slh.keygen(p, sk_seed, sk_prf, pk_seed)
+    msg = b"determinism"
+    assert slh.sign(p, sk, msg) == slh.sign(p, sk, msg)
+    hedged = slh.sign(p, sk, msg, addrnd=b"\x01" * p.n)
+    assert hedged != slh.sign(p, sk, msg)
+    assert slh.verify(p, pk, msg, hedged)
+
+
+def test_wots_sign_recovers_pk():
+    p = slh.PARAMS["SPHINCS+-SHA2-128f-simple"]
+    sk_seed, _, pk_seed = _seeds(p)
+    adrs = slh.ADRS()
+    adrs.set_type_and_clear(slh.WOTS_HASH)
+    adrs.w1 = 5
+    pk = slh.wots_pkgen(p, sk_seed, pk_seed, adrs.copy())
+    msg = bytes(RNG.integers(0, 256, size=p.n, dtype=np.uint8))
+    a2 = adrs.copy()
+    sig = slh.wots_sign(p, msg, sk_seed, pk_seed, a2)
+    a3 = adrs.copy()
+    assert slh.wots_pk_from_sig(p, sig, msg, pk_seed, a3) == pk

@@ -1,0 +1,302 @@
+"""Algorithm-combination compatibility harness.
+
+Port of the reference's tests/crypto_algorithms_tester.py (1169 LoC): two full
+in-process node stacks on localhost TCP, every KEM x AEAD x SIG combination
+exercised end-to-end (key exchange, bidirectional messaging, file transfers at
+three sizes), results collected into a PASS/FAIL report with throughput
+rankings (reference: :452-544 run loop, :893-1094 report).
+
+The reference matrix is 9 KEMs x 2 AEADs x 6 SIGs = 108; this framework's
+registry also splits FrodoKEM into AES/SHAKE variants (12 KEMs -> 144 combos).
+
+Usage:
+  python -m tools.compat_matrix --quick              # ML-KEM x everything
+  python -m tools.compat_matrix --backend tpu        # full matrix on TPU
+  python -m tools.compat_matrix --kems ML-KEM-768 --sigs ML-DSA-65
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from quantum_resistant_p2p_tpu.app.message_store import Message  # noqa: E402
+from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging  # noqa: E402
+from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode  # noqa: E402
+from quantum_resistant_p2p_tpu.provider import (  # noqa: E402
+    get_kem,
+    get_signature,
+    get_symmetric,
+    list_kems,
+    list_signatures,
+    list_symmetrics,
+)
+from quantum_resistant_p2p_tpu.storage.key_storage import KeyStorage  # noqa: E402
+
+FILE_SIZES = {"10KB": 10 * 1024, "100KB": 100 * 1024, "1MB": 1024 * 1024}
+
+
+@dataclass
+class ComboResult:
+    kem: str
+    aead: str
+    sig: str
+    connected: bool = False
+    key_exchange_ok: bool = False
+    key_exchange_time: float = 0.0
+    messaging_ok: bool = False
+    file_results: dict = field(default_factory=dict)  # label -> KB/s or None
+    error: str | None = None
+
+    @property
+    def passed(self) -> bool:
+        return (
+            self.connected
+            and self.key_exchange_ok
+            and self.messaging_ok
+            and all(v is not None for v in self.file_results.values())
+        )
+
+
+class TestNode:
+    """Full stack minus UI (reference TestNode, crypto_algorithms_tester.py:49)."""
+
+    def __init__(self, name: str, workdir: Path, backend: str):
+        self.name = name
+        self.backend = backend
+        self.storage = KeyStorage(workdir / f"{name}.vault.json")
+        assert self.storage.unlock("test_password")
+        self.node = P2PNode(node_id=name, host="127.0.0.1", port=0)
+        self.messaging: SecureMessaging | None = None
+        self.inbox: list[Message] = []
+        self.got = asyncio.Event()
+
+    async def start(self):
+        await self.node.start()
+        self.messaging = SecureMessaging(
+            self.node, key_storage=self.storage, backend=self.backend
+        )
+        self.messaging.register_message_listener(self._on_msg)
+
+    def _on_msg(self, peer_id: str, message: Message):
+        if not message.is_system:
+            self.inbox.append(message)
+            self.got.set()
+
+    def configure(self, kem: str, aead: str, sig: str):
+        m = self.messaging
+        m.kem = get_kem(kem, self.backend)
+        m.symmetric = get_symmetric(aead)
+        m.signature = get_signature(sig, self.backend)
+        m._sig_keypair = m._load_or_generate_sig_keypair()
+        if m.use_batching:
+            from quantum_resistant_p2p_tpu.provider.batched import (
+                BatchedKEM,
+                BatchedSignature,
+            )
+
+            m._bkem = BatchedKEM(m.kem, *m._batch_cfg)
+            m._bsig = BatchedSignature(m.signature, *m._batch_cfg)
+
+    def reset_keys(self):
+        m = self.messaging
+        m.shared_keys.clear()
+        m.raw_secrets.clear()
+        m.ke_state.clear()
+
+    async def wait_message(self, pred, timeout=30.0) -> Message | None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for msg in self.inbox:
+                if pred(msg):
+                    return msg
+            self.got.clear()
+            try:
+                await asyncio.wait_for(self.got.wait(), 0.25)
+            except asyncio.TimeoutError:
+                pass
+        return None
+
+    async def stop(self):
+        await self.node.stop()
+
+
+async def run_combo(a: TestNode, b: TestNode, kem: str, aead: str, sig: str,
+                    payloads: dict[str, bytes]) -> ComboResult:
+    r = ComboResult(kem, aead, sig)
+    a.configure(kem, aead, sig)
+    b.configure(kem, aead, sig)
+    a.reset_keys()
+    b.reset_keys()
+    a.inbox.clear()
+    b.inbox.clear()
+    r.connected = a.node.is_connected(b.name)
+    if not r.connected:
+        r.error = "not connected"
+        return r
+    # Re-gossip the new settings and wait for both sides to see them
+    # (reference: settings-sync retry loop, crypto_algorithms_tester.py:617-643).
+    await a.messaging.notify_peers_of_settings_change()
+    await b.messaging.notify_peers_of_settings_change()
+    for _ in range(200):
+        if (a.messaging.settings_match(b.name) is True
+                and b.messaging.settings_match(a.name) is True):
+            break
+        await asyncio.sleep(0.01)
+    else:
+        r.error = "settings gossip did not converge"
+        return r
+    t0 = time.perf_counter()
+    try:
+        ok = await a.messaging.initiate_key_exchange(b.name)
+    except Exception as e:
+        r.error = f"key exchange raised: {e}"
+        return r
+    r.key_exchange_time = time.perf_counter() - t0
+    # both sides must hold the key (reference: :665-672)
+    for _ in range(200):
+        if b.name in a.messaging.shared_keys and a.name in b.messaging.shared_keys:
+            break
+        await asyncio.sleep(0.01)
+    r.key_exchange_ok = bool(ok) and a.messaging.shared_keys.get(
+        b.name
+    ) == b.messaging.shared_keys.get(a.name)
+    if not r.key_exchange_ok:
+        r.error = "key exchange failed"
+        return r
+    # bidirectional messaging
+    ping = f"ping {kem}/{aead}/{sig}".encode()
+    await a.messaging.send_message(b.name, ping)
+    got = await b.wait_message(lambda m: m.content == ping)
+    pong = b"pong " + ping
+    await b.messaging.send_message(a.name, pong)
+    got2 = await a.wait_message(lambda m: m.content == pong)
+    r.messaging_ok = got is not None and got2 is not None
+    if not r.messaging_ok:
+        r.error = "messaging failed"
+        return r
+    # file transfers with throughput (reference: :754-849)
+    for label, payload in payloads.items():
+        t0 = time.perf_counter()
+        sent = await a.messaging.send_message(b.name, payload, is_file=True,
+                                              filename=f"{label}.bin")
+        got = await b.wait_message(
+            lambda m: m.is_file and m.filename == f"{label}.bin"
+        )
+        dt = time.perf_counter() - t0
+        if sent is None or got is None or got.content != payload:
+            r.file_results[label] = None
+            r.error = f"file {label} failed"
+        else:
+            r.file_results[label] = round(len(payload) / 1024 / dt, 2)
+    return r
+
+
+def make_report(results: list[ComboResult], out_dir: Path, backend: str) -> dict:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    passed = [r for r in results if r.passed]
+    by_throughput = sorted(
+        (r for r in passed if r.file_results),
+        key=lambda r: -(sum(v for v in r.file_results.values() if v) / max(len(r.file_results), 1)),
+    )
+    report = {
+        "backend": backend,
+        "total": len(results),
+        "passed": len(passed),
+        "failed": len(results) - len(passed),
+        "results": [r.__dict__ for r in results],
+        "fastest_key_exchange": sorted(
+            ({"combo": f"{r.kem}+{r.sig}", "seconds": round(r.key_exchange_time, 4)}
+             for r in passed),
+            key=lambda d: d["seconds"],
+        )[:10],
+        "best_throughput": [
+            {
+                "combo": f"{r.aead}+{r.sig}",
+                "avg_kb_s": round(
+                    sum(v for v in r.file_results.values() if v) / max(len(r.file_results), 1), 1
+                ),
+            }
+            for r in by_throughput[:10]
+        ],
+    }
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    (out_dir / f"compat_report_{stamp}.json").write_text(json.dumps(report, indent=2))
+    lines = [f"Compatibility report — backend={backend}",
+             f"{len(passed)}/{len(results)} combinations passed", ""]
+    for r in results:
+        mark = "PASS" if r.passed else f"FAIL ({r.error})"
+        lines.append(
+            f"  {r.kem:24s} {r.aead:20s} {r.sig:30s} {mark}"
+            f"  ke={r.key_exchange_time:.3f}s files={r.file_results}"
+        )
+    (out_dir / f"compat_report_{stamp}.txt").write_text("\n".join(lines))
+    return report
+
+
+async def run_matrix(kems, aeads, sigs, backend: str, out_dir: Path,
+                     file_sizes=FILE_SIZES) -> dict:
+    import tempfile
+
+    workdir = Path(tempfile.mkdtemp(prefix="qrp2p_tpu_compat_"))
+    payloads = {label: os.urandom(size) for label, size in file_sizes.items()}
+    a = TestNode("server", workdir, backend)
+    b = TestNode("client", workdir, backend)
+    await a.start()
+    await b.start()
+    assert await b.node.connect_to_peer("127.0.0.1", a.node.port)
+    for _ in range(200):
+        if a.node.is_connected("client"):
+            break
+        await asyncio.sleep(0.01)
+
+    results = []
+    for kem in kems:
+        for aead in aeads:
+            for sig in sigs:
+                print(f"[{len(results) + 1}] {kem} + {aead} + {sig} ...",
+                      flush=True)
+                r = await run_combo(b, a, kem, aead, sig, payloads)
+                print(f"    -> {'PASS' if r.passed else 'FAIL: ' + str(r.error)}"
+                      f"  ke={r.key_exchange_time:.3f}s", flush=True)
+                results.append(r)
+    await a.stop()
+    await b.stop()
+    return make_report(results, out_dir, backend)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="cpu", choices=("cpu", "tpu", "auto"))
+    ap.add_argument("--kems", nargs="*", default=None)
+    ap.add_argument("--aeads", nargs="*", default=None)
+    ap.add_argument("--sigs", nargs="*", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="ML-KEM-only KEMs, small files")
+    ap.add_argument("--output-dir", default="bench_results")
+    args = ap.parse_args(argv)
+
+    kems = args.kems or ([k for k in list_kems() if k.startswith("ML-KEM")]
+                         if args.quick else list_kems())
+    aeads = args.aeads or list_symmetrics()
+    sigs = args.sigs or ([s for s in list_signatures() if s.startswith("ML-DSA")]
+                         if args.quick else list_signatures())
+    sizes = {"10KB": 10240, "100KB": 102400} if args.quick else FILE_SIZES
+
+    report = asyncio.run(
+        run_matrix(kems, aeads, sigs, args.backend, Path(args.output_dir), sizes)
+    )
+    print(json.dumps({k: report[k] for k in ("backend", "total", "passed", "failed")}))
+    return 0 if report["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Simulated peer-swarm benchmark (BASELINE.json config 5).
+
+N client stacks connect to one hub node over real localhost TCP and run the
+full authenticated 5-message handshake concurrently, with the hub's (and
+clients') KEM/signature ops coalescing in the TPU batch queue; then every
+client sends one AEAD message.  Reports handshakes/sec, p50/p99 handshake
+latency, and end-to-end msgs/sec as ONE JSON line.
+
+Reference analog: tests/crypto_algorithms_tester.py runs exactly two nodes
+(reference :455-464); the swarm scales that shape to 1000 peers, which is the
+point of the batching refactor (SURVEY.md §2.3 "data parallelism").
+
+Usage: python -m tools.swarm_bench --peers 1000 --backend tpu --batch
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging  # noqa: E402
+from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode  # noqa: E402
+
+
+async def run_swarm(n_peers: int, backend: str, use_batching: bool,
+                    max_batch: int, max_wait_ms: float, concurrency: int,
+                    warmup: int = 0, ke_timeout: float = 180.0) -> dict:
+    # Cold-compile of each batch-size bucket can take tens of seconds on a
+    # fresh machine; a generous protocol timeout plus an untimed warmup round
+    # keeps compiles out of the measured numbers.
+    from quantum_resistant_p2p_tpu.app import messaging as _messaging
+
+    _messaging.KEY_EXCHANGE_TIMEOUT = ke_timeout
+    hub_node = P2PNode(node_id="hub", host="127.0.0.1", port=0)
+    await hub_node.start()
+    hub = SecureMessaging(
+        hub_node, backend=backend, use_batching=use_batching,
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+    )
+    received = 0
+    got_all = asyncio.Event()
+
+    def on_msg(peer_id, message):
+        nonlocal received
+        if not message.is_system:
+            received += 1
+            if received >= n_peers:
+                got_all.set()
+
+    hub.register_message_listener(on_msg)
+
+    # Shared algorithm objects across clients: one jitted program, one queue.
+    proto = SecureMessaging(
+        P2PNode(node_id="proto", host="127.0.0.1", port=0),
+        backend=backend, use_batching=use_batching,
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+    )
+
+    clients: list[SecureMessaging] = []
+    latencies: list[float] = []
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one_client(i: int) -> None:
+        node = P2PNode(node_id=f"peer{i:04d}", host="127.0.0.1", port=0)
+        sm = SecureMessaging(node, backend=backend, kem=proto.kem,
+                             symmetric=proto.symmetric, signature=proto.signature)
+        # share the batch queues so all clients coalesce into the same batches
+        sm._bkem, sm._bsig = proto._bkem, proto._bsig
+        sm.use_batching = use_batching
+        clients.append(sm)
+        async with sem:
+            assert await node.connect_to_peer("127.0.0.1", hub_node.port) == "hub"
+            t0 = time.perf_counter()
+            ok = await sm.initiate_key_exchange("hub")
+            latencies.append(time.perf_counter() - t0)
+            if not ok:
+                raise RuntimeError(f"handshake {i} failed")
+            await sm.send_message("hub", b"hello from peer %d" % i)
+
+    if warmup:
+        warm = await asyncio.gather(*(one_client(-i - 1) for i in range(warmup)),
+                                    return_exceptions=True)
+        warm_fail = sum(1 for r in warm if isinstance(r, Exception))
+        if warm_fail:
+            print(f"warmup: {warm_fail}/{warmup} failed", file=sys.stderr)
+        latencies.clear()
+        received = 0
+        got_all.clear()
+
+    t_start = time.perf_counter()
+    results = await asyncio.gather(*(one_client(i) for i in range(n_peers)),
+                                   return_exceptions=True)
+    failures = [r for r in results if isinstance(r, Exception)]
+    try:
+        await asyncio.wait_for(got_all.wait(), 60)
+    except asyncio.TimeoutError:
+        pass
+    elapsed = time.perf_counter() - t_start
+
+    for sm in clients:
+        await sm.node.stop()
+    await hub_node.stop()
+
+    lat_sorted = sorted(latencies)
+    stats = {
+        "peers": n_peers,
+        "backend": backend,
+        "batching": use_batching,
+        "failures": len(failures),
+        "elapsed_s": round(elapsed, 3),
+        "handshakes_per_s": round(len(latencies) / elapsed, 2),
+        "e2e_msgs_per_s": round(received / elapsed, 2),
+        "p50_handshake_s": round(statistics.median(lat_sorted), 4) if lat_sorted else None,
+        "p99_handshake_s": round(
+            lat_sorted[max(0, int(len(lat_sorted) * 0.99) - 1)], 4
+        ) if lat_sorted else None,
+        "messages_received": received,
+    }
+    if use_batching and hub._bkem is not None:
+        stats["hub_queue"] = {"kem": hub._bkem.stats(), "sig": hub._bsig.stats()}
+    return stats
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=1000)
+    ap.add_argument("--backend", default="tpu", choices=("cpu", "tpu", "auto"))
+    ap.add_argument("--batch", action="store_true", default=True)
+    ap.add_argument("--no-batch", dest="batch", action="store_false")
+    ap.add_argument("--max-batch", type=int, default=4096)
+    ap.add_argument("--max-wait-ms", type=float, default=3.0)
+    ap.add_argument("--concurrency", type=int, default=256,
+                    help="simultaneous in-flight handshakes")
+    ap.add_argument("--warmup", type=int, default=32,
+                    help="untimed warmup handshakes (compile the size buckets)")
+    ap.add_argument("--ke-timeout", type=float, default=180.0)
+    args = ap.parse_args(argv)
+    stats = asyncio.run(
+        run_swarm(args.peers, args.backend, args.batch, args.max_batch,
+                  args.max_wait_ms, args.concurrency, args.warmup, args.ke_timeout)
+    )
+    print(json.dumps(stats))
+    return 0 if stats["failures"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
