@@ -216,16 +216,25 @@ class SecureMessaging:
             return await self._bsig.sign(self._sig_keypair[1], message)
         return self.signature.sign(self._sig_keypair[1], message)
 
-    async def _verify(self, sig_algo: str, pk: bytes, message: bytes, sig: bytes) -> bool:
-        """Never raises: malformed attacker input means False (scalar verify's
-        contract, kept on the batched path too)."""
+    async def _verify(self, sig_algo: str, pk: bytes, message: bytes, sig: bytes) -> bool | None:
+        """False on verification failure, None for an unknown/unsupported
+        signature algorithm (the caller maps None to ALGORITHM_MISMATCH, the
+        reference's typed rejection, rather than INVALID_SIGNATURE).  Never
+        raises: malformed attacker input means False."""
+        if sig_algo != self.signature.name:
+            try:
+                verifier = get_signature(sig_algo, self.backend)
+            except (KeyError, ValueError, TypeError):
+                # TypeError: attacker-supplied non-string sig_algo (unhashable)
+                return None
+            try:
+                return verifier.verify(pk, message, sig)
+            except Exception:
+                return False
         try:
-            if sig_algo == self.signature.name:
-                if self._bsig is not None:
-                    return await self._bsig.verify(pk, message, sig)
-                return self.signature.verify(pk, message, sig)
-            verifier = get_signature(sig_algo, self.backend)
-            return verifier.verify(pk, message, sig)
+            if self._bsig is not None:
+                return await self._bsig.verify(pk, message, sig)
+            return self.signature.verify(pk, message, sig)
         except Exception:
             return False
 
@@ -339,7 +348,10 @@ class SecureMessaging:
     async def _check_common(self, peer_id: str, data: dict, sig: bytes, sig_pk: bytes,
                             sig_algo: str) -> RejectReason | None:
         """Signature + identity + replay-window checks shared by init/response."""
-        if not await self._verify(sig_algo, sig_pk, _canonical(data), sig):
+        ok = await self._verify(sig_algo, sig_pk, _canonical(data), sig)
+        if ok is None:
+            return RejectReason.ALGORITHM_MISMATCH
+        if not ok:
             return RejectReason.INVALID_SIGNATURE
         if data.get("sender") != peer_id or data.get("recipient") != self.node_id:
             return RejectReason.IDENTITY_MISMATCH

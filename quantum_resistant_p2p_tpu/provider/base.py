@@ -137,3 +137,22 @@ class SymmetricAlgorithm(CryptoAlgorithm):
 
 def _stack_bytes(items) -> np.ndarray:
     return np.stack([np.frombuffer(b, dtype=np.uint8) for b in items])
+
+
+def expect_len(buf: bytes, expected: int, what: str, algo: str) -> None:
+    """Reject wrong-length attacker-controlled material BEFORE it reaches a
+    backend.  The native C++ core reads exactly ``expected`` bytes from the
+    buffer it is handed, so an unchecked short input is a heap out-of-bounds
+    read; the JAX backends would raise an opaque reshape error instead of a
+    protocol-level one.  Raises ValueError (which the messaging layer maps to
+    a typed rejection)."""
+    if len(buf) != expected:
+        raise ValueError(f"{algo}: {what} must be {expected} bytes, got {len(buf)}")
+
+
+def expect_cols(arr: np.ndarray, expected: int, what: str, algo: str) -> None:
+    """Batch-array analog of expect_len: trailing dim must match exactly."""
+    if arr.ndim != 2 or arr.shape[1] != expected:
+        raise ValueError(
+            f"{algo}: batched {what} must have shape (n, {expected}), got {arr.shape}"
+        )

@@ -135,6 +135,24 @@ def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length() if n > 1 else 1
 
 
+def _run_valid(items, is_valid, dispatch, invalid_result):
+    """Shared filter-pad-dispatch-scatter skeleton for the batch fns.
+
+    ``is_valid(item) -> bool`` selects items safe to stack; ``dispatch(valid
+    items, pow2 target) -> per-item results`` runs the padded device batch;
+    invalid slots get ``invalid_result()`` so one attacker-supplied ragged
+    input never poisons its batch mates.
+    """
+    valid_idx = [i for i, it in enumerate(items) if is_valid(it)]
+    results = [invalid_result() for _ in items]
+    if valid_idx:
+        tgt = _next_pow2(len(valid_idx))
+        out = dispatch([items[i] for i in valid_idx], tgt)
+        for j, i in enumerate(valid_idx):
+            results[i] = out[j]
+    return results
+
+
 def _pad_rows(rows: np.ndarray, target: int) -> np.ndarray:
     """Pad the batch dim to ``target`` by repeating the last row.
 
@@ -166,42 +184,33 @@ class BatchedKEM:
         return [(bytes(pk), bytes(sk)) for pk, sk in zip(pks[:n], sks[:n])]
 
     def _enc_batch(self, items: list[bytes]):
-        valid_idx = [i for i, pk in enumerate(items)
-                     if len(pk) == self.algo.public_key_len]
-        results: list = [ValueError("bad public-key length") for _ in items]
-        if valid_idx:
-            tgt = _next_pow2(len(valid_idx))
-            pks = _pad_rows(
-                np.stack([np.frombuffer(items[i], np.uint8) for i in valid_idx]), tgt
-            )
+        def dispatch(valid, tgt):
+            pks = _pad_rows(np.stack([np.frombuffer(pk, np.uint8) for pk in valid]), tgt)
             cts, sss = self.algo.encapsulate_batch(pks)
-            for j, i in enumerate(valid_idx):
-                results[i] = (bytes(cts[j]), bytes(sss[j]))
-        return results
+            return [(bytes(ct), bytes(ss)) for ct, ss in zip(cts, sss)]
+
+        return _run_valid(
+            items,
+            lambda pk: len(pk) == self.algo.public_key_len,
+            dispatch,
+            lambda: ValueError("bad public-key length"),
+        )
 
     def _dec_batch(self, items: list[tuple[bytes, bytes]]):
-        # Per-item length validation BEFORE stacking: one attacker-supplied
-        # ragged ciphertext must not poison the whole batch (np.stack raises
-        # batch-wide otherwise).  Invalid items get their own error result.
-        valid_idx = [
-            i for i, (sk, ct) in enumerate(items)
-            if len(sk) == self.algo.secret_key_len and len(ct) == self.algo.ciphertext_len
-        ]
-        results: list = [
-            ValueError("bad secret-key/ciphertext length") for _ in items
-        ]
-        if valid_idx:
-            tgt = _next_pow2(len(valid_idx))
-            sks = _pad_rows(
-                np.stack([np.frombuffer(items[i][0], np.uint8) for i in valid_idx]), tgt
-            )
-            cts = _pad_rows(
-                np.stack([np.frombuffer(items[i][1], np.uint8) for i in valid_idx]), tgt
-            )
-            sss = self.algo.decapsulate_batch(sks, cts)
-            for j, i in enumerate(valid_idx):
-                results[i] = bytes(sss[j])
-        return results
+        def dispatch(valid, tgt):
+            sks = _pad_rows(np.stack([np.frombuffer(sk, np.uint8) for sk, _ in valid]), tgt)
+            cts = _pad_rows(np.stack([np.frombuffer(ct, np.uint8) for _, ct in valid]), tgt)
+            return [bytes(ss) for ss in self.algo.decapsulate_batch(sks, cts)]
+
+        return _run_valid(
+            items,
+            lambda it: (
+                len(it[0]) == self.algo.secret_key_len
+                and len(it[1]) == self.algo.ciphertext_len
+            ),
+            dispatch,
+            lambda: ValueError("bad secret-key/ciphertext length"),
+        )
 
     async def generate_keypair(self) -> tuple[bytes, bytes]:
         return await self._kg.submit(None)
@@ -230,36 +239,41 @@ class BatchedSignature:
         self._sign = OpQueue(self._sign_batch, max_batch, max_wait_ms)
         self._verify = OpQueue(self._verify_batch, max_batch, max_wait_ms)
 
-    def _sign_batch(self, items: list[tuple[bytes, bytes]]) -> list[bytes]:
-        n = len(items)
-        tgt = _next_pow2(n)
-        sks = _pad_rows(np.stack([np.frombuffer(sk, np.uint8) for sk, _ in items]), tgt)
-        msgs = [m for _, m in items] + [items[-1][1]] * (tgt - n)
-        return self.algo.sign_batch(sks, msgs)[:n]
+    def _sign_batch(self, items: list[tuple[bytes, bytes]]):
+        def dispatch(valid, tgt):
+            sks = _pad_rows(np.stack([np.frombuffer(sk, np.uint8) for sk, _ in valid]), tgt)
+            msgs = [m for _, m in valid] + [valid[-1][1]] * (tgt - len(valid))
+            return self.algo.sign_batch(sks, msgs)
+
+        return _run_valid(
+            items,
+            lambda it: len(it[0]) == self.algo.secret_key_len,
+            dispatch,
+            lambda: ValueError("bad secret-key length"),
+        )
 
     def _verify_batch(self, items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
-        # Per the verify contract, malformed input means False — never raise —
-        # and must not poison batch mates with a ragged np.stack.
-        valid_idx = [
-            i for i, (pk, _, s) in enumerate(items)
-            if len(pk) == self.algo.public_key_len and len(s) == self.algo.signature_len
-        ]
-        results = [False] * len(items)
-        if valid_idx:
-            tgt = _next_pow2(len(valid_idx))
-            pks = _pad_rows(
-                np.stack([np.frombuffer(items[i][0], np.uint8) for i in valid_idx]), tgt
-            )
-            last = items[valid_idx[-1]]
-            msgs = [items[i][1] for i in valid_idx] + [last[1]] * (tgt - len(valid_idx))
-            sigs = [items[i][2] for i in valid_idx] + [last[2]] * (tgt - len(valid_idx))
+        # Per the verify contract, malformed input means False — never raise.
+        def dispatch(valid, tgt):
+            pks = _pad_rows(np.stack([np.frombuffer(pk, np.uint8) for pk, _, _ in valid]), tgt)
+            pad = tgt - len(valid)
+            msgs = [m for _, m, _ in valid] + [valid[-1][1]] * pad
+            sigs = [s for _, _, s in valid] + [valid[-1][2]] * pad
             try:
                 oks = self.algo.verify_batch(pks, msgs, sigs)
             except Exception:
                 oks = [False] * tgt
-            for j, i in enumerate(valid_idx):
-                results[i] = bool(oks[j])
-        return results
+            return [bool(ok) for ok in oks]
+
+        return _run_valid(
+            items,
+            lambda it: (
+                len(it[0]) == self.algo.public_key_len
+                and len(it[2]) == self.algo.signature_len
+            ),
+            dispatch,
+            lambda: False,
+        )
 
     async def sign(self, secret_key: bytes, message: bytes) -> bytes:
         return await self._sign.submit((secret_key, message))

@@ -19,7 +19,7 @@ import os
 import numpy as np
 
 from ..pyref import frodo_ref, hqc_ref, mlkem_ref
-from .base import KeyExchangeAlgorithm
+from .base import KeyExchangeAlgorithm, expect_cols, expect_len
 
 _LEVEL_TO_MLKEM = {1: mlkem_ref.MLKEM512, 3: mlkem_ref.MLKEM768, 5: mlkem_ref.MLKEM1024}
 
@@ -73,11 +73,14 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         return bytes(pk[0]), bytes(sk[0])
 
     def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
+        expect_len(public_key, self.public_key_len, "public key", self.name)
         pk = np.frombuffer(public_key, dtype=np.uint8)[None]
         ct, ss = self.encapsulate_batch(pk)
         return bytes(ct[0]), bytes(ss[0])
 
     def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
+        expect_len(secret_key, self.secret_key_len, "secret key", self.name)
+        expect_len(ciphertext, self.ciphertext_len, "ciphertext", self.name)
         sk = np.frombuffer(secret_key, dtype=np.uint8)[None]
         ct = np.frombuffer(ciphertext, dtype=np.uint8)[None]
         return bytes(self.decapsulate_batch(sk, ct)[0])
@@ -102,6 +105,7 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         )
 
     def encapsulate_batch(self, public_keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        expect_cols(public_keys, self.public_key_len, "public keys", self.name)
         n = public_keys.shape[0]
         m = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
         if self.backend == "tpu":
@@ -119,6 +123,8 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         )
 
     def decapsulate_batch(self, secret_keys: np.ndarray, ciphertexts: np.ndarray) -> np.ndarray:
+        expect_cols(secret_keys, self.secret_key_len, "secret keys", self.name)
+        expect_cols(ciphertexts, self.ciphertext_len, "ciphertexts", self.name)
         if self.backend == "tpu":
             return np.asarray(self._dec(secret_keys, ciphertexts))
         impl = self._native
@@ -173,10 +179,13 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         return bytes(pk[0]), bytes(sk[0])
 
     def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
+        expect_len(public_key, self.public_key_len, "public key", self.name)
         ct, ss = self.encapsulate_batch(np.frombuffer(public_key, np.uint8)[None])
         return bytes(ct[0]), bytes(ss[0])
 
     def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
+        expect_len(secret_key, self.secret_key_len, "secret key", self.name)
+        expect_len(ciphertext, self.ciphertext_len, "ciphertext", self.name)
         sk = np.frombuffer(secret_key, np.uint8)[None]
         ct = np.frombuffer(ciphertext, np.uint8)[None]
         return bytes(self.decapsulate_batch(sk, ct)[0])
@@ -199,6 +208,7 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         )
 
     def encapsulate_batch(self, public_keys: np.ndarray):
+        expect_cols(public_keys, self.public_key_len, "public keys", self.name)
         p = self.params
         n = public_keys.shape[0]
         mu = np.frombuffer(os.urandom(p.len_sec * n), np.uint8).reshape(n, p.len_sec)
@@ -215,6 +225,8 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         )
 
     def decapsulate_batch(self, secret_keys: np.ndarray, ciphertexts: np.ndarray):
+        expect_cols(secret_keys, self.secret_key_len, "secret keys", self.name)
+        expect_cols(ciphertexts, self.ciphertext_len, "ciphertexts", self.name)
         p = self.params
         if self.backend == "tpu":
             return np.asarray(self._dec(secret_keys, ciphertexts))
@@ -266,10 +278,13 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         return bytes(pk[0]), bytes(sk[0])
 
     def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
+        expect_len(public_key, self.public_key_len, "public key", self.name)
         ct, ss = self.encapsulate_batch(np.frombuffer(public_key, np.uint8)[None])
         return bytes(ct[0]), bytes(ss[0])
 
     def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
+        expect_len(secret_key, self.secret_key_len, "secret key", self.name)
+        expect_len(ciphertext, self.ciphertext_len, "ciphertext", self.name)
         sk = np.frombuffer(secret_key, np.uint8)[None]
         ct = np.frombuffer(ciphertext, np.uint8)[None]
         return bytes(self.decapsulate_batch(sk, ct)[0])
@@ -292,6 +307,7 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         )
 
     def encapsulate_batch(self, public_keys: np.ndarray):
+        expect_cols(public_keys, self.public_key_len, "public keys", self.name)
         p = self.params
         n = public_keys.shape[0]
         m = np.frombuffer(os.urandom(p.k * n), np.uint8).reshape(n, p.k)
@@ -309,6 +325,8 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         )
 
     def decapsulate_batch(self, secret_keys: np.ndarray, ciphertexts: np.ndarray):
+        expect_cols(secret_keys, self.secret_key_len, "secret keys", self.name)
+        expect_cols(ciphertexts, self.ciphertext_len, "ciphertexts", self.name)
         p = self.params
         if self.backend == "tpu":
             return np.asarray(self._dec(secret_keys, ciphertexts))

@@ -17,7 +17,7 @@ import os
 import numpy as np
 
 from ..pyref import mldsa_ref
-from .base import SignatureAlgorithm
+from .base import SignatureAlgorithm, expect_cols, expect_len
 
 _LEVEL_TO_MLDSA = {2: mldsa_ref.MLDSA44, 3: mldsa_ref.MLDSA65, 5: mldsa_ref.MLDSA87}
 
@@ -67,6 +67,7 @@ class MLDSASignature(SignatureAlgorithm):
         return mldsa_ref.keygen(self.params, xi)
 
     def sign(self, secret_key: bytes, message: bytes) -> bytes:
+        expect_len(secret_key, self.secret_key_len, "secret key", self.name)
         rnd = os.urandom(32)  # hedged variant
         if self.backend == "tpu":
             sk = np.frombuffer(secret_key, np.uint8)[None]
@@ -88,6 +89,7 @@ class MLDSASignature(SignatureAlgorithm):
     # -- batch API (tpu-native; cpu falls back to base-class loop) ----------
 
     def sign_batch(self, secret_keys: np.ndarray, messages: list[bytes], rnd=None):
+        expect_cols(secret_keys, self.secret_key_len, "secret keys", self.name)
         if self.backend != "tpu":
             return super().sign_batch(secret_keys, messages)
         n = len(messages)
@@ -98,10 +100,19 @@ class MLDSASignature(SignatureAlgorithm):
             [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, messages)]
         )
         rnds = np.stack([np.frombuffer(r, np.uint8) for r in rnd])
-        sigs = np.asarray(self._sign_mu(np.asarray(secret_keys), mus, rnds))
+        sigs, done = self._sign_mu(np.asarray(secret_keys), mus, rnds)
+        sigs, done = np.asarray(sigs), np.asarray(done)
+        if not done.all():
+            # P < 1e-12 per lane; an all-zero sigma must never leave the
+            # provider as if it were a signature (ADVICE r1).
+            raise RuntimeError(
+                f"{self.name}: {int((~done).sum())} lane(s) exhausted the "
+                f"rejection-sampling budget"
+            )
         return [bytes(s) for s in sigs]
 
     def verify_batch(self, public_keys: np.ndarray, messages: list[bytes], signatures):
+        expect_cols(public_keys, self.public_key_len, "public keys", self.name)
         if self.backend != "tpu":
             return super().verify_batch(public_keys, messages, signatures)
         trs = [hashlib.shake_256(bytes(pk)).digest(64) for pk in public_keys]
@@ -154,6 +165,7 @@ class SPHINCSSignature(SignatureAlgorithm):
         return slhdsa_ref.keygen(p, sk_seed, sk_prf, pk_seed)
 
     def sign(self, secret_key: bytes, message: bytes) -> bytes:
+        expect_len(secret_key, self.secret_key_len, "secret key", self.name)
         if self.backend == "tpu":
             sk = np.frombuffer(secret_key, np.uint8)[None]
             return bytes(self.sign_batch(sk, [message])[0])
@@ -174,6 +186,7 @@ class SPHINCSSignature(SignatureAlgorithm):
     # -- batch API ----------------------------------------------------------
 
     def sign_batch(self, secret_keys: np.ndarray, messages: list[bytes]):
+        expect_cols(secret_keys, self.secret_key_len, "secret keys", self.name)
         if self.backend != "tpu":
             return super().sign_batch(secret_keys, messages)
         p = self.params
@@ -193,6 +206,7 @@ class SPHINCSSignature(SignatureAlgorithm):
         return [bytes(s) for s in sigs]
 
     def verify_batch(self, public_keys: np.ndarray, messages: list[bytes], signatures):
+        expect_cols(public_keys, self.public_key_len, "public keys", self.name)
         if self.backend != "tpu":
             return super().verify_batch(public_keys, messages, signatures)
         p = self.params

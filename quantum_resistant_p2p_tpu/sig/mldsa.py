@@ -406,10 +406,15 @@ def _inf_norm(x: jax.Array, axes) -> jax.Array:
     return jnp.max(jnp.abs(_center(x)), axis=axes)
 
 
-def sign_mu(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array) -> jax.Array:
+def sign_mu(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array):
     """Core of Algorithm 7 given mu = SHAKE256(tr||M', 64).
 
-    sk (..., sk_len), mu (..., 64), rnd (..., 32) -> sigma (..., sig_len).
+    sk (..., sk_len), mu (..., 64), rnd (..., 32) ->
+    (sigma (..., sig_len), done (...,) bool).
+
+    ``done`` is False for any lane whose rejection loop exhausted
+    MAX_SIGN_ITERS attempts (P < 1e-12 per lane); such a lane's sigma is
+    all-zero and must not be emitted — callers check host-side and raise.
     """
     sk = jnp.asarray(sk, jnp.uint8)
     mu = jnp.asarray(mu, jnp.uint8)
@@ -474,8 +479,8 @@ def sign_mu(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array) -> jax
         done = done | ok
         return done, kappa, sig, it + 1
 
-    _, _, sig, _ = lax.while_loop(cond, body, (done0, kappa0, sig0, jnp.int32(0)))
-    return sig
+    done, _, sig, _ = lax.while_loop(cond, body, (done0, kappa0, sig0, jnp.int32(0)))
+    return sig, done
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,62 @@
+"""Input-length validation at the provider boundary (ADVICE r1, high).
+
+Attacker-controlled public keys / ciphertexts of the wrong length must raise
+ValueError at the plugin boundary — BEFORE reaching the native C++ core
+(which reads fixed lengths from the buffer it is handed: a short pk would be
+a heap out-of-bounds read) or the JAX backends (opaque reshape errors).
+The reference gets this for free from liboqs's internal checks
+(vendor/oqs.py:332-381); here it is the provider's job.
+"""
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.provider import get_kem, get_signature
+
+
+@pytest.mark.parametrize("name", ["ML-KEM-768", "FrodoKEM-640-AES", "HQC-128"])
+def test_kem_scalar_rejects_bad_lengths(name):
+    kem = get_kem(name, "cpu")
+    pk, sk = kem.generate_keypair()
+    ct, _ = kem.encapsulate(pk)
+
+    with pytest.raises(ValueError):
+        kem.encapsulate(pk[:-1])
+    with pytest.raises(ValueError):
+        kem.encapsulate(pk + b"\x00")
+    with pytest.raises(ValueError):
+        kem.encapsulate(b"")
+    with pytest.raises(ValueError):
+        kem.decapsulate(sk, ct[:-1])
+    with pytest.raises(ValueError):
+        kem.decapsulate(sk[:-1], ct)
+
+    # well-formed input still round-trips
+    ss = kem.decapsulate(sk, ct)
+    assert len(ss) == kem.shared_secret_len
+
+
+@pytest.mark.parametrize("name", ["ML-KEM-512"])
+def test_kem_batch_rejects_bad_shapes(name):
+    kem = get_kem(name, "cpu")
+    pks, sks = kem.generate_keypair_batch(2)
+    cts, _ = kem.encapsulate_batch(pks)
+
+    with pytest.raises(ValueError):
+        kem.encapsulate_batch(pks[:, :-1])
+    with pytest.raises(ValueError):
+        kem.decapsulate_batch(sks, cts[:, :-1])
+    with pytest.raises(ValueError):
+        kem.decapsulate_batch(sks[:, 1:], cts)
+
+
+def test_signature_sign_rejects_bad_sk_and_verify_returns_false():
+    sig = get_signature("ML-DSA-44", "cpu")
+    pk, sk = sig.generate_keypair()
+    with pytest.raises(ValueError):
+        sig.sign(sk[:-1], b"msg")
+    s = sig.sign(sk, b"msg")
+    # verify never raises on malformed input — contract is False
+    assert sig.verify(pk[:-1], b"msg", s) is False
+    assert sig.verify(pk, b"msg", s[:-1]) is False
+    assert sig.verify(pk, b"msg", s) is True
