@@ -1,0 +1,47 @@
+"""Honest device timing helpers.
+
+On this environment's remote-TPU platform ("axon", a tunnel to one v5e chip)
+``jax.block_until_ready`` returns once the *handle* is ready, before device
+execution has actually finished — timing dispatch, not compute.  Round 1's
+headline number (61.5M encaps/s, BENCH_r01.json) was inflated ~6000x by
+exactly this.  The only reliable fence is a small host readback that depends
+on the output buffer: transferring even one element forces the producing
+computation (and everything it depends on) to complete.
+
+All benchmarks in this repo time ``reps`` back-to-back dispatches followed by
+one such readback, so per-dispatch overhead pipelines the way it would in
+production (the batching queue also issues back-to-back batches).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+def sync(tree: Any) -> None:
+    """Force real completion of every array in ``tree`` via host readback."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "addressable_shards") or hasattr(leaf, "device"):
+            np.asarray(jax.device_get(leaf.ravel()[:1] if hasattr(leaf, "ravel") else leaf))
+
+
+def timeit(fn: Callable, *args, reps: int = 3, trials: int = 3) -> float:
+    """Best-of-``trials`` mean seconds per call of ``fn(*args)``, honest-sync.
+
+    The first call (compile + warm-up) is excluded.  Each trial times ``reps``
+    back-to-back dispatches ending in one forced readback.
+    """
+    sync(fn(*args))  # compile + warm caches
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(reps):
+            out = fn(*args)
+        sync(out)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
