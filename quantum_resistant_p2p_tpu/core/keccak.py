@@ -178,6 +178,21 @@ def _words_to_bytes(hi: jax.Array, lo: jax.Array) -> jax.Array:
 # --------------------------------------------------------------------------
 
 
+def _use_pallas() -> bool:
+    """Pallas fast path on real TPU; pure-jnp elsewhere (tests run on CPU)."""
+    import os
+
+    flag = os.environ.get("QRP2P_PALLAS", "auto")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover
+        return False
+
+
 def sponge(data: jax.Array, rate: int, ds_byte: int, out_len: int) -> jax.Array:
     """Keccak sponge with static lengths.
 
@@ -200,6 +215,20 @@ def sponge(data: jax.Array, rate: int, ds_byte: int, out_len: int) -> jax.Array:
     padded = lax.dynamic_update_slice_in_dim(padded, data, 0, axis=-1) if msg_len else padded
     padded = padded.at[..., msg_len].set(jnp.uint8(ds_byte))
     padded = padded.at[..., padded_len - 1].set(padded[..., padded_len - 1] | jnp.uint8(0x80))
+
+    out_nblocks_total = -(-out_len // rate)
+    if nblocks + out_nblocks_total <= 16 and _use_pallas():
+        from . import keccak_pallas  # deferred: pallas import
+
+        if nblocks + out_nblocks_total <= keccak_pallas.MAX_BLOCKS_FUSED:
+            b = int(np.prod(batch)) if batch else 1
+            ph, plo = _bytes_to_words(padded.reshape(b, padded_len))
+            oh, ol = keccak_pallas.sponge_words(
+                ph.T, plo.T, rate_words=rate // 8, n_abs=nblocks,
+                n_sq=out_nblocks_total,
+            )
+            out = _words_to_bytes(oh.T, ol.T)
+            return out.reshape(batch + (-1,))[..., :out_len]
 
     hi = jnp.zeros(batch + (25,), dtype=jnp.uint32)
     lo = jnp.zeros(batch + (25,), dtype=jnp.uint32)

@@ -1,22 +1,28 @@
-"""Pallas TPU kernel for batched Keccak-f[1600].
+"""Pallas TPU kernel for the Keccak sponge — the production hash fast path.
 
-The jnp version (core.keccak) lowers to an XLA fori_loop whose 24-round body
-materialises intermediate 25-lane stacks each round.  This kernel keeps the
-whole 50-word (25 lanes x hi/lo uint32) state resident in VMEM for all 24
-rounds, with the batch on the 128-lane axis — one grid cell per 128 sponges:
+Why a kernel at all: the pure-jnp sponge (core/keccak.py) materialises the
+full batched 25-lane state between every one of the 24 rounds, so one
+ML-KEM-768 encaps batch reads/writes ~38 MB of HBM per op (measured: 155 GB
+per 4096-batch, wholly memory-bound).  This kernel keeps the entire state in
+registers/VMEM for the whole absorb-permute-squeeze pipeline; HBM traffic
+drops to the message bytes in and digest bytes out.
 
-  layout:  state[56, B] int32 — rows 0..24 hi words, rows 28..52 lo words
-           (row count padded to a multiple of 8 for int32 sublane tiling)
-  grid:    (B // 128,) — each cell permutes its 128-sponge block in place
+Layout: batch lives on the two *minor* dimensions — each of the 50 uint32
+state words is an ``(8, 128)`` tile (sublanes x lanes, exactly one 32-bit
+vector register) across 1024 sponge instances, so theta/chi xors and the
+per-lane constant rotations are full-width VPU ops with zero register waste
+(a ``(1, B)`` row layout measured 8x slower: 7/8 of every vreg idle).  The
+24 rounds and the (static) absorb/squeeze block loops are fully unrolled at
+trace time; rho/pi/iota constants are Python ints baked into the program.
 
-Rotations are per-lane compile-time constants, so the round body unrolls into
-pure VPU bitwise ops with zero gathers.  Use ``keccak_f1600`` below as a
-drop-in for core.keccak.keccak_f1600 on (batch, 25) uint32 pairs; it falls
-back to the jnp implementation off-TPU (Pallas interpret mode is only used in
-tests).
+Used by core/keccak.py when running on TPU for sponges up to
+``MAX_BLOCKS_FUSED`` total blocks (covers every ML-KEM / ML-DSA / SLH-DSA
+call site); longer sponges (FrodoKEM/HQC matrix expansion) stay on the
+lax.scan jnp path.  Oracle: hashlib via tests/test_keccak.py, which runs
+this kernel in interpret mode on CPU and natively on TPU.
 
-Reference for parity: same permutation the vendored liboqs implements in C
-(reference vendor/oqs.py loads it; every KEM/sig depends on it).
+Replaces (reference): the Keccak core inside vendored liboqs
+(vendor/oqs.py:122-183), reached from every KEM/signature hot call.
 """
 
 from __future__ import annotations
@@ -25,28 +31,20 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+from jax.experimental import pallas as pl
 
-from . import keccak as _jnp_keccak
+from .keccak import _PI_SRC, _RC, _RHO
 
-try:  # pallas import can fail on exotic platforms; fall back silently
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+#: sponges with more than this many total (absorb + squeeze) blocks fall back
+#: to the jnp scan path — the fully-unrolled kernel would compile too slowly.
+MAX_BLOCKS_FUSED = 16
 
-    _HAVE_PALLAS = True
-except Exception:  # pragma: no cover
-    _HAVE_PALLAS = False
-
-_RHO = _jnp_keccak._rho_offsets()
-_PI_SRC = _jnp_keccak._pi_source()
-_RC = _jnp_keccak._round_constants()
-
-_ROWS = 56  # 25 hi + pad + 25 lo, multiple of 8
-_LO_OFF = 28
-_BLOCK_B = 128
+#: sponges per grid step: 8 sublanes x 128 lanes = one vreg per state word.
+_TS, _TL = 8, 128
+BT = _TS * _TL
 
 
-def _rotl_pair(hi, lo, n: int):
+def _rotl(hi, lo, n: int):
     n %= 64
     if n == 0:
         return hi, lo
@@ -58,82 +56,103 @@ def _rotl_pair(hi, lo, n: int):
     return (hi << n) | (lo >> (32 - n)), (lo << n) | (hi >> (32 - n))
 
 
-def _kernel(state_ref, out_ref):
-    # load the full 56x128 block once; all rounds run on register/VMEM values
-    s = state_ref[:].astype(jnp.uint32)
-    hi = [s[i, :] for i in range(25)]
-    lo = [s[_LO_OFF + i, :] for i in range(25)]
+def _f1600(sh: list, sl: list) -> tuple[list, list]:
+    """One Keccak-f[1600] permutation over 50 (8, 128) uint32 tiles."""
     for rnd in range(24):
         # theta
-        ch = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20] for x in range(5)]
-        cl = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20] for x in range(5)]
+        ch = [sh[x] ^ sh[x + 5] ^ sh[x + 10] ^ sh[x + 15] ^ sh[x + 20] for x in range(5)]
+        cl = [sl[x] ^ sl[x + 5] ^ sl[x + 10] ^ sl[x + 15] ^ sl[x + 20] for x in range(5)]
         for x in range(5):
-            r1h, r1l = _rotl_pair(ch[(x + 1) % 5], cl[(x + 1) % 5], 1)
-            dh = ch[(x + 4) % 5] ^ r1h
-            dl = cl[(x + 4) % 5] ^ r1l
+            rh, rl = _rotl(ch[(x + 1) % 5], cl[(x + 1) % 5], 1)
+            dh, dl = ch[(x + 4) % 5] ^ rh, cl[(x + 4) % 5] ^ rl
             for y in range(5):
-                hi[x + 5 * y] = hi[x + 5 * y] ^ dh
-                lo[x + 5 * y] = lo[x + 5 * y] ^ dl
+                sh[x + 5 * y] = sh[x + 5 * y] ^ dh
+                sl[x + 5 * y] = sl[x + 5 * y] ^ dl
         # rho + pi
-        bh = [None] * 25
-        bl = [None] * 25
+        bh, bl = [None] * 25, [None] * 25
         for dst in range(25):
             src = int(_PI_SRC[dst])
-            bh[dst], bl[dst] = _rotl_pair(hi[src], lo[src], int(_RHO[src]))
+            bh[dst], bl[dst] = _rotl(sh[src], sl[src], int(_RHO[src]))
         # chi
         for y in range(5):
             row_h = [bh[x + 5 * y] for x in range(5)]
             row_l = [bl[x + 5 * y] for x in range(5)]
             for x in range(5):
-                hi[x + 5 * y] = row_h[x] ^ (~row_h[(x + 1) % 5] & row_h[(x + 2) % 5])
-                lo[x + 5 * y] = row_l[x] ^ (~row_l[(x + 1) % 5] & row_l[(x + 2) % 5])
+                sh[x + 5 * y] = row_h[x] ^ (~row_h[(x + 1) % 5] & row_h[(x + 2) % 5])
+                sl[x + 5 * y] = row_l[x] ^ (~row_l[(x + 1) % 5] & row_l[(x + 2) % 5])
         # iota
-        hi[0] = hi[0] ^ jnp.uint32(int(_RC[rnd, 0]))
-        lo[0] = lo[0] ^ jnp.uint32(int(_RC[rnd, 1]))
-    out = jnp.zeros((_ROWS, _BLOCK_B), jnp.uint32)
-    for i in range(25):
-        out = out.at[i, :].set(hi[i])
-        out = out.at[_LO_OFF + i, :].set(lo[i])
-    out_ref[:] = out.astype(jnp.int32)
+        sh[0] = sh[0] ^ jnp.uint32(int(_RC[rnd, 0]))
+        sl[0] = sl[0] ^ jnp.uint32(int(_RC[rnd, 1]))
+    return sh, sl
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _permute_blocks(packed: jax.Array, interpret: bool = False) -> jax.Array:
-    """(56, B) int32 with B % 128 == 0 -> permuted, same shape."""
-    nb = packed.shape[1] // _BLOCK_B
-    return pl.pallas_call(
-        _kernel,
-        out_shape=jax.ShapeDtypeStruct(packed.shape, jnp.int32),
-        grid=(nb,),
-        in_specs=[pl.BlockSpec((_ROWS, _BLOCK_B), lambda i: (0, i))],
-        out_specs=pl.BlockSpec((_ROWS, _BLOCK_B), lambda i: (0, i)),
-        interpret=interpret,
-    )(packed)
+def _sponge_kernel(in_hi_ref, in_lo_ref, out_hi_ref, out_lo_ref,
+                   *, rate_words: int, n_abs: int, n_sq: int):
+    zero = jnp.zeros((_TS, _TL), jnp.uint32)
+    sh = [zero] * 25
+    sl = [zero] * 25
+    for blk in range(n_abs):
+        for w in range(rate_words):
+            r = blk * rate_words + w
+            sh[w] = sh[w] ^ in_hi_ref[r]
+            sl[w] = sl[w] ^ in_lo_ref[r]
+        sh, sl = _f1600(sh, sl)
+    for blk in range(n_sq):
+        for w in range(rate_words):
+            r = blk * rate_words + w
+            out_hi_ref[r] = sh[w]
+            out_lo_ref[r] = sl[w]
+        if blk + 1 < n_sq:
+            sh, sl = _f1600(sh, sl)
 
 
-def keccak_f1600(hi: jax.Array, lo: jax.Array, interpret: bool = False):
-    """Drop-in for core.keccak.keccak_f1600 on 2-D (batch, 25) uint32 pairs.
+@functools.partial(jax.jit, static_argnames=("rate_words", "n_abs", "n_sq", "interpret"))
+def sponge_words(in_hi: jax.Array, in_lo: jax.Array, *, rate_words: int,
+                 n_abs: int, n_sq: int, interpret: bool = False):
+    """Padded-message sponge over word-transposed batches.
 
-    Pads the batch up to a multiple of 128 and runs the Pallas kernel; use on
-    TPU (or interpret=True in tests).
+    Args:
+      in_hi/in_lo: (n_abs*rate_words, B) uint32 — padded message lane words,
+        batch on the minor axis (B need not be a multiple of the tile).
+      rate_words: sponge rate in 64-bit lanes (21 SHAKE128, 17 SHAKE256,
+        17 SHA3-256, 9 SHA3-512).
+      n_abs/n_sq: number of absorb / squeeze blocks (static).
+
+    Returns:
+      (out_hi, out_lo): (n_sq*rate_words, B) uint32 squeezed lane words.
     """
-    if not _HAVE_PALLAS:
-        return _jnp_keccak.keccak_f1600(hi, lo)
-    b = hi.shape[0]
-    bpad = -(-b // _BLOCK_B) * _BLOCK_B
-    packed = jnp.zeros((_ROWS, bpad), jnp.int32)
-    packed = packed.at[:25, :b].set(hi.astype(jnp.int32).T)
-    packed = packed.at[_LO_OFF : _LO_OFF + 25, :b].set(lo.astype(jnp.int32).T)
-    out = _permute_blocks(packed, interpret=interpret)
-    return (
-        out[:25, :b].T.astype(jnp.uint32),
-        out[_LO_OFF : _LO_OFF + 25, :b].T.astype(jnp.uint32),
+    in_words, b = in_hi.shape
+    assert in_words == n_abs * rate_words
+    bp = -(-b // BT) * BT
+    if bp != b:
+        pad = ((0, 0), (0, bp - b))
+        in_hi = jnp.pad(in_hi, pad)
+        in_lo = jnp.pad(in_lo, pad)
+    # (W, B) -> (W, B/128, 128): sponge j*128+l sits at [:, j, l]; a grid step
+    # covers 8 consecutive j (one full vreg tile per state word).
+    in_hi = in_hi.reshape(in_words, bp // _TL, _TL)
+    in_lo = in_lo.reshape(in_words, bp // _TL, _TL)
+    out_words = n_sq * rate_words
+    kern = functools.partial(
+        _sponge_kernel, rate_words=rate_words, n_abs=n_abs, n_sq=n_sq
     )
-
-
-def use_pallas_on_tpu() -> bool:
-    """True when the default backend is a TPU (where the kernel is worth it)."""
-    try:
-        return _HAVE_PALLAS and jax.default_backend() not in ("cpu",)
-    except Exception:  # pragma: no cover
-        return False
+    out_hi, out_lo = pl.pallas_call(
+        kern,
+        grid=(bp // BT,),
+        in_specs=[
+            pl.BlockSpec((in_words, _TS, _TL), lambda i: (0, i, 0)),
+            pl.BlockSpec((in_words, _TS, _TL), lambda i: (0, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((out_words, _TS, _TL), lambda i: (0, i, 0)),
+            pl.BlockSpec((out_words, _TS, _TL), lambda i: (0, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((out_words, bp // _TL, _TL), jnp.uint32),
+            jax.ShapeDtypeStruct((out_words, bp // _TL, _TL), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(in_hi, in_lo)
+    out_hi = out_hi.reshape(out_words, bp)[:, :b]
+    out_lo = out_lo.reshape(out_words, bp)[:, :b]
+    return out_hi, out_lo

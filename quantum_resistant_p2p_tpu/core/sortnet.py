@@ -1,0 +1,66 @@
+"""Gather-free bitonic sorting networks for TPU.
+
+XLA's sort/argsort, lax.top_k, take_along_axis and scatter all serialise on
+TPU for per-lane dynamic indices (measured 120-1160 ms for a (36864, 448)
+compaction — the entire ML-KEM encaps budget).  A bitonic network expressed
+as reshapes + min/max + where with *static* direction masks lowers to pure
+vectorised VPU ops: the same compaction runs in ~13 ms.
+
+Used for the rejection-sampling compactions in kem/mlkem.py (SampleNTT) and
+sig/mldsa.py (RejNTT / SampleInBall), where spec order of accepted candidates
+must be preserved: callers embed the candidate index in the sort key, making
+the (unstable) bitonic network a deterministic stable partition.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def bitonic_sort(x: jax.Array) -> jax.Array:
+    """Sort ascending along the last axis; length must be a power of two."""
+    n = x.shape[-1]
+    stages = int(np.log2(n))
+    assert 1 << stages == n, f"bitonic length must be a power of 2, got {n}"
+    for k in range(1, stages + 1):
+        for j in range(k - 1, -1, -1):
+            d = 1 << j
+            xr = x.reshape(x.shape[:-1] + (n // (2 * d), 2, d))
+            a, b = xr[..., 0, :], xr[..., 1, :]
+            idx = np.arange(n // (2 * d))[:, None] * 2 * d + np.arange(d)[None, :]
+            desc = jnp.asarray(((idx >> k) & 1).astype(bool))
+            lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+            x = jnp.stack(
+                [jnp.where(desc, hi, lo), jnp.where(desc, lo, hi)], axis=-2
+            ).reshape(x.shape)
+    return x
+
+
+def bitonic_sort_pairs(key: jax.Array, val: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Sort ``key`` ascending along the last axis, carrying ``val`` along.
+
+    Keys must be unique per lane (callers embed the element index), so the
+    network's instability is unobservable.
+    """
+    n = key.shape[-1]
+    stages = int(np.log2(n))
+    assert 1 << stages == n, f"bitonic length must be a power of 2, got {n}"
+    for k in range(1, stages + 1):
+        for j in range(k - 1, -1, -1):
+            d = 1 << j
+            kr = key.reshape(key.shape[:-1] + (n // (2 * d), 2, d))
+            vr = val.reshape(val.shape[:-1] + (n // (2 * d), 2, d))
+            ka, kb = kr[..., 0, :], kr[..., 1, :]
+            va, vb = vr[..., 0, :], vr[..., 1, :]
+            idx = np.arange(n // (2 * d))[:, None] * 2 * d + np.arange(d)[None, :]
+            desc = jnp.asarray(((idx >> k) & 1).astype(bool))
+            swap = (ka > kb) ^ desc
+            ka2 = jnp.where(swap, kb, ka)
+            kb2 = jnp.where(swap, ka, kb)
+            va2 = jnp.where(swap, vb, va)
+            vb2 = jnp.where(swap, va, vb)
+            key = jnp.stack([ka2, kb2], axis=-2).reshape(key.shape)
+            val = jnp.stack([va2, vb2], axis=-2).reshape(val.shape)
+    return key, val

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import keccak
+from ..core.sortnet import bitonic_sort
 from ..pyref.mlkem_ref import (  # parameter sets + computed constant tables
     GAMMAS,
     MLKEM512,
@@ -139,17 +140,28 @@ def sample_ntt(seeds: jax.Array) -> jax.Array:
 
     Fixed-shape replacement for the spec's squeeze-until-256-accepted loop:
     squeeze 672 bytes up front, mark candidates < q, and compact accepted
-    candidates to the front with a stable argsort on the reject mask (order
-    preserved == spec order).
+    candidates to the front in spec order.  The compaction is a gather-free
+    bitonic network over packed int32 keys (reject | index | value) — XLA's
+    argsort/take_along_axis serialise on TPU and measured 200+ ms per batch,
+    the entire encaps budget (core/sortnet.py).
     """
     buf = keccak.shake128(seeds, _SAMPLE_NTT_BYTES).astype(jnp.int32)
     t = buf.reshape(buf.shape[:-1] + (-1, 3))
     d1 = t[..., 0] + 256 * (t[..., 1] % 16)
     d2 = (t[..., 1] // 16) + 16 * t[..., 2]
     cand = jnp.stack([d1, d2], axis=-1).reshape(buf.shape[:-1] + (-1,))
-    reject = (cand >= Q).astype(jnp.int8)
-    order = jnp.argsort(reject, axis=-1, stable=True)
-    return jnp.take_along_axis(cand, order, axis=-1)[..., :N]
+    nc = cand.shape[-1]
+    idx = jnp.arange(nc, dtype=jnp.int32)
+    # key: accepted (bit 21 clear) before rejected, index order within each,
+    # 12-bit candidate value in the low bits.  Unique keys => stable partition.
+    key = jnp.where(cand < Q, 0, 1 << 21) | (idx << 12) | cand
+    np2 = 1 << (nc - 1).bit_length()
+    key = jnp.pad(
+        key,
+        [(0, 0)] * (key.ndim - 1) + [(0, np2 - nc)],
+        constant_values=1 << 22,
+    )
+    return bitonic_sort(key)[..., :N] & 0xFFF
 
 
 def sample_poly_cbd(b: jax.Array, eta: int) -> jax.Array:

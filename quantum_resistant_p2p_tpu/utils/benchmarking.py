@@ -29,19 +29,31 @@ def sync(tree: Any) -> None:
             np.asarray(jax.device_get(leaf.ravel()[:1] if hasattr(leaf, "ravel") else leaf))
 
 
-def timeit(fn: Callable, *args, reps: int = 3, trials: int = 3) -> float:
+def timeit(fn: Callable, *args, min_time_s: float = 1.5, trials: int = 2) -> float:
     """Best-of-``trials`` mean seconds per call of ``fn(*args)``, honest-sync.
 
     The first call (compile + warm-up) is excluded.  Each trial times ``reps``
-    back-to-back dispatches ending in one forced readback.
+    back-to-back dispatches ending in one forced readback; ``reps`` is grown
+    until a trial takes at least ``min_time_s`` so the tunnel's ~100 ms fixed
+    round-trip latency (measured on this environment's remote TPU) inflates
+    the result by <~7% — the reported number is conservative, never flattering.
     """
     sync(fn(*args))  # compile + warm caches
-    best = float("inf")
-    for _ in range(trials):
+
+    def trial(reps: int) -> float:
         t0 = time.perf_counter()
         out = None
         for _ in range(reps):
             out = fn(*args)
         sync(out)
-        best = min(best, (time.perf_counter() - t0) / reps)
-    return best
+        return time.perf_counter() - t0
+
+    reps = 1
+    total = trial(reps)
+    while total < min_time_s:
+        reps = max(reps * 2, int(reps * min_time_s / max(total, 1e-6)) + 1)
+        total = trial(reps)
+    best = total
+    for _ in range(trials - 1):
+        best = min(best, trial(reps))
+    return best / reps
