@@ -11,6 +11,7 @@
 // Build: g++ -O3 -shared -fPIC -o libqrp_native.so qrp_native.cpp
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <cstddef>
 
@@ -373,6 +374,571 @@ void kpke_decrypt(const MLKEMParams& p, const uint8_t* dk, const uint8_t* ct,
   byte_encode(bits, 1, m);
 }
 
+// ---------------------------------------------------------------- ML-DSA
+//
+// FIPS 204, internal forms with deterministic seams matching
+// pyref/mldsa_ref.py: keygen(xi), sign_internal(sk, m_prime, rnd),
+// verify_internal(pk, m_prime, sigma).  Replaces (reference): liboqs ML-DSA
+// reached via crypto/signatures.py:58-188.
+
+namespace mldsa {
+
+constexpr int32_t MQ = 8380417;
+constexpr int MD = 13;  // dropped bits (Power2Round)
+
+struct Params {
+  int k, l, eta, tau, omega;
+  int32_t gamma1, gamma2;
+  int ctilde_len, z_bits, w1_bits, s_bits;
+  int pk_len, sk_len, sig_len;
+};
+
+constexpr Params P44 = {4, 4, 2, 39, 80, 1 << 17, (MQ - 1) / 88,
+                        32, 18, 6, 3, 1312, 2560, 2420};
+constexpr Params P65 = {6, 5, 4, 49, 55, 1 << 19, (MQ - 1) / 32,
+                        48, 20, 4, 4, 1952, 4032, 3309};
+constexpr Params P87 = {8, 7, 2, 60, 75, 1 << 19, (MQ - 1) / 32,
+                        64, 20, 4, 3, 2592, 4896, 4627};
+
+inline const Params& params_for(int level) {
+  if (level == 2) return P44;
+  if (level == 3) return P65;
+  return P87;
+}
+
+inline int32_t freeze(int64_t x) {
+  int32_t r = (int32_t)(x % MQ);
+  return r < 0 ? r + MQ : r;
+}
+
+inline void secure_wipe(void* p, size_t n) {
+  volatile uint8_t* b = (volatile uint8_t*)p;
+  while (n--) *b++ = 0;
+}
+
+inline int32_t center(int32_t x, int32_t m) {  // mod+- into (-m/2, m/2]
+  int32_t r = x % m;
+  if (r < 0) r += m;
+  if (r > m / 2) r -= m;
+  return r;
+}
+
+int32_t DZETAS[256];
+struct DZetaInit {
+  DZetaInit() {
+    auto pw = [](int64_t b, int e) {
+      int64_t r = 1;
+      while (e) {
+        if (e & 1) r = r * b % MQ;
+        b = b * b % MQ;
+        e >>= 1;
+      }
+      return r;
+    };
+    for (int i = 0; i < 256; ++i) {
+      int rev = 0;
+      for (int b = 0; b < 8; ++b)
+        if (i & (1 << b)) rev |= 1 << (7 - b);
+      DZETAS[i] = (int32_t)pw(1753, rev);
+    }
+  }
+} dzeta_init;
+
+void dntt(int32_t f[N]) {
+  int kidx = 0;
+  for (int len = 128; len >= 1; len >>= 1)
+    for (int start = 0; start < N; start += 2 * len) {
+      int64_t z = DZETAS[++kidx];
+      for (int j = start; j < start + len; ++j) {
+        int32_t t = freeze(z * f[j + len]);
+        f[j + len] = freeze((int64_t)f[j] - t);
+        f[j] = freeze((int64_t)f[j] + t);
+      }
+    }
+}
+
+void dntt_inv(int32_t f[N]) {
+  int kidx = 256;
+  for (int len = 1; len <= 128; len <<= 1)
+    for (int start = 0; start < N; start += 2 * len) {
+      int64_t z = DZETAS[--kidx];
+      for (int j = start; j < start + len; ++j) {
+        int32_t t = f[j];
+        f[j] = freeze((int64_t)t + f[j + len]);
+        f[j + len] = freeze(z * ((int64_t)f[j + len] - t));
+      }
+    }
+  constexpr int64_t n_inv = 8347681;  // 256^-1 mod q
+  for (int j = 0; j < N; ++j) f[j] = freeze(n_inv * f[j]);
+}
+
+inline void pw_mul(const int32_t a[N], const int32_t b[N], int32_t out[N]) {
+  for (int i = 0; i < N; ++i) out[i] = freeze((int64_t)a[i] * b[i]);
+}
+
+// -- rounding ---------------------------------------------------------------
+
+inline void power2round(int32_t r, int32_t& r1, int32_t& r0) {
+  r = freeze(r);
+  r0 = center(r, 1 << MD);
+  r1 = (r - r0) >> MD;
+}
+
+inline void decompose(const Params& p, int32_t r, int32_t& r1, int32_t& r0) {
+  int32_t alpha = 2 * p.gamma2;
+  r = freeze(r);
+  r0 = center(r, alpha);
+  if (r - r0 == MQ - 1) {
+    r1 = 0;
+    r0 -= 1;
+  } else {
+    r1 = (r - r0) / alpha;
+  }
+}
+
+inline int32_t high_bits(const Params& p, int32_t r) {
+  int32_t r1, r0;
+  decompose(p, r, r1, r0);
+  return r1;
+}
+
+inline int use_hint(const Params& p, int h, int32_t r) {
+  int32_t m = (MQ - 1) / (2 * p.gamma2);
+  int32_t r1, r0;
+  decompose(p, r, r1, r0);
+  if (!h) return r1;
+  return r0 > 0 ? (r1 + 1) % m : ((r1 - 1) % m + m) % m;
+}
+
+// -- packing ----------------------------------------------------------------
+
+void simple_bit_pack(const int32_t* c, int bits, uint8_t* out) {
+  std::memset(out, 0, 32 * bits);
+  int pos = 0;
+  for (int i = 0; i < N; ++i)
+    for (int j = 0; j < bits; ++j, ++pos)
+      out[pos >> 3] |= (uint8_t)(((c[i] >> j) & 1) << (pos & 7));
+}
+
+void simple_bit_unpack(const uint8_t* b, int bits, int32_t* out) {
+  int pos = 0;
+  for (int i = 0; i < N; ++i) {
+    int32_t v = 0;
+    for (int j = 0; j < bits; ++j, ++pos)
+      v |= (int32_t)((b[pos >> 3] >> (pos & 7)) & 1) << j;
+    out[i] = v;
+  }
+}
+
+// pack coeffs as (up - centered(c)) in `bits` bits
+void bit_pack(const int32_t* c, int32_t up, int bits, uint8_t* out) {
+  int32_t tmp[N];
+  for (int i = 0; i < N; ++i) tmp[i] = up - center(freeze(c[i]), MQ);
+  simple_bit_pack(tmp, bits, out);
+}
+
+void bit_unpack(const uint8_t* b, int32_t up, int bits, int32_t* out) {
+  simple_bit_unpack(b, bits, out);
+  for (int i = 0; i < N; ++i) out[i] = freeze((int64_t)up - out[i]);
+}
+
+// center(freeze(x)) over the full field
+inline int32_t qcenter(int32_t x) { return center(freeze(x), MQ); }
+
+// -- samplers ---------------------------------------------------------------
+
+void rej_ntt_poly(const uint8_t seed[34], int32_t out[N]) {
+  Sponge sp(168);
+  sp.absorb(seed, 34);
+  sp.finish(0x1f);
+  uint8_t buf[168];
+  int count = 0;
+  while (count < N) {
+    sp.squeeze(buf, 168);
+    for (int i = 0; i + 3 <= 168 && count < N; i += 3) {
+      int32_t t = buf[i] | (buf[i + 1] << 8) | ((int32_t)(buf[i + 2] & 0x7f) << 16);
+      if (t < MQ) out[count++] = t;
+    }
+  }
+}
+
+void rej_bounded_poly(int eta, const uint8_t seed[66], int32_t out[N]) {
+  Sponge sp(136);
+  sp.absorb(seed, 66);
+  sp.finish(0x1f);
+  uint8_t buf[136];
+  int count = 0;
+  while (count < N) {
+    sp.squeeze(buf, 136);
+    for (int i = 0; i < 136 && count < N; ++i) {
+      for (int half = 0; half < 2 && count < N; ++half) {
+        int z = half ? (buf[i] >> 4) : (buf[i] & 0xf);
+        if (eta == 2 && z < 15) out[count++] = freeze(2 - z % 5);
+        else if (eta == 4 && z < 9) out[count++] = freeze(4 - z);
+      }
+    }
+  }
+}
+
+void sample_in_ball(const Params& p, const uint8_t* ctilde, int32_t c[N]) {
+  Sponge sp(136);
+  sp.absorb(ctilde, (size_t)p.ctilde_len);
+  sp.finish(0x1f);
+  uint8_t signs[8];
+  sp.squeeze(signs, 8);
+  uint64_t sbits = 0;
+  for (int i = 0; i < 8; ++i) sbits |= (uint64_t)signs[i] << (8 * i);
+  std::memset(c, 0, N * sizeof(int32_t));
+  for (int i = N - p.tau; i < N; ++i) {
+    uint8_t j;
+    do sp.squeeze(&j, 1); while (j > i);
+    c[i] = c[j];
+    c[j] = (sbits & 1) ? MQ - 1 : 1;
+    sbits >>= 1;
+  }
+}
+
+void expand_a(const Params& p, const uint8_t rho[32], int32_t a[8][7][N]) {
+  uint8_t seed[34];
+  std::memcpy(seed, rho, 32);
+  for (int r = 0; r < p.k; ++r)
+    for (int s = 0; s < p.l; ++s) {
+      seed[32] = (uint8_t)s;
+      seed[33] = (uint8_t)r;
+      rej_ntt_poly(seed, a[r][s]);
+    }
+}
+
+// -- hints ------------------------------------------------------------------
+
+void hint_bit_pack(const Params& p, const uint8_t h[8][N], uint8_t* out) {
+  std::memset(out, 0, (size_t)(p.omega + p.k));
+  int idx = 0;
+  for (int i = 0; i < p.k; ++i) {
+    for (int j = 0; j < N; ++j)
+      if (h[i][j]) out[idx++] = (uint8_t)j;
+    out[p.omega + i] = (uint8_t)idx;
+  }
+}
+
+bool hint_bit_unpack(const Params& p, const uint8_t* b, uint8_t h[8][N]) {
+  std::memset(h, 0, 8 * N);
+  int idx = 0;
+  for (int i = 0; i < p.k; ++i) {
+    int end = b[p.omega + i];
+    if (end < idx || end > p.omega) return false;
+    int prev = -1;
+    while (idx < end) {
+      int j = b[idx];
+      if (prev >= 0 && j <= prev) return false;
+      h[i][j] = 1;
+      prev = j;
+      ++idx;
+    }
+  }
+  for (int i = idx; i < p.omega; ++i)
+    if (b[i] != 0) return false;
+  return true;
+}
+
+// -- keygen / sign / verify -------------------------------------------------
+
+void keygen(const Params& p, const uint8_t xi[32], uint8_t* pk, uint8_t* sk) {
+  uint8_t seed_in[34], seed[128];
+  std::memcpy(seed_in, xi, 32);
+  seed_in[32] = (uint8_t)p.k;
+  seed_in[33] = (uint8_t)p.l;
+  shake(136, seed_in, 34, seed, 128);
+  const uint8_t* rho = seed;
+  const uint8_t* rhop = seed + 32;
+  const uint8_t* cap_k = seed + 96;
+
+  static thread_local int32_t a[8][7][N];
+  expand_a(p, rho, a);
+
+  uint8_t sseed[66];
+  std::memcpy(sseed, rhop, 64);
+  int32_t s1[7][N], s2[8][N], s1h[7][N];
+  for (int n = 0; n < p.l; ++n) {
+    sseed[64] = (uint8_t)n;
+    sseed[65] = 0;
+    rej_bounded_poly(p.eta, sseed, s1[n]);
+  }
+  for (int n = 0; n < p.k; ++n) {
+    sseed[64] = (uint8_t)(p.l + n);
+    sseed[65] = 0;
+    rej_bounded_poly(p.eta, sseed, s2[n]);
+  }
+  for (int n = 0; n < p.l; ++n) {
+    std::memcpy(s1h[n], s1[n], sizeof(s1h[n]));
+    dntt(s1h[n]);
+  }
+  // t = invNTT(A s1) + s2 ; split into t1/t0
+  int32_t t1[8][N], t0[8][N];
+  for (int r = 0; r < p.k; ++r) {
+    int32_t acc[N] = {0}, tmp[N];
+    for (int s = 0; s < p.l; ++s) {
+      pw_mul(a[r][s], s1h[s], tmp);
+      for (int n = 0; n < N; ++n) acc[n] = freeze((int64_t)acc[n] + tmp[n]);
+    }
+    dntt_inv(acc);
+    for (int n = 0; n < N; ++n) {
+      int32_t t = freeze((int64_t)acc[n] + s2[r][n]);
+      power2round(t, t1[r][n], t0[r][n]);
+    }
+  }
+  // pk = rho || pack(t1, 10)
+  std::memcpy(pk, rho, 32);
+  for (int r = 0; r < p.k; ++r) simple_bit_pack(t1[r], 23 - MD, pk + 32 + r * 320);
+  // sk = rho || K || tr || pack(s1) || pack(s2) || pack(t0)
+  uint8_t tr[64];
+  shake(136, pk, (size_t)p.pk_len, tr, 64);
+  std::memcpy(sk, rho, 32);
+  std::memcpy(sk + 32, cap_k, 32);
+  std::memcpy(sk + 64, tr, 64);
+  int off = 128, sb = 32 * p.s_bits;
+  for (int n = 0; n < p.l; ++n, off += sb) bit_pack(s1[n], p.eta, p.s_bits, sk + off);
+  for (int n = 0; n < p.k; ++n, off += sb) bit_pack(s2[n], p.eta, p.s_bits, sk + off);
+  for (int r = 0; r < p.k; ++r, off += 32 * MD)
+    bit_pack(t0[r], 1 << (MD - 1), MD, sk + off);
+  secure_wipe(s1, sizeof(s1));
+  secure_wipe(s2, sizeof(s2));
+  secure_wipe(s1h, sizeof(s1h));
+  secure_wipe(t0, sizeof(t0));
+  secure_wipe(seed, sizeof(seed));
+}
+
+// scratch shared by sign/verify (single-threaded per-thread use)
+struct SignScratch {
+  int32_t a[8][7][N];
+  int32_t s1h[7][N], s2h[8][N], t0h[8][N];
+  int32_t y[7][N], yh[7][N], w[8][N], w1[8][N];
+  int32_t z[7][N], c[N], ch[N];
+  int32_t cs2[8][N], ct0[8][N], rm[8][N];
+  uint8_t h[8][N];
+};
+
+void sign_internal(const Params& p, const uint8_t* sk, const uint8_t* m_prime,
+                   size_t mlen, const uint8_t rnd[32], uint8_t* sig) {
+  const uint8_t* rho = sk;
+  const uint8_t* cap_k = sk + 32;
+  const uint8_t* tr = sk + 64;
+  int off = 128, sb = 32 * p.s_bits;
+  static thread_local SignScratch S;
+  for (int n = 0; n < p.l; ++n, off += sb) {
+    bit_unpack(sk + off, p.eta, p.s_bits, S.s1h[n]);
+    dntt(S.s1h[n]);
+  }
+  for (int n = 0; n < p.k; ++n, off += sb) {
+    bit_unpack(sk + off, p.eta, p.s_bits, S.s2h[n]);
+    dntt(S.s2h[n]);
+  }
+  for (int r = 0; r < p.k; ++r, off += 32 * MD) {
+    bit_unpack(sk + off, 1 << (MD - 1), MD, S.t0h[r]);
+    dntt(S.t0h[r]);
+  }
+  expand_a(p, rho, S.a);
+
+  uint8_t mu[64];
+  {
+    Sponge sp(136);
+    sp.absorb(tr, 64);
+    sp.absorb(m_prime, mlen);
+    sp.finish(0x1f);
+    sp.squeeze(mu, 64);
+  }
+  uint8_t rhopp[64];
+  {
+    Sponge sp(136);
+    sp.absorb(cap_k, 32);
+    sp.absorb(rnd, 32);
+    sp.absorb(mu, 64);
+    sp.finish(0x1f);
+    sp.squeeze(rhopp, 64);
+  }
+
+  uint8_t w1_enc[8 * 32 * 6];  // k * 32 * w1_bits max
+  int w1_bytes = 32 * p.w1_bits;
+  for (uint16_t kappa = 0;; kappa = (uint16_t)(kappa + p.l)) {
+    // y = ExpandMask
+    for (int r = 0; r < p.l; ++r) {
+      uint8_t mseed[66];
+      std::memcpy(mseed, rhopp, 64);
+      uint16_t idx = (uint16_t)(kappa + r);
+      mseed[64] = (uint8_t)(idx & 0xff);
+      mseed[65] = (uint8_t)(idx >> 8);
+      uint8_t buf[32 * 20];
+      shake(136, mseed, 66, buf, (size_t)(32 * p.z_bits));
+      bit_unpack(buf, p.gamma1, p.z_bits, S.y[r]);
+      std::memcpy(S.yh[r], S.y[r], sizeof(S.yh[r]));
+      dntt(S.yh[r]);
+    }
+    // w = invNTT(A yh); w1 = HighBits(w)
+    for (int r = 0; r < p.k; ++r) {
+      int32_t acc[N] = {0}, tmp[N];
+      for (int s = 0; s < p.l; ++s) {
+        pw_mul(S.a[r][s], S.yh[s], tmp);
+        for (int n = 0; n < N; ++n) acc[n] = freeze((int64_t)acc[n] + tmp[n]);
+      }
+      dntt_inv(acc);
+      std::memcpy(S.w[r], acc, sizeof(acc));
+      for (int n = 0; n < N; ++n) {
+        int32_t r1, r0;
+        decompose(p, acc[n], r1, r0);
+        S.w1[r][n] = r1;
+      }
+      simple_bit_pack(S.w1[r], p.w1_bits, w1_enc + r * w1_bytes);
+    }
+    uint8_t ctilde[64];
+    {
+      Sponge sp(136);
+      sp.absorb(mu, 64);
+      sp.absorb(w1_enc, (size_t)(p.k * w1_bytes));
+      sp.finish(0x1f);
+      sp.squeeze(ctilde, (size_t)p.ctilde_len);
+    }
+    sample_in_ball(p, ctilde, S.c);
+    std::memcpy(S.ch, S.c, sizeof(S.c));
+    dntt(S.ch);
+    // z = y + invNTT(ch * s1h); check norm
+    bool ok = true;
+    for (int s = 0; s < p.l && ok; ++s) {
+      int32_t tmp[N];
+      pw_mul(S.ch, S.s1h[s], tmp);
+      dntt_inv(tmp);
+      for (int n = 0; n < N; ++n) {
+        S.z[s][n] = freeze((int64_t)S.y[s][n] + tmp[n]);
+        if (abs(qcenter(S.z[s][n])) >= p.gamma1 - p.tau * p.eta) {
+          ok = false;
+          break;
+        }
+      }
+    }
+    if (!ok) continue;
+    // r_minus = w - invNTT(ch*s2h); LowBits norm check
+    for (int r = 0; r < p.k && ok; ++r) {
+      pw_mul(S.ch, S.s2h[r], S.cs2[r]);
+      dntt_inv(S.cs2[r]);
+      for (int n = 0; n < N; ++n) {
+        S.rm[r][n] = freeze((int64_t)S.w[r][n] - S.cs2[r][n]);
+        int32_t r1, r0;
+        decompose(p, S.rm[r][n], r1, r0);
+        if (abs(r0) >= p.gamma2 - p.tau * p.eta) {
+          ok = false;
+          break;
+        }
+      }
+    }
+    if (!ok) continue;
+    // ct0 norm check
+    for (int r = 0; r < p.k && ok; ++r) {
+      pw_mul(S.ch, S.t0h[r], S.ct0[r]);
+      dntt_inv(S.ct0[r]);
+      for (int n = 0; n < N; ++n)
+        if (abs(qcenter(S.ct0[r][n])) >= p.gamma2) {
+          ok = false;
+          break;
+        }
+    }
+    if (!ok) continue;
+    // hints
+    int hcount = 0;
+    for (int r = 0; r < p.k; ++r)
+      for (int n = 0; n < N; ++n) {
+        // MakeHint(-ct0, rm + ct0): HighBits(rm) vs HighBits(rm + ct0)
+        int32_t ct0c = qcenter(S.ct0[r][n]);
+        int32_t rmc = qcenter(S.rm[r][n]);
+        int32_t hi_with = high_bits(p, freeze(rmc));
+        int32_t hi_base = high_bits(p, freeze((int64_t)rmc + ct0c));
+        S.h[r][n] = (uint8_t)(hi_with != hi_base);
+        hcount += S.h[r][n];
+      }
+    if (hcount > p.omega) continue;
+    // serialize
+    std::memcpy(sig, ctilde, (size_t)p.ctilde_len);
+    int soff = p.ctilde_len;
+    for (int s = 0; s < p.l; ++s, soff += 32 * p.z_bits)
+      bit_pack(S.z[s], p.gamma1, p.z_bits, sig + soff);
+    hint_bit_pack(p, S.h, sig + soff);
+    // wipe secret-derived state (expanded sk, masks, rho''); A and the
+    // emitted signature are public
+    secure_wipe(S.s1h, sizeof(S.s1h));
+    secure_wipe(S.s2h, sizeof(S.s2h));
+    secure_wipe(S.t0h, sizeof(S.t0h));
+    secure_wipe(S.y, sizeof(S.y));
+    secure_wipe(S.yh, sizeof(S.yh));
+    secure_wipe(S.cs2, sizeof(S.cs2));
+    secure_wipe(S.ct0, sizeof(S.ct0));
+    secure_wipe(S.rm, sizeof(S.rm));
+    secure_wipe(S.w, sizeof(S.w));
+    secure_wipe(rhopp, sizeof(rhopp));
+    return;
+  }
+}
+
+bool verify_internal(const Params& p, const uint8_t* pk, const uint8_t* m_prime,
+                     size_t mlen, const uint8_t* sig) {
+  static thread_local SignScratch S;
+  const uint8_t* rho = pk;
+  int32_t t1[8][N];
+  for (int r = 0; r < p.k; ++r) simple_bit_unpack(pk + 32 + r * 320, 23 - MD, t1[r]);
+  const uint8_t* ctilde = sig;
+  int off = p.ctilde_len;
+  for (int s = 0; s < p.l; ++s, off += 32 * p.z_bits) {
+    bit_unpack(sig + off, p.gamma1, p.z_bits, S.z[s]);
+    for (int n = 0; n < N; ++n)
+      if (abs(qcenter(S.z[s][n])) >= p.gamma1 - p.tau * p.eta) return false;
+  }
+  if (!hint_bit_unpack(p, sig + off, S.h)) return false;
+  expand_a(p, rho, S.a);
+  uint8_t tr[64], mu[64];
+  shake(136, pk, (size_t)p.pk_len, tr, 64);
+  {
+    Sponge sp(136);
+    sp.absorb(tr, 64);
+    sp.absorb(m_prime, mlen);
+    sp.finish(0x1f);
+    sp.squeeze(mu, 64);
+  }
+  sample_in_ball(p, ctilde, S.c);
+  std::memcpy(S.ch, S.c, sizeof(S.c));
+  dntt(S.ch);
+  for (int s = 0; s < p.l; ++s) {
+    std::memcpy(S.yh[s], S.z[s], sizeof(S.yh[s]));
+    dntt(S.yh[s]);
+  }
+  uint8_t w1_enc[8 * 32 * 6];
+  int w1_bytes = 32 * p.w1_bits;
+  for (int r = 0; r < p.k; ++r) {
+    int32_t acc[N] = {0}, tmp[N];
+    for (int s = 0; s < p.l; ++s) {
+      pw_mul(S.a[r][s], S.yh[s], tmp);
+      for (int n = 0; n < N; ++n) acc[n] = freeze((int64_t)acc[n] + tmp[n]);
+    }
+    // ct1*2^d
+    int32_t t1s[N];
+    for (int n = 0; n < N; ++n) t1s[n] = freeze((int64_t)t1[r][n] << MD);
+    dntt(t1s);
+    pw_mul(S.ch, t1s, tmp);
+    for (int n = 0; n < N; ++n) acc[n] = freeze((int64_t)acc[n] - tmp[n]);
+    dntt_inv(acc);
+    int32_t w1[N];
+    for (int n = 0; n < N; ++n) w1[n] = use_hint(p, S.h[r][n], acc[n]);
+    simple_bit_pack(w1, p.w1_bits, w1_enc + r * w1_bytes);
+  }
+  uint8_t ct2[64];
+  {
+    Sponge sp(136);
+    sp.absorb(mu, 64);
+    sp.absorb(w1_enc, (size_t)(p.k * w1_bytes));
+    sp.finish(0x1f);
+    sp.squeeze(ct2, (size_t)p.ctilde_len);
+  }
+  return std::memcmp(ctilde, ct2, (size_t)p.ctilde_len) == 0;
+}
+
+}  // namespace mldsa
+
 }  // namespace
 
 extern "C" {
@@ -452,6 +1018,25 @@ void qrp_mlkem_decaps(int k, const uint8_t* dk, const uint8_t* ct, uint8_t* key)
     key[i] = (uint8_t)((g_out[i] & mask) | (key_bar[i] & ~mask));
 }
 
-int qrp_version(void) { return 1; }
+// -------- ML-DSA (FIPS 204 internal forms; level = 2/3/5) -------------------
+//
+// m_prime is the already-framed message M' = 0x00 || len(ctx) || ctx || M
+// (same seam as pyref/mldsa_ref.py sign_internal/verify_internal).
+
+void qrp_mldsa_keygen(int level, const uint8_t* xi, uint8_t* pk, uint8_t* sk) {
+  mldsa::keygen(mldsa::params_for(level), xi, pk, sk);
+}
+
+void qrp_mldsa_sign(int level, const uint8_t* sk, const uint8_t* m_prime,
+                    size_t mlen, const uint8_t* rnd, uint8_t* sig) {
+  mldsa::sign_internal(mldsa::params_for(level), sk, m_prime, mlen, rnd, sig);
+}
+
+int qrp_mldsa_verify(int level, const uint8_t* pk, const uint8_t* m_prime,
+                     size_t mlen, const uint8_t* sig) {
+  return mldsa::verify_internal(mldsa::params_for(level), pk, m_prime, mlen, sig) ? 1 : 0;
+}
+
+int qrp_version(void) { return 2; }
 
 }  // extern "C"

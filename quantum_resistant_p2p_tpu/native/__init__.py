@@ -71,10 +71,14 @@ def load() -> ctypes.CDLL | None:
             ("qrp_mlkem_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p]),
             ("qrp_mlkem_encaps", [ctypes.c_int, u8p, u8p, u8p, u8p]),
             ("qrp_mlkem_decaps", [ctypes.c_int, u8p, u8p, u8p]),
+            ("qrp_mldsa_keygen", [ctypes.c_int, u8p, u8p, u8p]),
+            ("qrp_mldsa_sign", [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]),
         ):
             fn = getattr(lib, name)
             fn.argtypes = argtypes
             fn.restype = None
+        lib.qrp_mldsa_verify.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p]
+        lib.qrp_mldsa_verify.restype = ctypes.c_int
         lib.qrp_version.restype = ctypes.c_int
         _lib = lib
         logger.info("loaded native crypto core v%d from %s", lib.qrp_version(), so)
@@ -118,6 +122,52 @@ class NativeMLKEM:
         key = _out(32)
         self.lib.qrp_mlkem_decaps(self.k, _buf(dk), _buf(ct), key)
         return bytes(key)
+
+
+class NativeMLDSA:
+    """Scalar ML-DSA over the native core (same seams as pyref.mldsa_ref:
+    keygen(xi), sign_internal(sk, m_prime, rnd), verify_internal)."""
+
+    _LEVEL = {"ML-DSA-44": 2, "ML-DSA-65": 3, "ML-DSA-87": 5}
+    _SIZES = {2: (1312, 2560, 2420), 3: (1952, 4032, 3309), 5: (2592, 4896, 4627)}
+
+    def __init__(self, name: str):
+        self.lib = load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable")
+        self.level = self._LEVEL[name]
+        self.pk_len, self.sk_len, self.sig_len = self._SIZES[self.level]
+
+    @staticmethod
+    def _expect(data: bytes, n: int, what: str) -> None:
+        # Same seam contract as pyref.mldsa_ref: wrong lengths never reach
+        # the native core (it reads fixed param-set sizes unconditionally).
+        if len(data) != n:
+            raise ValueError(f"{what} must be {n} bytes, got {len(data)}")
+
+    def keygen(self, xi: bytes) -> tuple[bytes, bytes]:
+        self._expect(xi, 32, "xi")
+        pk, sk = _out(self.pk_len), _out(self.sk_len)
+        self.lib.qrp_mldsa_keygen(self.level, _buf(xi), pk, sk)
+        return bytes(pk), bytes(sk)
+
+    def sign_internal(self, sk: bytes, m_prime: bytes, rnd: bytes) -> bytes:
+        self._expect(sk, self.sk_len, "secret key")
+        self._expect(rnd, 32, "rnd")
+        sig = _out(self.sig_len)
+        self.lib.qrp_mldsa_sign(
+            self.level, _buf(sk), _buf(m_prime), len(m_prime), _buf(rnd), sig
+        )
+        return bytes(sig)
+
+    def verify_internal(self, pk: bytes, m_prime: bytes, sig: bytes) -> bool:
+        if len(pk) != self.pk_len or len(sig) != self.sig_len:
+            return False
+        return bool(
+            self.lib.qrp_mldsa_verify(
+                self.level, _buf(pk), _buf(m_prime), len(m_prime), _buf(sig)
+            )
+        )
 
 
 def shake256(data: bytes, out_len: int) -> bytes:

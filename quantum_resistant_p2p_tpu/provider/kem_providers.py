@@ -14,6 +14,7 @@ and KATs can inject seeds through the same seam.
 
 from __future__ import annotations
 
+import logging
 import os
 
 import numpy as np
@@ -63,7 +64,13 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
                 from .. import native as _native
 
                 self._native = _native.NativeMLKEM(self.params.name)
-            except Exception:
+            except Exception as e:
+                logging.getLogger(__name__).warning(
+                    "%s: native fast path unavailable, using pure-Python "
+                    "fallback (orders of magnitude slower): %s",
+                    self.params.name,
+                    e,
+                )
                 self._native = None
 
     # -- scalar API (batch-of-1 on the tpu backend) -------------------------

@@ -12,6 +12,7 @@ cheap); the lattice math runs as fixed-shape batched JAX programs.
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 
 import numpy as np
@@ -58,12 +59,30 @@ class MLDSASignature(SignatureAlgorithm):
             from ..sig import mldsa as _jax_mldsa  # deferred: pulls in jax
 
             self._kg, self._sign_mu, self._verify_mu = _jax_mldsa.get(self.params.name)
+        self._native = None
+        if backend == "cpu":
+            # Native C++ fast path (the role liboqs plays for the reference:
+            # crypto/signatures.py:58-188); pyref stays the fallback + oracle.
+            try:
+                from .. import native as _native
+
+                self._native = _native.NativeMLDSA(self.params.name)
+            except Exception as e:
+                logging.getLogger(__name__).warning(
+                    "%s: native fast path unavailable, using pure-Python "
+                    "fallback (orders of magnitude slower): %s",
+                    self.params.name,
+                    e,
+                )
+                self._native = None
 
     def generate_keypair(self) -> tuple[bytes, bytes]:
         xi = os.urandom(32)
         if self.backend == "tpu":
             pk, sk = self._kg(np.frombuffer(xi, np.uint8)[None])
             return bytes(np.asarray(pk)[0]), bytes(np.asarray(sk)[0])
+        if self._native is not None:
+            return self._native.keygen(xi)
         return mldsa_ref.keygen(self.params, xi)
 
     def sign(self, secret_key: bytes, message: bytes) -> bytes:
@@ -72,6 +91,9 @@ class MLDSASignature(SignatureAlgorithm):
         if self.backend == "tpu":
             sk = np.frombuffer(secret_key, np.uint8)[None]
             return bytes(self.sign_batch(sk, [message], rnd=[rnd])[0])
+        if self._native is not None:
+            m_prime = bytes([0, 0]) + message
+            return self._native.sign_internal(secret_key, m_prime, rnd)
         return mldsa_ref.sign(self.params, secret_key, message, rnd=rnd)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
@@ -82,6 +104,9 @@ class MLDSASignature(SignatureAlgorithm):
                 pk = np.frombuffer(public_key, np.uint8)[None]
                 sig = np.frombuffer(signature, np.uint8)[None]
                 return bool(self.verify_batch(pk, [message], [sig])[0])
+            if self._native is not None:
+                m_prime = bytes([0, 0]) + message
+                return self._native.verify_internal(public_key, m_prime, signature)
             return mldsa_ref.verify(self.params, public_key, message, signature)
         except Exception:
             return False

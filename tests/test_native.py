@@ -39,6 +39,43 @@ def test_mlkem_matches_pyref(name):
     assert nk.decaps(dk, bytes(bad)) == mlkem_ref.decaps(p, dk, bytes(bad))
 
 
+@pytest.mark.parametrize("name", ["ML-DSA-44", "ML-DSA-65", "ML-DSA-87"])
+def test_mldsa_matches_pyref(name):
+    from quantum_resistant_p2p_tpu.pyref import mldsa_ref
+
+    p = mldsa_ref.PARAMS[name]
+    nd = native.NativeMLDSA(name)
+    xi = bytes(RNG.integers(0, 256, size=32, dtype=np.uint8))
+    rnd = bytes(RNG.integers(0, 256, size=32, dtype=np.uint8))
+    pk, sk = nd.keygen(xi)
+    rpk, rsk = mldsa_ref.keygen(p, xi)
+    assert pk == rpk and sk == rsk
+    m_prime = bytes([0, 0]) + b"native vs pyref"
+    sig = nd.sign_internal(sk, m_prime, rnd)
+    assert sig == mldsa_ref.sign_internal(p, sk, m_prime, rnd)
+    assert nd.verify_internal(pk, m_prime, sig)
+    assert mldsa_ref.verify_internal(p, pk, m_prime, sig)
+    bad = bytearray(sig)
+    bad[17] ^= 1
+    assert not nd.verify_internal(pk, m_prime, bytes(bad))
+    assert not nd.verify_internal(pk, bytes([0, 0]) + b"other message", sig)
+
+
+def test_mldsa_provider_native_cpu_interop():
+    """cpu provider (native fast path) and pyref agree through the plugin API."""
+    from quantum_resistant_p2p_tpu.provider.sig_providers import MLDSASignature
+
+    alg = MLDSASignature(security_level=3, backend="cpu")
+    assert alg._native is not None  # toolchain present (module-level skip)
+    pk, sk = alg.generate_keypair()
+    sig = alg.sign(sk, b"interop message")
+    assert alg.verify(pk, b"interop message", sig)
+    assert not alg.verify(pk, b"tampered message", sig)
+    from quantum_resistant_p2p_tpu.pyref import mldsa_ref
+
+    assert mldsa_ref.verify(mldsa_ref.MLDSA65, pk, b"interop message", sig)
+
+
 def test_zeroize():
     buf = bytearray(b"secret material")
     native.zeroize(buf)
