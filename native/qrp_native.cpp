@@ -718,7 +718,7 @@ struct SignScratch {
   uint8_t h[8][N];
 };
 
-void sign_internal(const Params& p, const uint8_t* sk, const uint8_t* m_prime,
+bool sign_internal(const Params& p, const uint8_t* sk, const uint8_t* m_prime,
                    size_t mlen, const uint8_t rnd[32], uint8_t* sig) {
   const uint8_t* rho = sk;
   const uint8_t* cap_k = sk + 32;
@@ -759,7 +759,10 @@ void sign_internal(const Params& p, const uint8_t* sk, const uint8_t* m_prime,
 
   uint8_t w1_enc[8 * 32 * 6];  // k * 32 * w1_bits max
   int w1_bytes = 32 * p.w1_bits;
-  for (uint16_t kappa = 0;; kappa = (uint16_t)(kappa + p.l)) {
+  // kappa is a 16-bit counter in ExpandMask; exhausting it (possible only
+  // with a pathological/adversarial sk) must fail loudly, not wrap — the
+  // pyref seam raises OverflowError at the same point.
+  for (uint32_t kappa = 0; kappa + p.l <= 0x10000; kappa += (uint32_t)p.l) {
     // y = ExpandMask
     for (int r = 0; r < p.l; ++r) {
       uint8_t mseed[66];
@@ -872,8 +875,11 @@ void sign_internal(const Params& p, const uint8_t* sk, const uint8_t* m_prime,
     secure_wipe(S.rm, sizeof(S.rm));
     secure_wipe(S.w, sizeof(S.w));
     secure_wipe(rhopp, sizeof(rhopp));
-    return;
+    return true;
   }
+  secure_wipe(&S, sizeof(S));
+  secure_wipe(rhopp, sizeof(rhopp));
+  return false;
 }
 
 bool verify_internal(const Params& p, const uint8_t* pk, const uint8_t* m_prime,
@@ -938,6 +944,783 @@ bool verify_internal(const Params& p, const uint8_t* pk, const uint8_t* m_prime,
 }
 
 }  // namespace mldsa
+
+// ---------------------------------------------------------------- SHA-2
+
+namespace sha2 {
+
+const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t ror32(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+void compress256(uint32_t h[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; ++i)
+    w[i] = ((uint32_t)block[4 * i] << 24) | ((uint32_t)block[4 * i + 1] << 16) |
+           ((uint32_t)block[4 * i + 2] << 8) | block[4 * i + 3];
+  for (int i = 16; i < 64; ++i) {
+    uint32_t s0 = ror32(w[i - 15], 7) ^ ror32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = ror32(w[i - 2], 17) ^ ror32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 64; ++i) {
+    uint32_t S1 = ror32(e, 6) ^ ror32(e, 11) ^ ror32(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + S1 + ch + K256[i] + w[i];
+    uint32_t S0 = ror32(a, 2) ^ ror32(a, 13) ^ ror32(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1; d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t total;
+  uint8_t buf[64];
+  size_t pos;
+  Sha256() { init(); }
+  void init() {
+    static const uint32_t iv[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, iv, sizeof(h));
+    total = 0;
+    pos = 0;
+  }
+  // resume from a precomputed midstate that has already absorbed `absorbed`
+  // whole blocks
+  void init_from(const uint32_t mid[8], uint64_t absorbed_bytes) {
+    std::memcpy(h, mid, sizeof(h));
+    total = absorbed_bytes;
+    pos = 0;
+  }
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    while (len) {
+      size_t take = 64 - pos;
+      if (take > len) take = len;
+      std::memcpy(buf + pos, data, take);
+      pos += take;
+      data += take;
+      len -= take;
+      if (pos == 64) {
+        compress256(h, buf);
+        pos = 0;
+      }
+    }
+  }
+  void final(uint8_t out[32]) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t z = 0;
+    while (pos != 56) update(&z, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = (uint8_t)(bits >> (56 - 8 * i));
+    total -= 8;  // length field does not count
+    update(lenb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = (uint8_t)(h[i] >> 24);
+      out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+      out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+      out[4 * i + 3] = (uint8_t)h[i];
+    }
+  }
+};
+
+const uint64_t K512[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL};
+
+inline uint64_t ror64(uint64_t x, int n) { return (x >> n) | (x << (64 - n)); }
+
+void compress512(uint64_t h[8], const uint8_t block[128]) {
+  uint64_t w[80];
+  for (int i = 0; i < 16; ++i) {
+    uint64_t v = 0;
+    for (int j = 0; j < 8; ++j) v = (v << 8) | block[8 * i + j];
+    w[i] = v;
+  }
+  for (int i = 16; i < 80; ++i) {
+    uint64_t s0 = ror64(w[i - 15], 1) ^ ror64(w[i - 15], 8) ^ (w[i - 15] >> 7);
+    uint64_t s1 = ror64(w[i - 2], 19) ^ ror64(w[i - 2], 61) ^ (w[i - 2] >> 6);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint64_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 80; ++i) {
+    uint64_t S1 = ror64(e, 14) ^ ror64(e, 18) ^ ror64(e, 41);
+    uint64_t ch = (e & f) ^ (~e & g);
+    uint64_t t1 = hh + S1 + ch + K512[i] + w[i];
+    uint64_t S0 = ror64(a, 28) ^ ror64(a, 34) ^ ror64(a, 39);
+    uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint64_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1; d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+struct Sha512 {
+  uint64_t h[8];
+  uint64_t total;
+  uint8_t buf[128];
+  size_t pos;
+  Sha512() { init(); }
+  void init() {
+    static const uint64_t iv[8] = {
+        0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+        0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+        0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+    std::memcpy(h, iv, sizeof(h));
+    total = 0;
+    pos = 0;
+  }
+  void init_from(const uint64_t mid[8], uint64_t absorbed_bytes) {
+    std::memcpy(h, mid, sizeof(h));
+    total = absorbed_bytes;
+    pos = 0;
+  }
+  void update(const uint8_t* data, size_t len) {
+    total += len;
+    while (len) {
+      size_t take = 128 - pos;
+      if (take > len) take = len;
+      std::memcpy(buf + pos, data, take);
+      pos += take;
+      data += take;
+      len -= take;
+      if (pos == 128) {
+        compress512(h, buf);
+        pos = 0;
+      }
+    }
+  }
+  void final(uint8_t out[64]) {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t z = 0;
+    while (pos != 112) update(&z, 1);
+    uint8_t lenb[16] = {0};  // 128-bit length; high 64 bits zero
+    for (int i = 0; i < 8; ++i) lenb[8 + i] = (uint8_t)(bits >> (56 - 8 * i));
+    total -= 16;
+    update(lenb, 16);
+    for (int i = 0; i < 8; ++i)
+      for (int j = 0; j < 8; ++j) out[8 * i + j] = (uint8_t)(h[i] >> (56 - 8 * j));
+  }
+};
+
+void sha256(const uint8_t* in, size_t len, uint8_t out[32]) {
+  Sha256 s;
+  s.update(in, len);
+  s.final(out);
+}
+
+void sha512(const uint8_t* in, size_t len, uint8_t out[64]) {
+  Sha512 s;
+  s.update(in, len);
+  s.final(out);
+}
+
+// HMAC over either hash (big = SHA-512)
+void hmac(bool big, const uint8_t* key, size_t keylen, const uint8_t* msg1,
+          size_t len1, const uint8_t* msg2, size_t len2, uint8_t* out) {
+  size_t bs = big ? 128 : 64, hs = big ? 64 : 32;
+  uint8_t k0[128] = {0}, ipad[128], opad[128], inner[64];
+  if (keylen > bs) {
+    if (big) sha512(key, keylen, k0);
+    else sha256(key, keylen, k0);
+  } else {
+    std::memcpy(k0, key, keylen);
+  }
+  for (size_t i = 0; i < bs; ++i) {
+    ipad[i] = k0[i] ^ 0x36;
+    opad[i] = k0[i] ^ 0x5c;
+  }
+  if (big) {
+    Sha512 s;
+    s.update(ipad, bs); s.update(msg1, len1); s.update(msg2, len2);
+    s.final(inner);
+    Sha512 o;
+    o.update(opad, bs); o.update(inner, hs);
+    o.final(out);
+  } else {
+    Sha256 s;
+    s.update(ipad, bs); s.update(msg1, len1); s.update(msg2, len2);
+    s.final(inner);
+    Sha256 o;
+    o.update(opad, bs); o.update(inner, hs);
+    o.final(out);
+  }
+}
+
+}  // namespace sha2
+
+// ---------------------------------------------------------------- SLH-DSA
+//
+// FIPS 205 SLH-DSA (SPHINCS+-SHA2 'simple'), all six SHA2 parameter sets,
+// with deterministic seams matching pyref/slhdsa_ref.py: keygen(sk_seed,
+// sk_prf, pk_seed), sign_internal(msg, sk, addrnd), verify_internal.
+// Replaces (reference): liboqs SPHINCS+ reached via crypto/signatures.py:
+// 191-315.  Speed: the first SHA-2 block (pk_seed || zero padding) is fixed
+// per keypair, so F/H/T run from a precomputed midstate — one compression
+// per F instead of two.
+
+namespace slhdsa {
+
+struct Params {
+  const char* name;
+  int n, h, d, hp, a, k, m;
+  int wots_len() const { return 2 * n + 3; }
+  int pk_len() const { return 2 * n; }
+  int sk_len() const { return 4 * n; }
+  int sig_len() const {
+    return n * (1 + k * (1 + a) + d * (wots_len() + hp));
+  }
+  bool big() const { return n > 16; }
+};
+
+// ids: 0=128s 1=128f 2=192s 3=192f 4=256s 5=256f
+const Params PARAMS[6] = {
+    {"SPHINCS+-SHA2-128s-simple", 16, 63, 7, 9, 12, 14, 30},
+    {"SPHINCS+-SHA2-128f-simple", 16, 66, 22, 3, 6, 33, 34},
+    {"SPHINCS+-SHA2-192s-simple", 24, 63, 7, 9, 14, 17, 39},
+    {"SPHINCS+-SHA2-192f-simple", 24, 66, 22, 3, 8, 33, 42},
+    {"SPHINCS+-SHA2-256s-simple", 32, 64, 8, 8, 14, 22, 47},
+    {"SPHINCS+-SHA2-256f-simple", 32, 68, 17, 4, 9, 35, 49},
+};
+
+enum AdrsType { WOTS_HASH, WOTS_PK, TREE, FORS_TREE, FORS_ROOTS, WOTS_PRF, FORS_PRF };
+
+struct ADRS {
+  uint8_t layer = 0;
+  uint64_t tree = 0;
+  uint8_t type = 0;
+  uint32_t w1 = 0, w2 = 0, w3 = 0;
+  void set_type_and_clear(uint8_t t) {
+    type = t;
+    w1 = w2 = w3 = 0;
+  }
+  void compressed(uint8_t out[22]) const {
+    out[0] = layer;
+    for (int i = 0; i < 8; ++i) out[1 + i] = (uint8_t)(tree >> (56 - 8 * i));
+    out[9] = type;
+    for (int i = 0; i < 4; ++i) out[10 + i] = (uint8_t)(w1 >> (24 - 8 * i));
+    for (int i = 0; i < 4; ++i) out[14 + i] = (uint8_t)(w2 >> (24 - 8 * i));
+    for (int i = 0; i < 4; ++i) out[18 + i] = (uint8_t)(w3 >> (24 - 8 * i));
+  }
+};
+
+// Per-keypair hash engine: pk_seed midstates precomputed once.
+struct Ctx {
+  const Params& p;
+  uint32_t mid256[8];   // SHA-256 state after (pk_seed || 0^(64-n))
+  uint64_t mid512[8];   // SHA-512 state after (pk_seed || 0^(128-n)) [big only]
+  const uint8_t* sk_seed;  // may be null for verify
+
+  Ctx(const Params& pp, const uint8_t* pk_seed, const uint8_t* sks)
+      : p(pp), sk_seed(sks) {
+    uint8_t blk[128] = {0};
+    std::memcpy(blk, pk_seed, (size_t)p.n);
+    sha2::Sha256 s;
+    sha2::compress256(s.h, blk);
+    std::memcpy(mid256, s.h, sizeof(mid256));
+    if (p.big()) {
+      sha2::Sha512 s5;
+      sha2::compress512(s5.h, blk);
+      std::memcpy(mid512, s5.h, sizeof(mid512));
+    }
+  }
+
+  // F (always SHA-256): out = SHA256(pk_seed || pad || adrs || m)[:n]
+  void F(const ADRS& adrs, const uint8_t* m, size_t mlen, uint8_t* out) const {
+    uint8_t a22[22], dig[32];
+    adrs.compressed(a22);
+    sha2::Sha256 s;
+    s.init_from(mid256, 64);
+    s.update(a22, 22);
+    s.update(m, mlen);
+    s.final(dig);
+    std::memcpy(out, dig, (size_t)p.n);
+  }
+
+  // H / T_l: SHA-256 (cat 1) or SHA-512 (cats 3/5)
+  void T(const ADRS& adrs, const uint8_t* m, size_t mlen, uint8_t* out) const {
+    if (!p.big()) {
+      F(adrs, m, mlen, out);
+      return;
+    }
+    uint8_t a22[22], dig[64];
+    adrs.compressed(a22);
+    sha2::Sha512 s;
+    s.init_from(mid512, 128);
+    s.update(a22, 22);
+    s.update(m, mlen);
+    s.final(dig);
+    std::memcpy(out, dig, (size_t)p.n);
+  }
+};
+
+// -- WOTS+ -------------------------------------------------------------------
+
+void wots_digits(const Params& p, const uint8_t* m, int* digits) {
+  int len1 = 2 * p.n;
+  int csum = 0;
+  for (int i = 0; i < p.n; ++i) {
+    digits[2 * i] = m[i] >> 4;
+    digits[2 * i + 1] = m[i] & 0xf;
+  }
+  for (int i = 0; i < len1; ++i) csum += 15 - digits[i];
+  csum <<= 4;  // left-align to a whole number of nibbles (len2*lg_w = 12 bits)
+  digits[len1] = (csum >> 12) & 0xf;  // first 3 nibbles of csum as 2 BE bytes
+  digits[len1 + 1] = (csum >> 8) & 0xf;
+  digits[len1 + 2] = (csum >> 4) & 0xf;
+}
+
+void chain(const Ctx& c, uint8_t* x, int i, int s, ADRS& adrs) {
+  for (int j = i; j < i + s; ++j) {
+    adrs.w3 = (uint32_t)j;
+    c.F(adrs, x, (size_t)c.p.n, x);
+  }
+}
+
+void wots_pkgen(const Ctx& c, ADRS adrs, uint8_t* out) {
+  const Params& p = c.p;
+  ADRS sk_adrs = adrs;
+  sk_adrs.set_type_and_clear(WOTS_PRF);
+  sk_adrs.w1 = adrs.w1;
+  uint8_t tmp[67 * 32];
+  for (int i = 0; i < p.wots_len(); ++i) {
+    sk_adrs.w2 = (uint32_t)i;
+    uint8_t* xi = tmp + i * p.n;
+    c.F(sk_adrs, c.sk_seed, (size_t)p.n, xi);
+    adrs.w2 = (uint32_t)i;
+    adrs.w3 = 0;
+    chain(c, xi, 0, 15, adrs);
+  }
+  ADRS pk_adrs = adrs;
+  pk_adrs.set_type_and_clear(WOTS_PK);
+  pk_adrs.w1 = adrs.w1;
+  c.T(pk_adrs, tmp, (size_t)(p.wots_len() * p.n), out);
+}
+
+void wots_sign(const Ctx& c, const uint8_t* m, ADRS adrs, uint8_t* sig) {
+  const Params& p = c.p;
+  int digits[67];
+  wots_digits(p, m, digits);
+  ADRS sk_adrs = adrs;
+  sk_adrs.set_type_and_clear(WOTS_PRF);
+  sk_adrs.w1 = adrs.w1;
+  for (int i = 0; i < p.wots_len(); ++i) {
+    sk_adrs.w2 = (uint32_t)i;
+    uint8_t* si = sig + i * p.n;
+    c.F(sk_adrs, c.sk_seed, (size_t)p.n, si);
+    adrs.w2 = (uint32_t)i;
+    adrs.w3 = 0;
+    chain(c, si, 0, digits[i], adrs);
+  }
+}
+
+void wots_pk_from_sig(const Ctx& c, const uint8_t* sig, const uint8_t* m,
+                      ADRS adrs, uint8_t* out) {
+  const Params& p = c.p;
+  int digits[67];
+  wots_digits(p, m, digits);
+  uint8_t tmp[67 * 32];
+  for (int i = 0; i < p.wots_len(); ++i) {
+    adrs.w2 = (uint32_t)i;
+    uint8_t* xi = tmp + i * p.n;
+    std::memcpy(xi, sig + i * p.n, (size_t)p.n);
+    chain(c, xi, digits[i], 15 - digits[i], adrs);
+  }
+  ADRS pk_adrs = adrs;
+  pk_adrs.set_type_and_clear(WOTS_PK);
+  pk_adrs.w1 = adrs.w1;
+  c.T(pk_adrs, tmp, (size_t)(p.wots_len() * p.n), out);
+}
+
+// -- XMSS ---------------------------------------------------------------------
+
+void xmss_node(const Ctx& c, uint32_t i, int z, ADRS adrs, uint8_t* out) {
+  const Params& p = c.p;
+  if (z == 0) {
+    adrs.set_type_and_clear(WOTS_HASH);
+    adrs.w1 = i;
+    wots_pkgen(c, adrs, out);
+    return;
+  }
+  uint8_t ln[32], rn[32];
+  xmss_node(c, 2 * i, z - 1, adrs, ln);
+  xmss_node(c, 2 * i + 1, z - 1, adrs, rn);
+  adrs.set_type_and_clear(TREE);
+  adrs.w2 = (uint32_t)z;
+  adrs.w3 = i;
+  uint8_t both[64];
+  std::memcpy(both, ln, (size_t)p.n);
+  std::memcpy(both + p.n, rn, (size_t)p.n);
+  c.T(adrs, both, (size_t)(2 * p.n), out);
+}
+
+void xmss_sign(const Ctx& c, const uint8_t* m, uint32_t idx, ADRS adrs, uint8_t* sig) {
+  const Params& p = c.p;
+  uint8_t* auth = sig + p.wots_len() * p.n;
+  for (int j = 0; j < p.hp; ++j) {
+    uint32_t k = (idx >> j) ^ 1u;
+    xmss_node(c, k, j, adrs, auth + j * p.n);
+  }
+  adrs.set_type_and_clear(WOTS_HASH);
+  adrs.w1 = idx;
+  wots_sign(c, m, adrs, sig);
+}
+
+void xmss_pk_from_sig(const Ctx& c, uint32_t idx, const uint8_t* sig_xmss,
+                      const uint8_t* m, ADRS adrs, uint8_t* out) {
+  const Params& p = c.p;
+  const uint8_t* auth = sig_xmss + p.wots_len() * p.n;
+  ADRS wadrs = adrs;
+  wadrs.set_type_and_clear(WOTS_HASH);
+  wadrs.w1 = idx;
+  uint8_t node[32];
+  wots_pk_from_sig(c, sig_xmss, m, wadrs, node);
+  ADRS tadrs = adrs;
+  tadrs.set_type_and_clear(TREE);
+  tadrs.w3 = idx;
+  uint8_t both[64];
+  for (int k = 0; k < p.hp; ++k) {
+    tadrs.w2 = (uint32_t)(k + 1);
+    const uint8_t* sib = auth + k * p.n;
+    if ((idx >> k) & 1) {
+      tadrs.w3 = (tadrs.w3 - 1) >> 1;
+      std::memcpy(both, sib, (size_t)p.n);
+      std::memcpy(both + p.n, node, (size_t)p.n);
+    } else {
+      tadrs.w3 = tadrs.w3 >> 1;
+      std::memcpy(both, node, (size_t)p.n);
+      std::memcpy(both + p.n, sib, (size_t)p.n);
+    }
+    c.T(tadrs, both, (size_t)(2 * p.n), node);
+  }
+  std::memcpy(out, node, (size_t)p.n);
+}
+
+// -- Hypertree -----------------------------------------------------------------
+
+ADRS adrs_for(uint64_t tree, int layer) {
+  ADRS a;
+  a.tree = tree;
+  a.layer = (uint8_t)layer;
+  return a;
+}
+
+void ht_sign(const Ctx& c, const uint8_t* m, uint64_t idx_tree, uint32_t idx_leaf,
+             uint8_t* sig) {
+  const Params& p = c.p;
+  int per = (p.wots_len() + p.hp) * p.n;
+  ADRS adrs = adrs_for(idx_tree, 0);
+  xmss_sign(c, m, idx_leaf, adrs, sig);
+  uint8_t root[32];
+  xmss_pk_from_sig(c, idx_leaf, sig, m, adrs_for(idx_tree, 0), root);
+  for (int j = 1; j < p.d; ++j) {
+    idx_leaf = (uint32_t)(idx_tree & ((1ULL << p.hp) - 1));
+    idx_tree >>= p.hp;
+    uint8_t* sig_j = sig + j * per;
+    xmss_sign(c, root, idx_leaf, adrs_for(idx_tree, j), sig_j);
+    if (j < p.d - 1)
+      xmss_pk_from_sig(c, idx_leaf, sig_j, root, adrs_for(idx_tree, j), root);
+  }
+}
+
+bool ht_verify(const Ctx& c, const uint8_t* m, const uint8_t* sig_ht,
+               uint64_t idx_tree, uint32_t idx_leaf, const uint8_t* pk_root) {
+  const Params& p = c.p;
+  int per = (p.wots_len() + p.hp) * p.n;
+  uint8_t node[32];
+  xmss_pk_from_sig(c, idx_leaf, sig_ht, m, adrs_for(idx_tree, 0), node);
+  for (int j = 1; j < p.d; ++j) {
+    idx_leaf = (uint32_t)(idx_tree & ((1ULL << p.hp) - 1));
+    idx_tree >>= p.hp;
+    xmss_pk_from_sig(c, idx_leaf, sig_ht + j * per, node, adrs_for(idx_tree, j), node);
+  }
+  return std::memcmp(node, pk_root, (size_t)p.n) == 0;
+}
+
+// -- FORS -----------------------------------------------------------------------
+
+void fors_sk(const Ctx& c, const ADRS& adrs, uint32_t idx, uint8_t* out) {
+  ADRS sk_adrs = adrs;
+  sk_adrs.set_type_and_clear(FORS_PRF);
+  sk_adrs.w1 = adrs.w1;
+  sk_adrs.w3 = idx;
+  c.F(sk_adrs, c.sk_seed, (size_t)c.p.n, out);
+}
+
+void fors_node(const Ctx& c, uint32_t i, int z, ADRS adrs, uint8_t* out) {
+  const Params& p = c.p;
+  if (z == 0) {
+    uint8_t sk[32];
+    fors_sk(c, adrs, i, sk);
+    adrs.w2 = 0;
+    adrs.w3 = i;
+    c.F(adrs, sk, (size_t)p.n, out);
+    return;
+  }
+  uint8_t ln[32], rn[32];
+  fors_node(c, 2 * i, z - 1, adrs, ln);
+  fors_node(c, 2 * i + 1, z - 1, adrs, rn);
+  adrs.w2 = (uint32_t)z;
+  adrs.w3 = i;
+  uint8_t both[64];
+  std::memcpy(both, ln, (size_t)p.n);
+  std::memcpy(both + p.n, rn, (size_t)p.n);
+  c.T(adrs, both, (size_t)(2 * p.n), out);
+}
+
+void msg_indices(const Params& p, const uint8_t* md, uint32_t* out) {
+  int bits = 0, pos = 0;
+  uint64_t acc = 0;
+  for (int i = 0; i < p.k; ++i) {
+    while (bits < p.a) {
+      acc = (acc << 8) | md[pos++];
+      bits += 8;
+    }
+    bits -= p.a;
+    out[i] = (uint32_t)((acc >> bits) & ((1ULL << p.a) - 1));
+    acc &= (1ULL << bits) - 1;
+  }
+}
+
+void fors_sign(const Ctx& c, const uint8_t* md, const ADRS& adrs, uint8_t* sig) {
+  const Params& p = c.p;
+  uint32_t indices[35];
+  msg_indices(p, md, indices);
+  uint8_t* out = sig;
+  for (int i = 0; i < p.k; ++i) {
+    fors_sk(c, adrs, ((uint32_t)i << p.a) + indices[i], out);
+    out += p.n;
+    for (int j = 0; j < p.a; ++j) {
+      uint32_t s = (indices[i] >> j) ^ 1u;
+      fors_node(c, ((uint32_t)i << (p.a - j)) + s, j, adrs, out);
+      out += p.n;
+    }
+  }
+}
+
+void fors_pk_from_sig(const Ctx& c, const uint8_t* sig, const uint8_t* md,
+                      ADRS adrs, uint8_t* out) {
+  const Params& p = c.p;
+  uint32_t indices[35];
+  msg_indices(p, md, indices);
+  int per = (1 + p.a) * p.n;
+  uint8_t roots[35 * 32];
+  uint8_t both[64];
+  for (int i = 0; i < p.k; ++i) {
+    const uint8_t* sk = sig + i * per;
+    const uint8_t* auth = sk + p.n;
+    adrs.w2 = 0;
+    uint32_t tree_idx = ((uint32_t)i << p.a) + indices[i];
+    adrs.w3 = tree_idx;
+    uint8_t node[32];
+    c.F(adrs, sk, (size_t)p.n, node);
+    for (int j = 0; j < p.a; ++j) {
+      const uint8_t* sib = auth + j * p.n;
+      adrs.w2 = (uint32_t)(j + 1);
+      if ((tree_idx >> j) & 1) {
+        adrs.w3 = (((uint32_t)i << (p.a - j)) + (indices[i] >> j) - 1) >> 1;
+        std::memcpy(both, sib, (size_t)p.n);
+        std::memcpy(both + p.n, node, (size_t)p.n);
+      } else {
+        adrs.w3 = (((uint32_t)i << (p.a - j)) + (indices[i] >> j)) >> 1;
+        std::memcpy(both, node, (size_t)p.n);
+        std::memcpy(both + p.n, sib, (size_t)p.n);
+      }
+      c.T(adrs, both, (size_t)(2 * p.n), node);
+    }
+    std::memcpy(roots + i * p.n, node, (size_t)p.n);
+  }
+  ADRS pk_adrs = adrs;
+  pk_adrs.set_type_and_clear(FORS_ROOTS);
+  pk_adrs.w1 = adrs.w1;
+  c.T(pk_adrs, roots, (size_t)(p.k * p.n), out);
+}
+
+// -- message hashing / top level ----------------------------------------------
+
+void mgf1(bool big, const uint8_t* seed, size_t seedlen, uint8_t* out, int outlen) {
+  int hlen = big ? 64 : 32;
+  uint8_t dig[64];
+  int pos = 0;
+  for (uint32_t ctr = 0; pos < outlen; ++ctr) {
+    uint8_t cb[4] = {(uint8_t)(ctr >> 24), (uint8_t)(ctr >> 16),
+                     (uint8_t)(ctr >> 8), (uint8_t)ctr};
+    if (big) {
+      sha2::Sha512 s;
+      s.update(seed, seedlen);
+      s.update(cb, 4);
+      s.final(dig);
+    } else {
+      sha2::Sha256 s;
+      s.update(seed, seedlen);
+      s.update(cb, 4);
+      s.final(dig);
+    }
+    int take = outlen - pos < hlen ? outlen - pos : hlen;
+    std::memcpy(out + pos, dig, (size_t)take);
+    pos += take;
+  }
+}
+
+void h_msg(const Params& p, const uint8_t* r, const uint8_t* pk_seed,
+           const uint8_t* pk_root, const uint8_t* msg, size_t msglen,
+           uint8_t* out) {
+  uint8_t inner[64];
+  size_t hs = p.big() ? 64 : 32;
+  if (p.big()) {
+    sha2::Sha512 s;
+    s.update(r, (size_t)p.n); s.update(pk_seed, (size_t)p.n);
+    s.update(pk_root, (size_t)p.n); s.update(msg, msglen);
+    s.final(inner);
+  } else {
+    sha2::Sha256 s;
+    s.update(r, (size_t)p.n); s.update(pk_seed, (size_t)p.n);
+    s.update(pk_root, (size_t)p.n); s.update(msg, msglen);
+    s.final(inner);
+  }
+  uint8_t seed[32 + 32 + 64];
+  std::memcpy(seed, r, (size_t)p.n);
+  std::memcpy(seed + p.n, pk_seed, (size_t)p.n);
+  std::memcpy(seed + 2 * p.n, inner, hs);
+  mgf1(p.big(), seed, (size_t)(2 * p.n) + hs, out, p.m);
+}
+
+void split_digest(const Params& p, const uint8_t* digest, const uint8_t** md,
+                  uint64_t* idx_tree, uint32_t* idx_leaf) {
+  int ka = (p.k * p.a + 7) / 8;
+  int t = (p.h - p.hp + 7) / 8;
+  int u = (p.hp + 7) / 8;
+  *md = digest;
+  uint64_t it = 0;
+  for (int i = 0; i < t; ++i) it = (it << 8) | digest[ka + i];
+  // h - hp can be 64 (256s: h=64, hp=8 -> 56; 128s: 63-9=54; all < 64 except
+  // none); mask safely
+  int bits = p.h - p.hp;
+  *idx_tree = bits >= 64 ? it : (it & ((1ULL << bits) - 1));
+  uint64_t il = 0;
+  for (int i = 0; i < u; ++i) il = (il << 8) | digest[ka + t + i];
+  *idx_leaf = (uint32_t)(il & ((1ULL << p.hp) - 1));
+}
+
+void keygen(const Params& p, const uint8_t* sk_seed, const uint8_t* sk_prf,
+            const uint8_t* pk_seed, uint8_t* pk, uint8_t* sk) {
+  Ctx c(p, pk_seed, sk_seed);
+  ADRS adrs;
+  adrs.layer = (uint8_t)(p.d - 1);
+  uint8_t root[32];
+  xmss_node(c, 0, p.hp, adrs, root);
+  std::memcpy(pk, pk_seed, (size_t)p.n);
+  std::memcpy(pk + p.n, root, (size_t)p.n);
+  std::memcpy(sk, sk_seed, (size_t)p.n);
+  std::memcpy(sk + p.n, sk_prf, (size_t)p.n);
+  std::memcpy(sk + 2 * p.n, pk, (size_t)(2 * p.n));
+}
+
+void sign_internal(const Params& p, const uint8_t* msg, size_t msglen,
+                   const uint8_t* sk, const uint8_t* addrnd, uint8_t* sig) {
+  const uint8_t* sk_seed = sk;
+  const uint8_t* sk_prf = sk + p.n;
+  const uint8_t* pk_seed = sk + 2 * p.n;
+  const uint8_t* pk_root = sk + 3 * p.n;
+  const uint8_t* opt_rand = addrnd ? addrnd : pk_seed;
+  // R = PRF_msg = HMAC(sk_prf, opt_rand || msg)
+  uint8_t rfull[64];
+  sha2::hmac(p.big(), sk_prf, (size_t)p.n, opt_rand, (size_t)p.n, msg, msglen, rfull);
+  uint8_t* r = sig;
+  std::memcpy(r, rfull, (size_t)p.n);
+  uint8_t digest[49];
+  h_msg(p, r, pk_seed, pk_root, msg, msglen, digest);
+  const uint8_t* md;
+  uint64_t idx_tree;
+  uint32_t idx_leaf;
+  split_digest(p, digest, &md, &idx_tree, &idx_leaf);
+  Ctx c(p, pk_seed, sk_seed);
+  ADRS adrs;
+  adrs.tree = idx_tree;
+  adrs.set_type_and_clear(FORS_TREE);
+  adrs.w1 = idx_leaf;
+  uint8_t* sig_fors = sig + p.n;
+  fors_sign(c, md, adrs, sig_fors);
+  uint8_t pk_fors[32];
+  ADRS fadrs;
+  fadrs.tree = idx_tree;
+  fadrs.set_type_and_clear(FORS_TREE);
+  fadrs.w1 = idx_leaf;
+  fors_pk_from_sig(c, sig_fors, md, fadrs, pk_fors);
+  uint8_t* sig_ht = sig_fors + p.k * (1 + p.a) * p.n;
+  ht_sign(c, pk_fors, idx_tree, idx_leaf, sig_ht);
+}
+
+bool verify_internal(const Params& p, const uint8_t* msg, size_t msglen,
+                     const uint8_t* sig, const uint8_t* pk) {
+  const uint8_t* pk_seed = pk;
+  const uint8_t* pk_root = pk + p.n;
+  const uint8_t* r = sig;
+  const uint8_t* sig_fors = sig + p.n;
+  const uint8_t* sig_ht = sig_fors + p.k * (1 + p.a) * p.n;
+  uint8_t digest[49];
+  h_msg(p, r, pk_seed, pk_root, msg, msglen, digest);
+  const uint8_t* md;
+  uint64_t idx_tree;
+  uint32_t idx_leaf;
+  split_digest(p, digest, &md, &idx_tree, &idx_leaf);
+  Ctx c(p, pk_seed, nullptr);
+  uint8_t pk_fors[32];
+  ADRS fadrs;
+  fadrs.tree = idx_tree;
+  fadrs.set_type_and_clear(FORS_TREE);
+  fadrs.w1 = idx_leaf;
+  fors_pk_from_sig(c, sig_fors, md, fadrs, pk_fors);
+  return ht_verify(c, pk_fors, sig_ht, idx_tree, idx_leaf, pk_root);
+}
+
+}  // namespace slhdsa
 
 }  // namespace
 
@@ -1027,9 +1810,11 @@ void qrp_mldsa_keygen(int level, const uint8_t* xi, uint8_t* pk, uint8_t* sk) {
   mldsa::keygen(mldsa::params_for(level), xi, pk, sk);
 }
 
-void qrp_mldsa_sign(int level, const uint8_t* sk, const uint8_t* m_prime,
-                    size_t mlen, const uint8_t* rnd, uint8_t* sig) {
-  mldsa::sign_internal(mldsa::params_for(level), sk, m_prime, mlen, rnd, sig);
+int qrp_mldsa_sign(int level, const uint8_t* sk, const uint8_t* m_prime,
+                   size_t mlen, const uint8_t* rnd, uint8_t* sig) {
+  return mldsa::sign_internal(mldsa::params_for(level), sk, m_prime, mlen, rnd, sig)
+             ? 1
+             : 0;
 }
 
 int qrp_mldsa_verify(int level, const uint8_t* pk, const uint8_t* m_prime,
@@ -1037,6 +1822,43 @@ int qrp_mldsa_verify(int level, const uint8_t* pk, const uint8_t* m_prime,
   return mldsa::verify_internal(mldsa::params_for(level), pk, m_prime, mlen, sig) ? 1 : 0;
 }
 
-int qrp_version(void) { return 2; }
+// -------- SHA-2 -------------------------------------------------------------
+
+void qrp_sha256(const uint8_t* in, size_t inlen, uint8_t* out) {
+  sha2::sha256(in, inlen, out);
+}
+
+void qrp_sha512(const uint8_t* in, size_t inlen, uint8_t* out) {
+  sha2::sha512(in, inlen, out);
+}
+
+void qrp_hmac_sha256(const uint8_t* key, size_t keylen, const uint8_t* msg,
+                     size_t msglen, uint8_t* out) {
+  sha2::hmac(false, key, keylen, msg, msglen, nullptr, 0, out);
+}
+
+// -------- SLH-DSA (FIPS 205 internal forms) ---------------------------------
+//
+// param_id: 0=128s 1=128f 2=192s 3=192f 4=256s 5=256f (SHA2 'simple').
+// addrnd may be NULL (deterministic variant, opt_rand = pk_seed).
+
+void qrp_slhdsa_keygen(int param_id, const uint8_t* sk_seed, const uint8_t* sk_prf,
+                       const uint8_t* pk_seed, uint8_t* pk, uint8_t* sk) {
+  slhdsa::keygen(slhdsa::PARAMS[param_id], sk_seed, sk_prf, pk_seed, pk, sk);
+}
+
+void qrp_slhdsa_sign(int param_id, const uint8_t* sk, const uint8_t* msg,
+                     size_t msglen, const uint8_t* addrnd, uint8_t* sig) {
+  slhdsa::sign_internal(slhdsa::PARAMS[param_id], msg, msglen, sk, addrnd, sig);
+}
+
+int qrp_slhdsa_verify(int param_id, const uint8_t* pk, const uint8_t* msg,
+                      size_t msglen, const uint8_t* sig) {
+  return slhdsa::verify_internal(slhdsa::PARAMS[param_id], msg, msglen, sig, pk)
+             ? 1
+             : 0;
+}
+
+int qrp_version(void) { return 3; }
 
 }  // extern "C"

@@ -72,13 +72,21 @@ def load() -> ctypes.CDLL | None:
             ("qrp_mlkem_encaps", [ctypes.c_int, u8p, u8p, u8p, u8p]),
             ("qrp_mlkem_decaps", [ctypes.c_int, u8p, u8p, u8p]),
             ("qrp_mldsa_keygen", [ctypes.c_int, u8p, u8p, u8p]),
-            ("qrp_mldsa_sign", [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]),
+            ("qrp_sha256", [u8p, ctypes.c_size_t, u8p]),
+            ("qrp_sha512", [u8p, ctypes.c_size_t, u8p]),
+            ("qrp_hmac_sha256", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t, u8p]),
+            ("qrp_slhdsa_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p, u8p]),
+            ("qrp_slhdsa_sign", [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]),
         ):
             fn = getattr(lib, name)
             fn.argtypes = argtypes
             fn.restype = None
+        lib.qrp_mldsa_sign.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]
+        lib.qrp_mldsa_sign.restype = ctypes.c_int
         lib.qrp_mldsa_verify.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p]
         lib.qrp_mldsa_verify.restype = ctypes.c_int
+        lib.qrp_slhdsa_verify.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p]
+        lib.qrp_slhdsa_verify.restype = ctypes.c_int
         lib.qrp_version.restype = ctypes.c_int
         _lib = lib
         logger.info("loaded native crypto core v%d from %s", lib.qrp_version(), so)
@@ -155,9 +163,13 @@ class NativeMLDSA:
         self._expect(sk, self.sk_len, "secret key")
         self._expect(rnd, 32, "rnd")
         sig = _out(self.sig_len)
-        self.lib.qrp_mldsa_sign(
+        ok = self.lib.qrp_mldsa_sign(
             self.level, _buf(sk), _buf(m_prime), len(m_prime), _buf(rnd), sig
         )
+        if not ok:
+            # Only reachable with a pathological/adversarial sk: the 16-bit
+            # ExpandMask counter space was exhausted without an accept.
+            raise RuntimeError("ML-DSA sign: rejection-sampling budget exhausted")
         return bytes(sig)
 
     def verify_internal(self, pk: bytes, m_prime: bytes, sig: bytes) -> bool:
@@ -167,6 +179,63 @@ class NativeMLDSA:
             self.lib.qrp_mldsa_verify(
                 self.level, _buf(pk), _buf(m_prime), len(m_prime), _buf(sig)
             )
+        )
+
+
+class NativeSLHDSA:
+    """Scalar SLH-DSA / SPHINCS+-SHA2 over the native core (same seams as
+    pyref.slhdsa_ref: keygen(sk_seed, sk_prf, pk_seed),
+    sign_internal(msg, sk, addrnd), verify_internal)."""
+
+    _ID = {
+        "SPHINCS+-SHA2-128s-simple": 0,
+        "SPHINCS+-SHA2-128f-simple": 1,
+        "SPHINCS+-SHA2-192s-simple": 2,
+        "SPHINCS+-SHA2-192f-simple": 3,
+        "SPHINCS+-SHA2-256s-simple": 4,
+        "SPHINCS+-SHA2-256f-simple": 5,
+    }
+    # param_id -> (n, sig_len)
+    _SIZES = {
+        0: (16, 7856), 1: (16, 17088), 2: (24, 16224),
+        3: (24, 35664), 4: (32, 29792), 5: (32, 49856),
+    }
+
+    def __init__(self, name: str):
+        self.lib = load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable")
+        self.param_id = self._ID[name]
+        self.n, self.sig_len = self._SIZES[self.param_id]
+        self.pk_len, self.sk_len = 2 * self.n, 4 * self.n
+
+    def keygen(self, sk_seed: bytes, sk_prf: bytes, pk_seed: bytes) -> tuple[bytes, bytes]:
+        for nm, s in (("sk_seed", sk_seed), ("sk_prf", sk_prf), ("pk_seed", pk_seed)):
+            if len(s) != self.n:
+                raise ValueError(f"{nm} must be {self.n} bytes, got {len(s)}")
+        pk, sk = _out(self.pk_len), _out(self.sk_len)
+        self.lib.qrp_slhdsa_keygen(
+            self.param_id, _buf(sk_seed), _buf(sk_prf), _buf(pk_seed), pk, sk
+        )
+        return bytes(pk), bytes(sk)
+
+    def sign_internal(self, msg: bytes, sk: bytes, addrnd: bytes | None = None) -> bytes:
+        if len(sk) != self.sk_len:
+            raise ValueError(f"secret key must be {self.sk_len} bytes, got {len(sk)}")
+        if addrnd is not None and len(addrnd) != self.n:
+            raise ValueError(f"addrnd must be {self.n} bytes, got {len(addrnd)}")
+        sig = _out(self.sig_len)
+        self.lib.qrp_slhdsa_sign(
+            self.param_id, _buf(sk), _buf(msg), len(msg),
+            _buf(addrnd) if addrnd is not None else None, sig,
+        )
+        return bytes(sig)
+
+    def verify_internal(self, msg: bytes, sig: bytes, pk: bytes) -> bool:
+        if len(pk) != self.pk_len or len(sig) != self.sig_len:
+            return False
+        return bool(
+            self.lib.qrp_slhdsa_verify(self.param_id, _buf(pk), _buf(msg), len(msg), _buf(sig))
         )
 
 

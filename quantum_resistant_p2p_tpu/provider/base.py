@@ -16,9 +16,33 @@ batch-of-1 special case, which is the inversion that makes 50k ops/s possible.
 from __future__ import annotations
 
 import abc
+import logging
 from typing import Any
 
 import numpy as np
+
+
+def try_native(class_name: str, algo_name: str):
+    """Instantiate a native-core wrapper (NativeMLKEM/NativeMLDSA/...), or
+    None with a logged warning when the C++ fast path is unavailable —
+    callers fall back to the pure-Python pyref implementations."""
+    try:
+        from .. import native as _native
+
+        return getattr(_native, class_name)(algo_name)
+    except Exception as e:
+        logging.getLogger(__name__).warning(
+            "%s: native fast path unavailable, using pure-Python fallback "
+            "(orders of magnitude slower): %s",
+            algo_name,
+            e,
+        )
+        return None
+
+
+def cpu_impl_desc(native_obj) -> str:
+    """Truthful description of which cpu implementation actually runs."""
+    return "native C++ CPU" if native_obj is not None else "pure-Python CPU"
 
 
 class CryptoAlgorithm(abc.ABC):

@@ -14,13 +14,12 @@ and KATs can inject seeds through the same seam.
 
 from __future__ import annotations
 
-import logging
 import os
 
 import numpy as np
 
 from ..pyref import frodo_ref, hqc_ref, mlkem_ref
-from .base import KeyExchangeAlgorithm, expect_cols, expect_len
+from .base import KeyExchangeAlgorithm, cpu_impl_desc, expect_cols, expect_len, try_native
 
 _LEVEL_TO_MLKEM = {1: mlkem_ref.MLKEM512, 3: mlkem_ref.MLKEM768, 5: mlkem_ref.MLKEM1024}
 
@@ -45,10 +44,6 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         self.backend = backend
         self.name = self.params.name
         self.display_name = f"{self.params.name} ({backend})"
-        self.description = (
-            f"Module-Lattice KEM, FIPS 203, NIST level {security_level}, "
-            f"{'batched JAX/TPU' if backend == 'tpu' else 'pure-Python CPU'} backend"
-        )
         self.public_key_len = self.params.ek_len
         self.secret_key_len = self.params.dk_len
         self.ciphertext_len = self.params.ct_len
@@ -60,18 +55,11 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference);
             # pyref remains the fallback and the oracle.
-            try:
-                from .. import native as _native
-
-                self._native = _native.NativeMLKEM(self.params.name)
-            except Exception as e:
-                logging.getLogger(__name__).warning(
-                    "%s: native fast path unavailable, using pure-Python "
-                    "fallback (orders of magnitude slower): %s",
-                    self.params.name,
-                    e,
-                )
-                self._native = None
+            self._native = try_native("NativeMLKEM", self.params.name)
+        self.description = (
+            f"Module-Lattice KEM, FIPS 203, NIST level {security_level}, "
+            f"{'batched JAX/TPU' if backend == 'tpu' else cpu_impl_desc(self._native)} backend"
+        )
 
     # -- scalar API (batch-of-1 on the tpu backend) -------------------------
 

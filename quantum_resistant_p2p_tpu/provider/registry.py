@@ -7,13 +7,14 @@ backend is an orthogonal axis ("cpu" | "tpu" | "auto").
 
 Registered families (full parity with the reference's Crypto Settings matrix
 of 9 KEMs x 2 AEADs x 6 signatures, ui/settings_dialog.py:108-172 — plus the
-AES/SHAKE FrodoKEM split exposed as distinct names):
+AES/SHAKE FrodoKEM split exposed as distinct names and the SLH-DSA
+small-signature 's' variants, BASELINE.json config 4):
 
-  KEM:  ML-KEM-512/768/1024                 (cpu + tpu)
-        FrodoKEM-640/976/1344-{AES,SHAKE}   (cpu + tpu)
-        HQC-128/192/256                     (cpu + tpu)
-  SIG:  ML-DSA-44/65/87                     (cpu + tpu)
-        SPHINCS+-SHA2-128f/192f/256f-simple (cpu + tpu)
+  KEM:  ML-KEM-512/768/1024                     (cpu + tpu)
+        FrodoKEM-640/976/1344-{AES,SHAKE}       (cpu + tpu)
+        HQC-128/192/256                         (cpu + tpu)
+  SIG:  ML-DSA-44/65/87                         (cpu + tpu)
+        SPHINCS+-SHA2-{128,192,256}{s,f}-simple (cpu + tpu)
   AEAD: AES-256-GCM, ChaCha20-Poly1305 (host)
 """
 
@@ -114,16 +115,15 @@ def _register_defaults() -> None:
             lambda backend, _level=level: MLDSASignature(_level, backend),
             ("cpu", "tpu"),
         )
-    for level, name in (
-        (1, "SPHINCS+-SHA2-128f-simple"),
-        (3, "SPHINCS+-SHA2-192f-simple"),
-        (5, "SPHINCS+-SHA2-256f-simple"),
-    ):
-        register_signature(
-            name,
-            lambda backend, _level=level: SPHINCSSignature(_level, backend),
-            ("cpu", "tpu"),
-        )
+    for level, size in ((1, 128), (3, 192), (5, 256)):
+        for fast in (True, False):
+            register_signature(
+                f"SPHINCS+-SHA2-{size}{'f' if fast else 's'}-simple",
+                lambda backend, _level=level, _fast=fast: SPHINCSSignature(
+                    _level, backend, fast=_fast
+                ),
+                ("cpu", "tpu"),
+            )
 
 
 _register_defaults()

@@ -12,22 +12,25 @@ cheap); the lattice math runs as fixed-shape batched JAX programs.
 from __future__ import annotations
 
 import hashlib
-import logging
 import os
 
 import numpy as np
 
 from ..pyref import mldsa_ref
-from .base import SignatureAlgorithm, expect_cols, expect_len
+from .base import SignatureAlgorithm, cpu_impl_desc, expect_cols, expect_len, try_native
 
 _LEVEL_TO_MLDSA = {2: mldsa_ref.MLDSA44, 3: mldsa_ref.MLDSA65, 5: mldsa_ref.MLDSA87}
 
 from ..pyref import slhdsa_ref  # noqa: E402
 
+# (level, fast) -> params; 'f' = fast-sign/large-sig, 's' = small-sig/slow-sign
 _LEVEL_TO_SLH = {
-    1: slhdsa_ref.SLH128F,
-    3: slhdsa_ref.SLH192F,
-    5: slhdsa_ref.SLH256F,
+    (1, True): slhdsa_ref.SLH128F,
+    (1, False): slhdsa_ref.SLH128S,
+    (3, True): slhdsa_ref.SLH192F,
+    (3, False): slhdsa_ref.SLH192S,
+    (5, True): slhdsa_ref.SLH256F,
+    (5, False): slhdsa_ref.SLH256S,
 }
 
 
@@ -48,10 +51,6 @@ class MLDSASignature(SignatureAlgorithm):
         self.backend = backend
         self.name = self.params.name
         self.display_name = f"{self.params.name} ({backend})"
-        self.description = (
-            f"Module-Lattice signature, FIPS 204, NIST level {security_level}, "
-            f"{'batched JAX/TPU' if backend == 'tpu' else 'pure-Python CPU'} backend"
-        )
         self.public_key_len = self.params.pk_len
         self.secret_key_len = self.params.sk_len
         self.signature_len = self.params.sig_len
@@ -63,18 +62,11 @@ class MLDSASignature(SignatureAlgorithm):
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference:
             # crypto/signatures.py:58-188); pyref stays the fallback + oracle.
-            try:
-                from .. import native as _native
-
-                self._native = _native.NativeMLDSA(self.params.name)
-            except Exception as e:
-                logging.getLogger(__name__).warning(
-                    "%s: native fast path unavailable, using pure-Python "
-                    "fallback (orders of magnitude slower): %s",
-                    self.params.name,
-                    e,
-                )
-                self._native = None
+            self._native = try_native("NativeMLDSA", self.params.name)
+        self.description = (
+            f"Module-Lattice signature, FIPS 204, NIST level {security_level}, "
+            f"{'batched JAX/TPU' if backend == 'tpu' else cpu_impl_desc(self._native)} backend"
+        )
 
     def generate_keypair(self) -> tuple[bytes, bytes]:
         xi = os.urandom(32)
@@ -156,18 +148,16 @@ class SPHINCSSignature(SignatureAlgorithm):
     hypertree hashing — the actual work — runs as batched JAX programs.
     """
 
-    def __init__(self, security_level: int = 1, backend: str = "cpu"):
-        if security_level not in _LEVEL_TO_SLH:
+    def __init__(self, security_level: int = 1, backend: str = "cpu", fast: bool = True):
+        key = (security_level, fast)
+        if key not in _LEVEL_TO_SLH:
             raise ValueError(f"SPHINCS+ level must be 1/3/5, got {security_level}")
-        self.params = _LEVEL_TO_SLH[security_level]
+        self.params = _LEVEL_TO_SLH[key]
         self.security_level = security_level
         self.backend = backend
+        self.fast = fast
         self.name = self.params.name
         self.display_name = f"{self.params.name} ({backend})"
-        self.description = (
-            f"Stateless hash-based signature, FIPS 205, NIST level {security_level}, "
-            f"{'batched JAX/TPU' if backend == 'tpu' else 'pure-Python CPU'} backend"
-        )
         self.public_key_len = self.params.pk_len
         self.secret_key_len = self.params.sk_len
         self.signature_len = self.params.sig_len
@@ -175,6 +165,16 @@ class SPHINCSSignature(SignatureAlgorithm):
             from ..sig import sphincs as _jax_slh  # deferred: pulls in jax
 
             self._kg, self._sign_digest, self._verify_digest = _jax_slh.get(self.params.name)
+        self._native = None
+        if backend == "cpu":
+            # Native C++ fast path (the role liboqs plays for the reference:
+            # crypto/signatures.py:191-315); pyref stays the fallback + oracle.
+            self._native = try_native("NativeSLHDSA", self.params.name)
+        self.description = (
+            f"Stateless hash-based signature, FIPS 205, NIST level {security_level}, "
+            f"{'fast-sign' if fast else 'small-signature'} variant, "
+            f"{'batched JAX/TPU' if backend == 'tpu' else cpu_impl_desc(self._native)} backend"
+        )
 
     def generate_keypair(self) -> tuple[bytes, bytes]:
         p = self.params
@@ -187,6 +187,8 @@ class SPHINCSSignature(SignatureAlgorithm):
                 np.frombuffer(pk_seed, np.uint8)[None],
             )
             return bytes(np.asarray(pk)[0]), bytes(np.asarray(sk)[0])
+        if self._native is not None:
+            return self._native.keygen(sk_seed, sk_prf, pk_seed)
         return slhdsa_ref.keygen(p, sk_seed, sk_prf, pk_seed)
 
     def sign(self, secret_key: bytes, message: bytes) -> bytes:
@@ -194,6 +196,8 @@ class SPHINCSSignature(SignatureAlgorithm):
         if self.backend == "tpu":
             sk = np.frombuffer(secret_key, np.uint8)[None]
             return bytes(self.sign_batch(sk, [message])[0])
+        if self._native is not None:
+            return self._native.sign_internal(message, secret_key)
         return slhdsa_ref.sign(self.params, secret_key, message)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
@@ -204,6 +208,8 @@ class SPHINCSSignature(SignatureAlgorithm):
                 pk = np.frombuffer(public_key, np.uint8)[None]
                 sig = np.frombuffer(signature, np.uint8)[None]
                 return bool(self.verify_batch(pk, [message], [sig])[0])
+            if self._native is not None:
+                return self._native.verify_internal(message, signature, public_key)
             return slhdsa_ref.verify(self.params, public_key, message, signature)
         except Exception:
             return False

@@ -68,13 +68,16 @@ class SLHDSAParams:
 
 SLH128S = SLHDSAParams("SPHINCS+-SHA2-128s-simple", n=16, h=63, d=7, hp=9, a=12, k=14, m=30)
 SLH128F = SLHDSAParams("SPHINCS+-SHA2-128f-simple", n=16, h=66, d=22, hp=3, a=6, k=33, m=34)
+SLH192S = SLHDSAParams("SPHINCS+-SHA2-192s-simple", n=24, h=63, d=7, hp=9, a=14, k=17, m=39)
 SLH192F = SLHDSAParams("SPHINCS+-SHA2-192f-simple", n=24, h=66, d=22, hp=3, a=8, k=33, m=42)
+SLH256S = SLHDSAParams("SPHINCS+-SHA2-256s-simple", n=32, h=64, d=8, hp=8, a=14, k=22, m=47)
 SLH256F = SLHDSAParams("SPHINCS+-SHA2-256f-simple", n=32, h=68, d=17, hp=4, a=9, k=35, m=49)
 
-PARAMS = {p.name: p for p in (SLH128S, SLH128F, SLH192F, SLH256F)}
+PARAMS = {p.name: p for p in (SLH128S, SLH128F, SLH192S, SLH192F, SLH256S, SLH256F)}
 
 assert SLH128F.sig_len == 17088 and SLH128S.sig_len == 7856
-assert SLH192F.sig_len == 35664 and SLH256F.sig_len == 49856
+assert SLH192F.sig_len == 35664 and SLH192S.sig_len == 16224
+assert SLH256F.sig_len == 49856 and SLH256S.sig_len == 29792
 
 
 # -- ADRS (FIPS 205 §4.2-4.3; compressed 22-byte form for SHA2, §11.2) -------

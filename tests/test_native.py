@@ -76,6 +76,80 @@ def test_mldsa_provider_native_cpu_interop():
     assert mldsa_ref.verify(mldsa_ref.MLDSA65, pk, b"interop message", sig)
 
 
+def test_sha2_matches_hashlib():
+    import hmac as hmac_mod
+
+    lib = native.load()
+    for ln in (0, 1, 55, 56, 63, 64, 65, 111, 112, 127, 128, 300):
+        data = bytes(RNG.integers(0, 256, size=ln, dtype=np.uint8))
+        out32 = (__import__("ctypes").c_uint8 * 32)()
+        out64 = (__import__("ctypes").c_uint8 * 64)()
+        lib.qrp_sha256(native._buf(data), ln, out32)
+        lib.qrp_sha512(native._buf(data), ln, out64)
+        assert bytes(out32) == hashlib.sha256(data).digest()
+        assert bytes(out64) == hashlib.sha512(data).digest()
+    key = bytes(RNG.integers(0, 256, size=32, dtype=np.uint8))
+    msg = bytes(RNG.integers(0, 256, size=99, dtype=np.uint8))
+    out32 = (__import__("ctypes").c_uint8 * 32)()
+    lib.qrp_hmac_sha256(native._buf(key), 32, native._buf(msg), 99, out32)
+    assert bytes(out32) == hmac_mod.new(key, msg, hashlib.sha256).digest()
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "SPHINCS+-SHA2-128s-simple",
+        "SPHINCS+-SHA2-128f-simple",
+        pytest.param("SPHINCS+-SHA2-192s-simple", marks=pytest.mark.slow),
+        pytest.param("SPHINCS+-SHA2-192f-simple", marks=pytest.mark.slow),
+        pytest.param("SPHINCS+-SHA2-256s-simple", marks=pytest.mark.slow),
+        pytest.param("SPHINCS+-SHA2-256f-simple", marks=pytest.mark.slow),
+    ],
+)
+def test_slhdsa_matches_pyref(name):
+    from quantum_resistant_p2p_tpu.pyref import slhdsa_ref
+
+    p = slhdsa_ref.PARAMS[name]
+    ns = native.NativeSLHDSA(name)
+    ss, sp, ps = (bytes(RNG.integers(0, 256, size=p.n, dtype=np.uint8)) for _ in range(3))
+    pk, sk = ns.keygen(ss, sp, ps)
+    rpk, rsk = slhdsa_ref.keygen(p, ss, sp, ps)
+    assert pk == rpk and sk == rsk
+    msg = b"native vs pyref slhdsa"
+    sig = ns.sign_internal(msg, sk)
+    assert sig == slhdsa_ref.sign_internal(p, msg, sk, None)
+    assert ns.verify_internal(msg, sig, pk)
+    bad = bytearray(sig)
+    bad[40] ^= 1
+    assert not ns.verify_internal(msg, bytes(bad), pk)
+    assert not ns.verify_internal(b"other", sig, pk)
+    # hedged variant agrees too
+    ar = bytes(RNG.integers(0, 256, size=p.n, dtype=np.uint8))
+    assert ns.sign_internal(msg, sk, ar) == slhdsa_ref.sign_internal(p, msg, sk, ar)
+
+
+def test_slhdsa_provider_native_cpu_interop():
+    from quantum_resistant_p2p_tpu.provider.sig_providers import SPHINCSSignature
+
+    alg = SPHINCSSignature(security_level=1, backend="cpu", fast=True)
+    assert alg._native is not None
+    pk, sk = alg.generate_keypair()
+    sig = alg.sign(sk, b"interop")
+    assert alg.verify(pk, b"interop", sig)
+    assert not alg.verify(pk, b"tampered", sig)
+    from quantum_resistant_p2p_tpu.pyref import slhdsa_ref
+
+    assert slhdsa_ref.verify(slhdsa_ref.SLH128F, pk, b"interop", sig)
+    # small-signature variant through the registry
+    from quantum_resistant_p2p_tpu.provider import get_signature
+
+    s128 = get_signature("SPHINCS+-SHA2-128s-simple", backend="cpu")
+    assert s128.signature_len == 7856
+    pk, sk = s128.generate_keypair()
+    sig = s128.sign(sk, b"small sig")
+    assert s128.verify(pk, b"small sig", sig)
+
+
 def test_zeroize():
     buf = bytearray(b"secret material")
     native.zeroize(buf)

@@ -12,6 +12,9 @@ FAST_SETS = [
     "SPHINCS+-SHA2-128f-simple",
     pytest.param("SPHINCS+-SHA2-192f-simple", marks=pytest.mark.slow),
     pytest.param("SPHINCS+-SHA2-256f-simple", marks=pytest.mark.slow),
+    pytest.param("SPHINCS+-SHA2-128s-simple", marks=pytest.mark.slow),
+    pytest.param("SPHINCS+-SHA2-192s-simple", marks=pytest.mark.slow),
+    pytest.param("SPHINCS+-SHA2-256s-simple", marks=pytest.mark.slow),
 ]
 
 
