@@ -706,6 +706,8 @@ void keygen(const Params& p, const uint8_t xi[32], uint8_t* pk, uint8_t* sk) {
   secure_wipe(s1h, sizeof(s1h));
   secure_wipe(t0, sizeof(t0));
   secure_wipe(seed, sizeof(seed));
+  secure_wipe(seed_in, sizeof(seed_in));  // copy of the master secret xi
+  secure_wipe(sseed, sizeof(sseed));      // rho' sampling seed
 }
 
 // scratch shared by sign/verify (single-threaded per-thread use)
@@ -775,6 +777,8 @@ bool sign_internal(const Params& p, const uint8_t* sk, const uint8_t* m_prime,
       bit_unpack(buf, p.gamma1, p.z_bits, S.y[r]);
       std::memcpy(S.yh[r], S.y[r], sizeof(S.yh[r]));
       dntt(S.yh[r]);
+      secure_wipe(mseed, sizeof(mseed));  // rho'' copy
+      secure_wipe(buf, sizeof(buf));      // packed secret mask
     }
     // w = invNTT(A yh); w1 = HighBits(w)
     for (int r = 0; r < p.k; ++r) {
@@ -1191,6 +1195,11 @@ void hmac(bool big, const uint8_t* key, size_t keylen, const uint8_t* msg1,
     o.update(opad, bs); o.update(inner, hs);
     o.final(out);
   }
+  // key material (k0 and its xor-masks are invertible to the key)
+  volatile uint8_t* w;
+  w = k0;   for (size_t i = 0; i < sizeof(k0); ++i) w[i] = 0;
+  w = ipad; for (size_t i = 0; i < sizeof(ipad); ++i) w[i] = 0;
+  w = opad; for (size_t i = 0; i < sizeof(opad); ++i) w[i] = 0;
 }
 
 }  // namespace sha2
@@ -1501,6 +1510,9 @@ void fors_node(const Ctx& c, uint32_t i, int z, ADRS adrs, uint8_t* out) {
     adrs.w2 = 0;
     adrs.w3 = i;
     c.F(adrs, sk, (size_t)p.n, out);
+    // unrevealed FORS leaf secrets must not linger (revealed ones are in
+    // the signature by design)
+    for (volatile uint8_t* w = sk; w < sk + sizeof(sk); ++w) *w = 0;
     return;
   }
   uint8_t ln[32], rn[32];

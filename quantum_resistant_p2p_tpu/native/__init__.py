@@ -60,37 +60,57 @@ def load() -> ctypes.CDLL | None:
         so = _build()
         if so is None:
             return None
-        lib = ctypes.CDLL(str(so))
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        for name, argtypes in (
-            ("qrp_shake128", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]),
-            ("qrp_shake256", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]),
-            ("qrp_sha3_256", [u8p, ctypes.c_size_t, u8p]),
-            ("qrp_sha3_512", [u8p, ctypes.c_size_t, u8p]),
-            ("qrp_zeroize", [u8p, ctypes.c_size_t]),
-            ("qrp_mlkem_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p]),
-            ("qrp_mlkem_encaps", [ctypes.c_int, u8p, u8p, u8p, u8p]),
-            ("qrp_mlkem_decaps", [ctypes.c_int, u8p, u8p, u8p]),
-            ("qrp_mldsa_keygen", [ctypes.c_int, u8p, u8p, u8p]),
-            ("qrp_sha256", [u8p, ctypes.c_size_t, u8p]),
-            ("qrp_sha512", [u8p, ctypes.c_size_t, u8p]),
-            ("qrp_hmac_sha256", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t, u8p]),
-            ("qrp_slhdsa_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p, u8p]),
-            ("qrp_slhdsa_sign", [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]),
-        ):
-            fn = getattr(lib, name)
-            fn.argtypes = argtypes
-            fn.restype = None
-        lib.qrp_mldsa_sign.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]
-        lib.qrp_mldsa_sign.restype = ctypes.c_int
-        lib.qrp_mldsa_verify.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p]
-        lib.qrp_mldsa_verify.restype = ctypes.c_int
-        lib.qrp_slhdsa_verify.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p]
-        lib.qrp_slhdsa_verify.restype = ctypes.c_int
-        lib.qrp_version.restype = ctypes.c_int
-        _lib = lib
-        logger.info("loaded native crypto core v%d from %s", lib.qrp_version(), so)
+        try:
+            _lib = _bind(ctypes.CDLL(str(so)))
+        except AttributeError:
+            # Stale cached .so predating newer symbols (e.g. synced with
+            # preserved mtimes): force one rebuild, then give up to the
+            # pure-Python fallback rather than raising out of load().
+            logger.warning("cached native library is stale; rebuilding")
+            try:
+                so.unlink()
+                so = _build()
+                _lib = _bind(ctypes.CDLL(str(so))) if so else None
+            except (OSError, AttributeError) as e:
+                logger.warning("native rebuild failed (pure-Python fallback): %s", e)
+                _lib = None
+        if _lib is not None:
+            logger.info(
+                "loaded native crypto core v%d from %s", _lib.qrp_version(), so
+            )
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Set argtypes/restypes; raises AttributeError if a symbol is missing."""
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    for name, argtypes in (
+        ("qrp_shake128", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]),
+        ("qrp_shake256", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]),
+        ("qrp_sha3_256", [u8p, ctypes.c_size_t, u8p]),
+        ("qrp_sha3_512", [u8p, ctypes.c_size_t, u8p]),
+        ("qrp_zeroize", [u8p, ctypes.c_size_t]),
+        ("qrp_mlkem_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p]),
+        ("qrp_mlkem_encaps", [ctypes.c_int, u8p, u8p, u8p, u8p]),
+        ("qrp_mlkem_decaps", [ctypes.c_int, u8p, u8p, u8p]),
+        ("qrp_mldsa_keygen", [ctypes.c_int, u8p, u8p, u8p]),
+        ("qrp_sha256", [u8p, ctypes.c_size_t, u8p]),
+        ("qrp_sha512", [u8p, ctypes.c_size_t, u8p]),
+        ("qrp_hmac_sha256", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t, u8p]),
+        ("qrp_slhdsa_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p, u8p]),
+        ("qrp_slhdsa_sign", [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]),
+    ):
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = None
+    lib.qrp_mldsa_sign.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]
+    lib.qrp_mldsa_sign.restype = ctypes.c_int
+    lib.qrp_mldsa_verify.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p]
+    lib.qrp_mldsa_verify.restype = ctypes.c_int
+    lib.qrp_slhdsa_verify.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p]
+    lib.qrp_slhdsa_verify.restype = ctypes.c_int
+    lib.qrp_version.restype = ctypes.c_int
+    return lib
 
 
 def _buf(data: bytes):
@@ -137,14 +157,16 @@ class NativeMLDSA:
     keygen(xi), sign_internal(sk, m_prime, rnd), verify_internal)."""
 
     _LEVEL = {"ML-DSA-44": 2, "ML-DSA-65": 3, "ML-DSA-87": 5}
-    _SIZES = {2: (1312, 2560, 2420), 3: (1952, 4032, 3309), 5: (2592, 4896, 4627)}
 
     def __init__(self, name: str):
+        from ..pyref import mldsa_ref  # single authority for sizes
+
         self.lib = load()
         if self.lib is None:
             raise RuntimeError("native core unavailable")
         self.level = self._LEVEL[name]
-        self.pk_len, self.sk_len, self.sig_len = self._SIZES[self.level]
+        p = mldsa_ref.PARAMS[name]
+        self.pk_len, self.sk_len, self.sig_len = p.pk_len, p.sk_len, p.sig_len
 
     @staticmethod
     def _expect(data: bytes, n: int, what: str) -> None:
@@ -195,19 +217,17 @@ class NativeSLHDSA:
         "SPHINCS+-SHA2-256s-simple": 4,
         "SPHINCS+-SHA2-256f-simple": 5,
     }
-    # param_id -> (n, sig_len)
-    _SIZES = {
-        0: (16, 7856), 1: (16, 17088), 2: (24, 16224),
-        3: (24, 35664), 4: (32, 29792), 5: (32, 49856),
-    }
 
     def __init__(self, name: str):
+        from ..pyref import slhdsa_ref  # single authority for sizes
+
         self.lib = load()
         if self.lib is None:
             raise RuntimeError("native core unavailable")
         self.param_id = self._ID[name]
-        self.n, self.sig_len = self._SIZES[self.param_id]
-        self.pk_len, self.sk_len = 2 * self.n, 4 * self.n
+        p = slhdsa_ref.PARAMS[name]
+        self.n, self.sig_len = p.n, p.sig_len
+        self.pk_len, self.sk_len = p.pk_len, p.sk_len
 
     def keygen(self, sk_seed: bytes, sk_prf: bytes, pk_seed: bytes) -> tuple[bytes, bytes]:
         for nm, s in (("sk_seed", sk_seed), ("sk_prf", sk_prf), ("pk_seed", pk_seed)):

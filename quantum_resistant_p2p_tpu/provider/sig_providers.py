@@ -34,10 +34,14 @@ _LEVEL_TO_SLH = {
 }
 
 
+def _m_prime(message: bytes, ctx: bytes = b"") -> bytes:
+    """FIPS 204/205 pure-mode framing: M' = 0x00 || len(ctx) || ctx || M."""
+    return bytes([0, len(ctx)]) + ctx + message
+
+
 def _mu(tr: bytes, message: bytes, ctx: bytes = b"") -> bytes:
-    """mu = SHAKE256(tr || M', 64) with M' = 0x00 || len(ctx) || ctx || M."""
-    m_prime = bytes([0, len(ctx)]) + ctx + message
-    return hashlib.shake_256(tr + m_prime).digest(64)
+    """mu = SHAKE256(tr || M', 64)."""
+    return hashlib.shake_256(tr + _m_prime(message, ctx)).digest(64)
 
 
 class MLDSASignature(SignatureAlgorithm):
@@ -84,8 +88,7 @@ class MLDSASignature(SignatureAlgorithm):
             sk = np.frombuffer(secret_key, np.uint8)[None]
             return bytes(self.sign_batch(sk, [message], rnd=[rnd])[0])
         if self._native is not None:
-            m_prime = bytes([0, 0]) + message
-            return self._native.sign_internal(secret_key, m_prime, rnd)
+            return self._native.sign_internal(secret_key, _m_prime(message), rnd)
         return mldsa_ref.sign(self.params, secret_key, message, rnd=rnd)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
@@ -97,8 +100,9 @@ class MLDSASignature(SignatureAlgorithm):
                 sig = np.frombuffer(signature, np.uint8)[None]
                 return bool(self.verify_batch(pk, [message], [sig])[0])
             if self._native is not None:
-                m_prime = bytes([0, 0]) + message
-                return self._native.verify_internal(public_key, m_prime, signature)
+                return self._native.verify_internal(
+                    public_key, _m_prime(message), signature
+                )
             return mldsa_ref.verify(self.params, public_key, message, signature)
         except Exception:
             return False
