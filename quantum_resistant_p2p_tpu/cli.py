@@ -46,7 +46,8 @@ commands:
   /logs [type] [n]           decrypted audit log (latest n, default 20)
   /clearlogs                 delete all audit logs
   /keyhistory [peer]         list stored shared-key history entries
-  /showkey <entry>           decrypt + display a stored key (audited)
+  /showkey <entry> [fmt]     decrypt + display a stored key (audited,
+                             confirmation required; fmt: hex|base64|decimal)
   /delkey <entry>            delete one key-history entry
   /clearhistory              delete ALL key-history entries
   /passwd                    change the vault password
@@ -80,6 +81,7 @@ class CLI:
         self.secure_logger: SecureLogger | None = None
         self.store = MessageStore()
         self._stop = asyncio.Event()
+        self._reader: asyncio.StreamReader | None = None
 
     # ---------------------------------------------------------------- output
 
@@ -252,12 +254,42 @@ class CLI:
             if not entries:
                 self.print("  (none)")
         elif cmd == "/showkey":
-            v = self.storage.get_key_history_value(args[0])
-            self.secure_logger.log_event("key_history_access", entry=args[0])
+            # Parity with the reference's key-history dialog: security
+            # warning before decrypt, hex/base64/decimal display, every
+            # access audited (ui/key_history_dialog.py:336-501).
+            entry = args[0]
+            fmt = args[1] if len(args) > 1 else "hex"
+            if fmt not in ("hex", "base64", "decimal"):
+                self.print("usage: /showkey <entry> [hex|base64|decimal]")
+                return True
+            self.print(
+                "WARNING: displaying a decrypted key exposes secret material\n"
+                "on screen and in terminal scrollback. Anyone who records it\n"
+                "can decrypt past traffic protected by this key."
+            )
+            confirm = await self._prompt("type YES to decrypt and display: ")
+            if confirm != "YES":
+                self.secure_logger.log_event(
+                    "key_history_access", entry=entry, granted=False
+                )
+                self.print("cancelled")
+                return True
+            v = self.storage.get_key_history_value(entry)
+            self.secure_logger.log_event(
+                "key_history_access", entry=entry, granted=True, found=v is not None
+            )
             if v is None:
                 self.print("not found")
             else:
-                self.print(f"  hex: {bytes.fromhex(v['key']).hex() if isinstance(v.get('key'), str) else v}")
+                import base64
+
+                raw = base64.b64decode(v["key"])  # save_peer_shared_key stores b64
+                if fmt == "hex":
+                    self.print(f"  hex: {raw.hex()}")
+                elif fmt == "base64":
+                    self.print(f"  base64: {base64.b64encode(raw).decode()}")
+                else:
+                    self.print(f"  decimal: {' '.join(str(b) for b in raw)}")
         elif cmd == "/delkey":
             ok = self.storage.delete_key_history(args[0])
             self.secure_logger.log_event("key_history_changed", deleted=args[0], ok=ok)
@@ -277,7 +309,7 @@ class CLI:
             else:
                 self.print("wrong password")
         elif cmd == "/reset":
-            confirm = input("type RESET to destroy the vault and start fresh: ")
+            confirm = await self._prompt("type RESET to destroy the vault and start fresh: ")
             if confirm == "RESET":
                 new = getpass.getpass("new password: ")
                 self.storage.reset_storage(new)
@@ -293,6 +325,20 @@ class CLI:
         else:
             self.print(f"unknown command {cmd}; /help for a list")
         return True
+
+    async def _prompt(self, text: str) -> str:
+        """Read one confirmation line.
+
+        Inside the running REPL, stdin belongs to the asyncio reader
+        (connect_read_pipe sets the fd non-blocking — a raw input() would
+        raise BlockingIOError), so read through it; programmatic callers
+        without a REPL get plain input().
+        """
+        if self._reader is not None:
+            self.print(text)
+            line = await self._reader.readline()
+            return line.decode().strip()
+        return input(text).strip()
 
     def _peer(self, prefix: str) -> str:
         """Resolve a peer-id prefix to a full id."""
@@ -314,6 +360,7 @@ class CLI:
         await loop.connect_read_pipe(
             lambda: asyncio.StreamReaderProtocol(reader), sys.stdin
         )
+        self._reader = reader
         self.print("type /help for commands")
         while not self._stop.is_set():
             line = await reader.readline()

@@ -64,6 +64,44 @@ def test_two_clis_chat(run, tmp_path):
     run(main())
 
 
+def test_showkey_formats_warning_and_audit(run, tmp_path, monkeypatch):
+    async def main():
+        a, a_out = _mk(tmp_path, "a2")
+        b, _ = _mk(tmp_path, "b2")
+        await a.start()
+        await b.start()
+        await a.handle(f"/connect 127.0.0.1 {b.node.port}")
+        await asyncio.sleep(0.05)
+        peer_b = a.node.get_peers()[0]
+        await a.handle(f"/key {peer_b[:8]}")
+        entries = a.storage.list_key_history()
+        assert entries
+        name = entries[0]["name"]
+
+        # declined confirmation: no key material shown, denial audited
+        monkeypatch.setattr("builtins.input", lambda *_: "no")
+        await a.handle(f"/showkey {name}")
+        assert "cancelled" in a_out.getvalue()
+        assert "hex:" not in a_out.getvalue()
+
+        monkeypatch.setattr("builtins.input", lambda *_: "YES")
+        await a.handle(f"/showkey {name}")
+        assert "WARNING" in a_out.getvalue() and "hex:" in a_out.getvalue()
+        await a.handle(f"/showkey {name} base64")
+        assert "base64:" in a_out.getvalue()
+        await a.handle(f"/showkey {name} decimal")
+        assert "decimal:" in a_out.getvalue()
+        # every access (granted and denied) is in the audit log
+        accesses = [e for e in a.secure_logger.get_events(event_type="key_history_access")]
+        assert len(accesses) == 4
+        assert any(e.get("granted") is False for e in accesses)
+
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
 def test_unknown_command_and_bad_args_keep_repl_alive(run, tmp_path):
     async def main():
         a, out = _mk(tmp_path, "solo")

@@ -288,10 +288,15 @@ def main(argv=None) -> int:
         pass
     path.write_text(json.dumps(out, indent=2))
 
+    dispatch = {1: bench_config1, 2: bench_config2, 3: bench_config3,
+                4: bench_config4,
+                5: lambda o, p: bench_config5(o, p, args.peers)}
+    unknown = [c for c in args.configs if c not in dispatch]
+    if unknown:
+        ap.error(f"unknown configs {unknown}; valid: 1-5")
     for cfg in args.configs:
         t0 = time.time()
-        {1: bench_config1, 2: bench_config2, 3: bench_config3,
-         4: bench_config4}.get(cfg, lambda o, p: bench_config5(o, p, args.peers))(out, path)
+        dispatch[cfg](out, path)
         print(f"config {cfg} done in {time.time() - t0:.1f}s", flush=True)
     print(json.dumps(out))
     return 0
