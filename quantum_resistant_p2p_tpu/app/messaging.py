@@ -114,11 +114,13 @@ class SecureMessaging:
         self.use_batching = use_batching
         self._batch_cfg = (max_batch, max_wait_ms)
         self._bkem = self._bsig = None
+        self._warmup_thread = None
         if use_batching:
             from ..provider.batched import BatchedKEM, BatchedSignature
 
             self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms)
             self._bsig = BatchedSignature(self.signature, max_batch, max_wait_ms)
+            self._spawn_warmup()
 
         # per-peer protocol state
         self.shared_keys: dict[str, bytes] = {}
@@ -333,6 +335,43 @@ class SecureMessaging:
             logger.warning("key exchange with %s failed: %s", peer_id[:8], e)
             self._cleanup_exchange(message_id, peer_id)
             return False
+
+    def _spawn_warmup(self, kem: bool = True, sig: bool = True) -> None:
+        """Precompile batched providers' size-1 buckets in the background so
+        a live handshake's cold jit never races KEY_EXCHANGE_TIMEOUT
+        (SURVEY.md §7.4 item 6; the round-1 flake).  Called at construction
+        AND after an algorithm hot-swap (only for the swapped provider — the
+        other is already warm).  cpu-backend algorithms have no jit cache to
+        warm, so they are skipped (their warmup would run real slow crypto)."""
+        import threading
+
+        bkem = self._bkem if kem and getattr(self.kem, "backend", "") == "tpu" else None
+        bsig = (
+            self._bsig if sig and getattr(self.signature, "backend", "") == "tpu" else None
+        )
+        if bkem is None and bsig is None:
+            return
+
+        def _warm():
+            try:
+                if bkem is not None:
+                    bkem.warmup()
+                if bsig is not None:
+                    bsig.warmup()
+            except Exception:
+                logger.exception("batched-provider warmup failed")
+
+        self._warmup_thread = threading.Thread(
+            target=_warm, name="qrp2p-warmup", daemon=True
+        )
+        self._warmup_thread.start()
+
+    async def wait_ready(self, timeout: float | None = None) -> None:
+        """Await background batched-provider warmup (no-op when batching off)."""
+        if self._warmup_thread is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._warmup_thread.join, timeout
+            )
 
     def _cleanup_exchange(self, message_id: str, peer_id: str) -> None:
         self._ephemeral.pop(message_id, None)
@@ -661,6 +700,7 @@ class SecureMessaging:
             from ..provider.batched import BatchedKEM
 
             self._bkem = BatchedKEM(self.kem, *self._batch_cfg)
+            self._spawn_warmup(kem=True, sig=False)
         peers = list(self.shared_keys)
         self.shared_keys.clear()
         self.raw_secrets.clear()
@@ -668,6 +708,8 @@ class SecureMessaging:
             self.ke_state[peer_id] = KeyExchangeState.NONE
         self._log("crypto_settings_changed", component="kem", algorithm=name)
         await self.notify_peers_of_settings_change()
+        # re-handshakes must not race the fresh provider's cold jit
+        await self.wait_ready()
         for peer_id in peers:
             if self.node.is_connected(peer_id):
                 asyncio.ensure_future(self.initiate_key_exchange(peer_id))
@@ -689,6 +731,7 @@ class SecureMessaging:
             from ..provider.batched import BatchedSignature
 
             self._bsig = BatchedSignature(self.signature, *self._batch_cfg)
+            self._spawn_warmup(kem=False, sig=True)
         self._sig_keypair = self._load_or_generate_sig_keypair()
         self._log("crypto_settings_changed", component="signature", algorithm=name)
         await self.notify_peers_of_settings_change()

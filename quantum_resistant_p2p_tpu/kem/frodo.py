@@ -35,6 +35,13 @@ from ..pyref.frodo_ref import NBAR, PARAMS, FrodoParams
 
 N_CHUNKS = 16  # A-matrix row chunks (n is divisible by 16 in all sets)
 
+#: Largest single-dispatch batch on real TPU hardware.  Batches >= 1024
+#: reproducibly crash this environment's remote TPU worker ("kernel fault";
+#: batch 256 is solid, N_CHUNKS does not change it) — callers slice larger
+#: batches into MAX_DEVICE_BATCH dispatches (provider does this
+#: automatically).
+MAX_DEVICE_BATCH = 256
+
 
 def _shake(p: FrodoParams, data: jax.Array, out_len: int) -> jax.Array:
     fn = keccak.shake128 if p.n == 640 else keccak.shake256

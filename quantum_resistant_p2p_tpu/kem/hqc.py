@@ -88,19 +88,40 @@ def _bits_to_bytes(bits: jax.Array) -> jax.Array:
 # -- sampling -----------------------------------------------------------------
 
 
-def _prng_u32s(seed: jax.Array, count: int, domain: int) -> jax.Array:
-    dom = jnp.broadcast_to(jnp.uint8(domain), seed.shape[:-1] + (1,))
-    buf = keccak.shake256(jnp.concatenate([seed, dom], axis=-1), 4 * count)
-    b = buf.astype(jnp.uint32).reshape(buf.shape[:-1] + (count, 4))
+def _seedexpand(seed: jax.Array, out_len: int) -> jax.Array:
+    """HQC seedexpander stream: SHAKE256(seed || 0x02) squeezed to out_len.
+    Callers slice consecutive reads off one stream (pyref SeedExpander)."""
+    dom = jnp.broadcast_to(jnp.uint8(2), seed.shape[:-1] + (1,))
+    return keccak.shake256(jnp.concatenate([seed, dom], axis=-1), out_len)
+
+
+def _u32s(buf: jax.Array) -> jax.Array:
+    """(..., 4k) uint8 -> (..., k) uint32 little-endian."""
+    b = buf.astype(jnp.uint32).reshape(buf.shape[:-1] + (-1, 4))
     return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
 
 
-def _sample_fixed_weight_support(p: HQCParams, seed: jax.Array, weight: int,
-                                 domain: int) -> jax.Array:
-    """-> (batch, weight) int32 distinct positions (oracle-identical dedup)."""
-    rand = _prng_u32s(seed, weight, domain)  # uint32
-    mod = jnp.asarray(np.arange(weight), jnp.uint32)
-    sup = (jnp.arange(weight, dtype=jnp.uint32) + rand % (p.n - mod)).astype(jnp.int32)
+def _mulhi32(a: jax.Array, m: int) -> jax.Array:
+    """floor(a * m / 2**32) for uint32 a and python int m < 2**16, exactly,
+    without 64-bit lanes: split a into 16-bit halves."""
+    assert 0 < m < (1 << 16), f"16-bit split requires m < 2^16, got {m}"
+    a1 = a >> 16
+    a0 = a & jnp.uint32(0xFFFF)
+    # a*m = a1*m*2^16 + a0*m ; both partial products fit uint32 (m < 2^16)
+    return (a1 * jnp.uint32(m) + ((a0 * jnp.uint32(m)) >> 16)) >> 16
+
+
+def _fixed_weight_support(p: HQCParams, rand: jax.Array, weight: int) -> jax.Array:
+    """(batch, weight) uint32 randoms -> (batch, weight) int32 positions.
+
+    HQC vect_set_random_fixed_weight: i + (rand32 * (n-i)) >> 32, duplicates
+    replaced by their index in a reverse scan (oracle-identical dedup).
+    """
+    cols = [
+        (jnp.uint32(i) + _mulhi32(rand[..., i], p.n - i)).astype(jnp.int32)
+        for i in range(weight)
+    ]
+    sup = jnp.stack(cols, axis=-1)
 
     idx = jnp.arange(weight)
 
@@ -122,10 +143,9 @@ def _support_to_bits(p: HQCParams, sup: jax.Array) -> jax.Array:
     return jnp.put_along_axis(v, sup, jnp.uint8(1), axis=-1, inplace=False)
 
 
-def _sample_random_bits(p: HQCParams, seed: jax.Array, domain: int) -> jax.Array:
-    dom = jnp.broadcast_to(jnp.uint8(domain), seed.shape[:-1] + (1,))
-    buf = keccak.shake256(jnp.concatenate([seed, dom], axis=-1), p.n_bytes)
-    return _bytes_to_bits(buf, p.n)
+def _sample_random_bits(p: HQCParams, seed: jax.Array) -> jax.Array:
+    """h: first n_bytes of the seed's expander stream."""
+    return _bytes_to_bits(_seedexpand(seed, p.n_bytes), p.n)
 
 
 # -- cyclic arithmetic --------------------------------------------------------
@@ -292,8 +312,9 @@ def _rm_decode(p: HQCParams, bits: jax.Array) -> jax.Array:
 
 
 def _hash_dom(data: jax.Array, domain: int, out_len: int = 64) -> jax.Array:
-    pfx = jnp.broadcast_to(jnp.uint8(domain), data.shape[:-1] + (1,))
-    return keccak.shake256(jnp.concatenate([pfx, data], axis=-1), out_len)
+    """SHAKE256-512 with TRAILING domain byte (HQC hash.c shake256_512_ds)."""
+    sfx = jnp.broadcast_to(jnp.uint8(domain), data.shape[:-1] + (1,))
+    return keccak.shake256(jnp.concatenate([data, sfx], axis=-1), out_len)
 
 
 # -- KEM ----------------------------------------------------------------------
@@ -304,9 +325,11 @@ def keygen(p: HQCParams, sk_seed: jax.Array, sigma: jax.Array, pk_seed: jax.Arra
     sk_seed = jnp.asarray(sk_seed, jnp.uint8)
     sigma = jnp.asarray(sigma, jnp.uint8)
     pk_seed = jnp.asarray(pk_seed, jnp.uint8)
-    h = _sample_random_bits(p, pk_seed, 0)
-    x_sup = _sample_fixed_weight_support(p, sk_seed, p.w, 1)
-    y_sup = _sample_fixed_weight_support(p, sk_seed, p.w, 2)
+    h = _sample_random_bits(p, pk_seed)
+    # one sk expander stream: y first, then x (pyref keygen order)
+    sk_stream = _u32s(_seedexpand(sk_seed, 8 * p.w))
+    y_sup = _fixed_weight_support(p, sk_stream[..., : p.w], p.w)
+    x_sup = _fixed_weight_support(p, sk_stream[..., p.w :], p.w)
     x = _support_to_bits(p, x_sup)
     s = x ^ _cyclic_mul_sparse(p, h, y_sup)
     pk = jnp.concatenate([pk_seed, _bits_to_bytes(s)], axis=-1)
@@ -317,10 +340,12 @@ def keygen(p: HQCParams, sk_seed: jax.Array, sigma: jax.Array, pk_seed: jax.Arra
 def _encrypt(p: HQCParams, pk: jax.Array, m: jax.Array, theta: jax.Array):
     pk_seed = pk[..., :40]
     s = _bytes_to_bits(pk[..., 40:], p.n)
-    h = _sample_random_bits(p, pk_seed, 0)
-    r1_sup = _sample_fixed_weight_support(p, theta, p.wr, 3)
-    r2_sup = _sample_fixed_weight_support(p, theta, p.wr, 4)
-    e_sup = _sample_fixed_weight_support(p, theta, p.wr, 5)
+    h = _sample_random_bits(p, pk_seed)
+    # one theta expander stream: r2, e, r1 (pyref _encrypt order)
+    stream = _u32s(_seedexpand(theta, 12 * p.wr))
+    r2_sup = _fixed_weight_support(p, stream[..., : p.wr], p.wr)
+    e_sup = _fixed_weight_support(p, stream[..., p.wr : 2 * p.wr], p.wr)
+    r1_sup = _fixed_weight_support(p, stream[..., 2 * p.wr :], p.wr)
     u = _support_to_bits(p, r1_sup) ^ _cyclic_mul_sparse(p, h, r2_sup)
     code = _rm_encode(p, _rs_encode(p, m.astype(jnp.int32)))
     t = _cyclic_mul_sparse(p, s, r2_sup) ^ _support_to_bits(p, e_sup)
@@ -353,7 +378,9 @@ def decaps(p: HQCParams, sk: jax.Array, ct: jax.Array):
     salt = ct[..., p.n_bytes + p.n1n2_bytes :]
     u = _bytes_to_bits(u_b, p.n)
     v = _bytes_to_bits(v_b, p.n1 * p.n2)
-    y_sup = _sample_fixed_weight_support(p, sk_seed, p.w, 2)
+    # y = first fixed-weight draw off the sk expander stream
+    sk_stream = _u32s(_seedexpand(sk_seed, 4 * p.w))
+    y_sup = _fixed_weight_support(p, sk_stream, p.w)
     uy = _cyclic_mul_sparse(p, u, y_sup)
     m_p = _rs_decode(p, _rm_decode(p, v ^ uy[..., : p.n1 * p.n2])).astype(jnp.uint8)
     theta_p = _hash_dom(jnp.concatenate([m_p, pk[..., :32], salt], axis=-1), 3)

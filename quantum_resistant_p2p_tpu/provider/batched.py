@@ -212,6 +212,15 @@ class BatchedKEM:
             lambda: ValueError("bad secret-key/ciphertext length"),
         )
 
+    def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:
+        """Compile the pow2 buckets a live queue will hit (blocking; run in a
+        background thread).  Cold jit of the first handshake's size-1 bucket
+        otherwise races the protocol timeout (SURVEY.md §7.4 item 6)."""
+        for n in sizes:
+            pks, sks = self.algo.generate_keypair_batch(n)
+            cts, _ = self.algo.encapsulate_batch(pks)
+            self.algo.decapsulate_batch(sks, cts)
+
     async def generate_keypair(self) -> tuple[bytes, bytes]:
         return await self._kg.submit(None)
 
@@ -274,6 +283,15 @@ class BatchedSignature:
             dispatch,
             lambda: False,
         )
+
+    def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:
+        """Compile keygen/sign/verify for the pow2 buckets (blocking)."""
+        pk, sk = self.algo.generate_keypair()
+        for n in sizes:
+            sks = np.stack([np.frombuffer(sk, np.uint8)] * n)
+            pks = np.stack([np.frombuffer(pk, np.uint8)] * n)
+            sigs = self.algo.sign_batch(sks, [b"warmup"] * n)
+            self.algo.verify_batch(pks, [b"warmup"] * n, sigs)
 
     async def sign(self, secret_key: bytes, message: bytes) -> bytes:
         return await self._sign.submit((secret_key, message))

@@ -168,6 +168,22 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
             from ..kem import frodo as _jax_frodo  # deferred: pulls in jax
 
             self._kg, self._enc, self._dec = _jax_frodo.get(self.params.name)
+            self._max_dispatch = _jax_frodo.MAX_DEVICE_BATCH
+
+    def _sliced(self, fn, *arrays):
+        """Dispatch in MAX_DEVICE_BATCH slices — larger single Frodo batches
+        crash this environment's TPU worker (kem/frodo.py MAX_DEVICE_BATCH)."""
+        n = arrays[0].shape[0]
+        step = self._max_dispatch
+        if n <= step:
+            out = fn(*arrays)
+            return tuple(np.asarray(o) for o in out) if isinstance(out, tuple) else np.asarray(out)
+        parts = [fn(*(a[i : i + step] for a in arrays)) for i in range(0, n, step)]
+        if isinstance(parts[0], tuple):
+            return tuple(
+                np.concatenate([np.asarray(p[j]) for p in parts]) for j in range(len(parts[0]))
+            )
+        return np.concatenate([np.asarray(p) for p in parts])
 
     def generate_keypair(self) -> tuple[bytes, bytes]:
         pk, sk = self.generate_keypair_batch(1)
@@ -190,8 +206,7 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         sec = p.len_sec
         seeds = np.frombuffer(os.urandom(3 * sec * n), np.uint8).reshape(3, n, sec)
         if self.backend == "tpu":
-            pk, sk = self._kg(seeds[0], seeds[1], seeds[2])
-            return np.asarray(pk), np.asarray(sk)
+            return self._sliced(self._kg, seeds[0], seeds[1], seeds[2])
         pairs = [
             frodo_ref.keygen(p, seeds[0, i].tobytes(), seeds[1, i].tobytes(),
                              seeds[2, i].tobytes())
@@ -208,8 +223,7 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         n = public_keys.shape[0]
         mu = np.frombuffer(os.urandom(p.len_sec * n), np.uint8).reshape(n, p.len_sec)
         if self.backend == "tpu":
-            ct, ss = self._enc(public_keys, mu)
-            return np.asarray(ct), np.asarray(ss)
+            return self._sliced(self._enc, np.asarray(public_keys), mu)
         outs = [
             frodo_ref.encaps(p, public_keys[i].tobytes(), mu[i].tobytes())
             for i in range(n)
@@ -224,7 +238,7 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         expect_cols(ciphertexts, self.ciphertext_len, "ciphertexts", self.name)
         p = self.params
         if self.backend == "tpu":
-            return np.asarray(self._dec(secret_keys, ciphertexts))
+            return self._sliced(self._dec, np.asarray(secret_keys), np.asarray(ciphertexts))
         return np.stack(
             [
                 np.frombuffer(

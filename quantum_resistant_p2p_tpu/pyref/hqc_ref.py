@@ -5,16 +5,19 @@ an outer Reed-Solomon code over GF(2^8) and an inner duplicated
 Reed-Muller RM(1,7) code — with quasi-cyclic products in
 GF(2)[x]/(x^n - 1) (big-int carryless arithmetic here).
 
-IMPORTANT COMPATIBILITY NOTE: the vendored liboqs binary is stripped from the
+COMPATIBILITY NOTE: the vendored liboqs binary is stripped from the
 reference checkout (.MISSING_LARGE_BLOBS), so no native HQC oracle exists in
-this environment.  This implementation is *structurally* faithful to the
-round-4 HQC design (parameter sets, code construction, fixed-weight sampling,
-salted FO transform with implicit rejection) but its exact byte-level PRNG
-call sequence is this framework's own documented seam — it is NOT claimed
-KAT-compatible with liboqs.  Both backends (this oracle and the batched JAX
-implementation in ``kem.hqc``) are bit-exact against each other, which is the
-property the application protocol needs (reference behavior:
-crypto/key_exchange.py:189-309 HQCKeyExchange).
+this environment.  Randomness follows the official round-4 structure — a
+SHAKE256 seedexpander (``seed || 0x02`` domain byte, one continuing stream
+per context), ``vect_set_random_fixed_weight`` with multiplicative range
+reduction ``i + (rand32 * (n-i)) >> 32`` and index-replacement dedup, G/K as
+SHAKE256-512 with trailing domain bytes, keygen drawing y then x from one sk
+stream, encrypt drawing r2, e, r1 from one theta stream — but the exact
+byte-level call order cannot be verified offline; official KAT .rsp files
+dropped into tests/vectors/ are the decisive check (docs/correctness.md).
+Both backends (this oracle and the batched JAX implementation in ``kem.hqc``)
+are bit-exact against each other, which is the property the application
+protocol needs (reference behavior: crypto/key_exchange.py:189-309).
 
 Determinism seam: keygen takes (sk_seed, sigma, pk_seed); encaps takes
 (m, salt).
@@ -294,30 +297,46 @@ def code_decode(p: HQCParams, v: int) -> bytes:
 # -- fixed-weight sampling + cyclic arithmetic -------------------------------
 
 
-def _prng_u32s(seed: bytes, count: int, domain: int) -> list[int]:
-    buf = hashlib.shake_256(seed + bytes([domain])).digest(4 * count)
-    return [int.from_bytes(buf[4 * i : 4 * i + 4], "little") for i in range(count)]
+SEEDEXPANDER_DOMAIN = 0x02  # HQC shake_prng.c seedexpander domain byte
 
 
-def sample_fixed_weight(p: HQCParams, seed: bytes, weight: int, domain: int) -> int:
-    """Fisher-Yates-style fixed-weight vector (Sendrier SampleFixedWeight)."""
-    rand = _prng_u32s(seed, weight, domain)
+class SeedExpander:
+    """HQC seedexpander: SHAKE256 XOF over ``seed || 0x02``, squeezed
+    incrementally — each read continues the same output stream, exactly the
+    reference implementation's seedexpander state (shake_prng.c)."""
+
+    def __init__(self, seed: bytes):
+        self._xof = hashlib.shake_256(seed + bytes([SEEDEXPANDER_DOMAIN]))
+        self._pos = 0
+
+    def read(self, n: int) -> bytes:
+        # hashlib has no incremental squeeze; an XOF's output is a
+        # prefix-consistent stream, so re-digest and slice.
+        out = self._xof.digest(self._pos + n)[self._pos :]
+        self._pos += n
+        return out
+
+
+def sample_fixed_weight(p: HQCParams, ctx: SeedExpander, weight: int) -> int:
+    """HQC vect_set_random_fixed_weight: one 4*weight-byte draw,
+    multiplicative range reduction ``i + (rand32 * (n-i)) >> 32``, duplicate
+    slots replaced by their index (reverse scan)."""
+    buf = ctx.read(4 * weight)
     support = [0] * weight
     for i in range(weight):
-        support[i] = i + rand[i] % (p.n - i)
-    for i in range(weight - 1, -1, -1):
-        for j in range(i + 1, weight):
-            if support[j] == support[i]:
-                support[i] = i
+        r = int.from_bytes(buf[4 * i : 4 * i + 4], "little")
+        support[i] = i + ((r * (p.n - i)) >> 32)
+    for i in range(weight - 2, -1, -1):
+        if support[i] in support[i + 1 :]:
+            support[i] = i
     v = 0
     for pos in support:
         v |= 1 << pos
     return v
 
 
-def sample_random_vector(p: HQCParams, seed: bytes, domain: int) -> int:
-    buf = hashlib.shake_256(seed + bytes([domain])).digest(p.n_bytes)
-    v = int.from_bytes(buf, "little")
+def sample_random_vector(p: HQCParams, ctx: SeedExpander) -> int:
+    v = int.from_bytes(ctx.read(p.n_bytes), "little")
     return v & ((1 << p.n) - 1)
 
 
@@ -335,21 +354,28 @@ def cyclic_mul(p: HQCParams, a: int, b_support_int: int) -> int:
 
 
 def _hash_g(data: bytes) -> bytes:
-    return hashlib.shake_256(b"\x03" + data).digest(64)
+    """G: SHAKE256-512 with trailing domain byte (HQC hash.c shake256_512_ds)."""
+    return hashlib.shake_256(data + b"\x03").digest(64)
 
 
 def _hash_k(data: bytes) -> bytes:
-    return hashlib.shake_256(b"\x04" + data).digest(64)
+    """K: SHAKE256-512 with trailing domain byte."""
+    return hashlib.shake_256(data + b"\x04").digest(64)
 
 
 # -- KEM ---------------------------------------------------------------------
 
 
 def keygen(p: HQCParams, sk_seed: bytes, sigma: bytes, pk_seed: bytes):
-    """sk_seed (40), sigma (k), pk_seed (40) -> (pk, sk)."""
-    h = sample_random_vector(p, pk_seed, 0)
-    x = sample_fixed_weight(p, sk_seed, p.w, 1)
-    y = sample_fixed_weight(p, sk_seed, p.w, 2)
+    """sk_seed (40), sigma (k), pk_seed (40) -> (pk, sk).
+
+    Both secrets come from ONE sk seedexpander stream, y before x
+    (reference hqc.c keygen order); h from the pk seedexpander.
+    """
+    sk_ctx = SeedExpander(sk_seed)
+    y = sample_fixed_weight(p, sk_ctx, p.w)
+    x = sample_fixed_weight(p, sk_ctx, p.w)
+    h = sample_random_vector(p, SeedExpander(pk_seed))
     s = x ^ cyclic_mul(p, h, y)
     pk = pk_seed + s.to_bytes(p.n_bytes, "little")
     sk = sk_seed + sigma + pk
@@ -357,12 +383,14 @@ def keygen(p: HQCParams, sk_seed: bytes, sigma: bytes, pk_seed: bytes):
 
 
 def _encrypt(p: HQCParams, pk: bytes, m: bytes, theta: bytes):
+    """One theta seedexpander stream: r2, e, r1 (in that order)."""
     pk_seed = pk[:40]
     s = int.from_bytes(pk[40:], "little")
-    h = sample_random_vector(p, pk_seed, 0)
-    r1 = sample_fixed_weight(p, theta, p.wr, 3)
-    r2 = sample_fixed_weight(p, theta, p.wr, 4)
-    e = sample_fixed_weight(p, theta, p.wr, 5)
+    h = sample_random_vector(p, SeedExpander(pk_seed))
+    ctx = SeedExpander(theta)
+    r2 = sample_fixed_weight(p, ctx, p.wr)
+    e = sample_fixed_weight(p, ctx, p.wr)
+    r1 = sample_fixed_weight(p, ctx, p.wr)
     u = r1 ^ cyclic_mul(p, h, r2)
     t = code_encode(p, m) ^ cyclic_mul(p, s, r2) ^ e
     v = t & ((1 << (p.n1 * p.n2)) - 1)  # truncate to the code length
@@ -388,7 +416,7 @@ def decaps(p: HQCParams, sk: bytes, ct: bytes) -> bytes:
     salt = ct[p.n_bytes + p.n1n2_bytes :]
     u = int.from_bytes(u_b, "little")
     v = int.from_bytes(v_b, "little")
-    y = sample_fixed_weight(p, sk_seed, p.w, 2)
+    y = sample_fixed_weight(p, SeedExpander(sk_seed), p.w)  # first draw = y
     uy = cyclic_mul(p, u, y)
     m_p = code_decode(p, v ^ (uy & ((1 << (p.n1 * p.n2)) - 1)))
     theta_p = _hash_g(m_p + pk[:32] + salt)

@@ -186,6 +186,10 @@ def test_handshake_with_batched_tpu_provider(run, tmp_path):
         kw = dict(backend="tpu", use_batching=True, max_batch=64, max_wait_ms=2.0)
         a, b = await _connected_pair(tmp_path, **kw)
         assert a.messaging._bkem is not None
+        # background warmup precompiles the size-1 buckets; waiting here keeps
+        # cold-jit time out of the protocol timeout (the round-1 flake)
+        await a.messaging.wait_ready()
+        await b.messaging.wait_ready()
         ok = await a.messaging.initiate_key_exchange("bob")
         assert ok
         assert a.messaging.shared_keys["bob"] == b.messaging.shared_keys["alice"]

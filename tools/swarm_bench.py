@@ -63,6 +63,11 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
         max_batch=max_batch, max_wait_ms=max_wait_ms,
     )
 
+    # size-1 buckets precompile in the background at construction; wait so
+    # warmup clients start against a warm provider
+    await hub.wait_ready()
+    await proto.wait_ready()
+
     clients: list[SecureMessaging] = []
     latencies: list[float] = []
     sem = asyncio.Semaphore(concurrency)
