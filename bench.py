@@ -28,7 +28,9 @@ BASELINE_OPS_PER_S = 50_000.0
 
 def main() -> None:
     from quantum_resistant_p2p_tpu.kem import mlkem
-    from quantum_resistant_p2p_tpu.utils.benchmarking import sync, timeit
+    from quantum_resistant_p2p_tpu.utils.benchmarking import enable_compile_cache, sync, timeit
+
+    enable_compile_cache()
 
     rng = np.random.default_rng(0)
     d = rng.integers(0, 256, size=(BATCH, 32), dtype=np.uint8)

@@ -707,9 +707,10 @@ class SecureMessaging:
         for peer_id in peers:
             self.ke_state[peer_id] = KeyExchangeState.NONE
         self._log("crypto_settings_changed", component="kem", algorithm=name)
-        await self.notify_peers_of_settings_change()
-        # re-handshakes must not race the fresh provider's cold jit
+        # Neither our re-handshakes nor peer-initiated ones (triggered by the
+        # gossip below) may race the fresh provider's cold jit: wait first.
         await self.wait_ready()
+        await self.notify_peers_of_settings_change()
         for peer_id in peers:
             if self.node.is_connected(peer_id):
                 asyncio.ensure_future(self.initiate_key_exchange(peer_id))
@@ -734,6 +735,9 @@ class SecureMessaging:
             self._spawn_warmup(kem=False, sig=True)
         self._sig_keypair = self._load_or_generate_sig_keypair()
         self._log("crypto_settings_changed", component="signature", algorithm=name)
+        # peers adopting the new signature re-handshake through our _bsig;
+        # don't gossip until it is warm
+        await self.wait_ready()
         await self.notify_peers_of_settings_change()
 
     async def adopt_peer_settings(self, peer_id: str) -> bool:

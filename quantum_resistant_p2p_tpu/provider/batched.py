@@ -41,7 +41,14 @@ class QueueStats:
     total_dispatch_s: float = 0.0
     #: per-flush batch sizes, most recent last (bounded)
     batch_sizes: list[int] = field(default_factory=list)
+    #: per-flush dispatch seconds, most recent last (bounded)
+    dispatch_times: list[float] = field(default_factory=list)
     BATCH_SIZE_HISTORY = 1024
+
+    @staticmethod
+    def _pct(xs: list[float], q: float) -> float:
+        s = sorted(xs)
+        return s[min(len(s) - 1, int(q * len(s)))] if s else 0.0
 
     def as_dict(self) -> dict[str, Any]:
         return {
@@ -52,6 +59,8 @@ class QueueStats:
             "avg_dispatch_ms": (
                 1e3 * self.total_dispatch_s / self.flushes if self.flushes else 0.0
             ),
+            "p50_dispatch_ms": round(1e3 * self._pct(self.dispatch_times, 0.5), 3),
+            "p99_dispatch_ms": round(1e3 * self._pct(self.dispatch_times, 0.99), 3),
         }
 
 
@@ -115,7 +124,10 @@ class OpQueue:
         loop = asyncio.get_running_loop()
         try:
             results = await loop.run_in_executor(None, self.batch_fn, items)
-            self.stats.total_dispatch_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.stats.total_dispatch_s += dt
+            self.stats.dispatch_times.append(dt)
+            del self.stats.dispatch_times[: -QueueStats.BATCH_SIZE_HISTORY]
             for f, r in zip(futs, results):
                 if f.cancelled():
                     continue

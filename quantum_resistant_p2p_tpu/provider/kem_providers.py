@@ -172,18 +172,29 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
 
     def _sliced(self, fn, *arrays):
         """Dispatch in MAX_DEVICE_BATCH slices — larger single Frodo batches
-        crash this environment's TPU worker (kem/frodo.py MAX_DEVICE_BATCH)."""
+        crash this environment's TPU worker (kem/frodo.py MAX_DEVICE_BATCH).
+        A non-divisible tail is padded up to a full slice (last row repeated)
+        so every dispatch hits an already-compiled shape, then trimmed."""
         n = arrays[0].shape[0]
         step = self._max_dispatch
         if n <= step:
             out = fn(*arrays)
             return tuple(np.asarray(o) for o in out) if isinstance(out, tuple) else np.asarray(out)
-        parts = [fn(*(a[i : i + step] for a in arrays)) for i in range(0, n, step)]
+
+        def slice_of(a, i):
+            part = a[i : i + step]
+            if part.shape[0] < step:
+                pad = np.broadcast_to(part[-1:], (step - part.shape[0],) + part.shape[1:])
+                part = np.concatenate([np.asarray(part), pad], axis=0)
+            return part
+
+        parts = [fn(*(slice_of(a, i) for a in arrays)) for i in range(0, n, step)]
         if isinstance(parts[0], tuple):
             return tuple(
-                np.concatenate([np.asarray(p[j]) for p in parts]) for j in range(len(parts[0]))
+                np.concatenate([np.asarray(p[j]) for p in parts])[:n]
+                for j in range(len(parts[0]))
             )
-        return np.concatenate([np.asarray(p) for p in parts])
+        return np.concatenate([np.asarray(p) for p in parts])[:n]
 
     def generate_keypair(self) -> tuple[bytes, bytes]:
         pk, sk = self.generate_keypair_batch(1)

@@ -22,6 +22,18 @@ import jax
 import numpy as np
 
 
+def enable_compile_cache(path: str = "/tmp/jax_cache_qrp2p") -> None:
+    """Persistent XLA compilation cache (same dir as tests/conftest.py).
+
+    The crypto programs are compile-heavy (minutes for the big signature
+    graphs); with the cache, repeat bench runs skip straight to execution.
+    Call before the first jit use in every bench/tool entry point.
+    """
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
 def sync(tree: Any) -> None:
     """Force real completion of every array in ``tree`` via host readback."""
     for leaf in jax.tree_util.tree_leaves(tree):

@@ -24,7 +24,7 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the crypto kernels are compile-heavy; caching
 # cuts repeat suite runs from tens of minutes to minutes.  Set via config (not
 # env): this image's TPU bootstrap imports jax at interpreter start, before
-# conftest env vars could be seen.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_qrp2p")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# conftest env vars could be seen.  Shared with the bench entry points.
+from quantum_resistant_p2p_tpu.utils.benchmarking import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

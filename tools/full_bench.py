@@ -22,9 +22,10 @@ Input residency: large operands (public keys, secret keys, ciphertexts) are
 ``jax.device_put`` BEFORE timing, so configs 2-4 measure device compute
 throughput — the same methodology as liboqs's in-memory speed tests, and
 what "ops/sec/chip" means.  This environment reaches its one chip through a
-~7 MB/s tunnel, so leaving multi-MB operands on the host would time the
-tunnel, not the chip (measured: encaps drops 110k -> 6.4k/s, and decaps
-lands at exactly half encaps because dk is twice the bytes).  The tunnel
+~0.4 MB/s tunnel (measured, audit_tunnel), so leaving multi-MB operands on
+the host would time the tunnel, not the chip (measured: encaps drops
+110k -> 6.4k/s, and decaps lands at exactly half encaps because dk is twice
+the bytes).  The tunnel
 h2d bandwidth is recorded separately in the audit section; config 5 (swarm)
 times the complete production pipeline including every host<->device hop.
 
@@ -309,6 +310,9 @@ def main(argv=None) -> int:
     try:
         import jax
 
+        from quantum_resistant_p2p_tpu.utils.benchmarking import enable_compile_cache
+
+        enable_compile_cache()
         out["platform"] = jax.default_backend()
         out["devices"] = [str(d) for d in jax.devices()]
     except Exception:

@@ -37,6 +37,10 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
     # keeps compiles out of the measured numbers.
     from quantum_resistant_p2p_tpu.app import messaging as _messaging
 
+    if backend != "cpu":
+        from quantum_resistant_p2p_tpu.utils.benchmarking import enable_compile_cache
+
+        enable_compile_cache()
     _messaging.KEY_EXCHANGE_TIMEOUT = ke_timeout
     hub_node = P2PNode(node_id="hub", host="127.0.0.1", port=0)
     await hub_node.start()
