@@ -225,9 +225,10 @@ def test_slhdsa_kat_native(fname):
         assert nat.verify_internal(msg, sig, pk)
 
 
-@pytest.mark.slow
 @pytest.mark.parametrize("fname", ["slhdsa_128f.json"])
 def test_slhdsa_kat_pyref(fname):
+    """Fast tier on purpose: the only toolchain-independent SPHINCS+ vector
+    check (native tests skip without g++, the JAX module is slow-tier)."""
     data = _load(fname)
     p = slhdsa_ref.PARAMS[data["algorithm"]]
     rec = data["tests"][0]

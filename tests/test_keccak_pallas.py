@@ -2,8 +2,13 @@
 
 The kernel's native path is exercised on the real chip by bench.py and the
 TPU provider; here interpret mode checks bit-exactness of the fused
-absorb-permute-squeeze pipeline.  Shapes are kept tiny: interpret mode
-executes the fully-unrolled 24-round network per grid step.
+absorb-permute-squeeze pipeline.
+
+Slow tier: interpret mode simulates every vector op of the fully-unrolled
+24-round network over (8, 128) tiles — minutes of wall time and tens of GB
+of trace memory per case, even at tiny logical shapes.  The fast tier
+covers the same byte-level behavior through the jnp sponge (test_keccak.py,
+hashlib oracle); this module proves kernel==sponge and runs nightly.
 """
 
 import hashlib
@@ -13,6 +18,8 @@ import pytest
 
 from quantum_resistant_p2p_tpu.core import keccak
 from quantum_resistant_p2p_tpu.core.keccak_pallas import sponge_words
+
+pytestmark = pytest.mark.slow
 
 
 def _run(msgs: np.ndarray, rate: int, ds: int, out_len: int) -> np.ndarray:

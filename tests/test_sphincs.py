@@ -1,10 +1,20 @@
-"""SPHINCS+ batched JAX vs pure-Python oracle (bit-exact)."""
+"""SPHINCS+ batched JAX vs pure-Python oracle (bit-exact).
+
+Slow tier: the hypertree graphs (d layers x unrolled WOTS chains) cost
+minutes of TRACE time per parameter set — jax's persistent cache skips XLA
+compilation but not tracing, so these pay their cost on every run.  The
+fast tier still proves SPHINCS+ correctness for every parameter set through
+the native C++/pyref KATs (tests/test_native.py, tests/test_kat.py); this
+module proves the JAX implementation bit-exact and runs nightly.
+"""
 
 import numpy as np
 import pytest
 
 from quantum_resistant_p2p_tpu.pyref import slhdsa_ref as slh
 from quantum_resistant_p2p_tpu.sig import sphincs as jslh
+
+pytestmark = pytest.mark.slow
 
 RNG = np.random.default_rng(20260730)
 
