@@ -1734,6 +1734,445 @@ bool verify_internal(const Params& p, const uint8_t* msg, size_t msglen,
 
 }  // namespace slhdsa
 
+// ---------------------------------------------------------------- AES-128
+
+namespace aes {
+
+uint8_t SBOX[256];
+uint32_t T0[256], T1[256], T2[256], T3[256];
+
+inline uint8_t xtime(uint8_t x) { return (uint8_t)((x << 1) ^ ((x >> 7) * 0x1b)); }
+
+struct AesInit {
+  AesInit() {
+    // S-box from GF(2^8) inverse + affine map (computed, not transcribed)
+    uint8_t expt[256], logt[256];
+    uint8_t x = 1;
+    for (int i = 0; i < 255; ++i) {
+      expt[i] = x;
+      logt[x] = (uint8_t)i;
+      x = (uint8_t)(x ^ xtime(x));  // multiply by 3 (generator)
+    }
+    for (int v = 0; v < 256; ++v) {
+      // exp has period 255: index (255 - log) % 255 (v=1 has log 0 -> inv 1)
+      uint8_t inv = v ? expt[(255 - logt[v]) % 255] : 0;
+      uint8_t r = 0x63;
+      for (int sh = 0; sh < 5; ++sh)
+        r ^= (uint8_t)((inv << sh) | (inv >> (8 - sh)));
+      SBOX[v] = r;
+    }
+    for (int v = 0; v < 256; ++v) {
+      uint8_t s = SBOX[v];
+      uint8_t s2 = xtime(s), s3 = (uint8_t)(s2 ^ s);
+      // column (2s, s, s, 3s) little-endian word
+      T0[v] = (uint32_t)s2 | ((uint32_t)s << 8) | ((uint32_t)s << 16) | ((uint32_t)s3 << 24);
+      T1[v] = (T0[v] << 8) | (T0[v] >> 24);
+      T2[v] = (T0[v] << 16) | (T0[v] >> 16);
+      T3[v] = (T0[v] << 24) | (T0[v] >> 8);
+    }
+  }
+} aes_init;
+
+struct Aes128 {
+  uint32_t rk[44];
+  explicit Aes128(const uint8_t key[16]) {
+    for (int i = 0; i < 4; ++i)
+      rk[i] = (uint32_t)key[4 * i] | ((uint32_t)key[4 * i + 1] << 8) |
+              ((uint32_t)key[4 * i + 2] << 16) | ((uint32_t)key[4 * i + 3] << 24);
+    uint8_t rcon = 1;
+    for (int i = 4; i < 44; ++i) {
+      uint32_t t = rk[i - 1];
+      if (i % 4 == 0) {
+        t = (t >> 8) | (t << 24);  // RotWord on LE layout
+        t = (uint32_t)SBOX[t & 0xff] | ((uint32_t)SBOX[(t >> 8) & 0xff] << 8) |
+            ((uint32_t)SBOX[(t >> 16) & 0xff] << 16) |
+            ((uint32_t)SBOX[(t >> 24) & 0xff] << 24);
+        t ^= rcon;
+        rcon = xtime(rcon);
+      }
+      rk[i] = rk[i - 4] ^ t;
+    }
+  }
+  void encrypt_block(const uint8_t in[16], uint8_t out[16]) const {
+    uint32_t s0, s1, s2, s3, t0, t1, t2, t3;
+    s0 = ((uint32_t)in[0] | ((uint32_t)in[1] << 8) | ((uint32_t)in[2] << 16) |
+          ((uint32_t)in[3] << 24)) ^ rk[0];
+    s1 = ((uint32_t)in[4] | ((uint32_t)in[5] << 8) | ((uint32_t)in[6] << 16) |
+          ((uint32_t)in[7] << 24)) ^ rk[1];
+    s2 = ((uint32_t)in[8] | ((uint32_t)in[9] << 8) | ((uint32_t)in[10] << 16) |
+          ((uint32_t)in[11] << 24)) ^ rk[2];
+    s3 = ((uint32_t)in[12] | ((uint32_t)in[13] << 8) | ((uint32_t)in[14] << 16) |
+          ((uint32_t)in[15] << 24)) ^ rk[3];
+    for (int r = 1; r < 10; ++r) {
+      t0 = T0[s0 & 0xff] ^ T1[(s1 >> 8) & 0xff] ^ T2[(s2 >> 16) & 0xff] ^
+           T3[(s3 >> 24) & 0xff] ^ rk[4 * r];
+      t1 = T0[s1 & 0xff] ^ T1[(s2 >> 8) & 0xff] ^ T2[(s3 >> 16) & 0xff] ^
+           T3[(s0 >> 24) & 0xff] ^ rk[4 * r + 1];
+      t2 = T0[s2 & 0xff] ^ T1[(s3 >> 8) & 0xff] ^ T2[(s0 >> 16) & 0xff] ^
+           T3[(s1 >> 24) & 0xff] ^ rk[4 * r + 2];
+      t3 = T0[s3 & 0xff] ^ T1[(s0 >> 8) & 0xff] ^ T2[(s1 >> 16) & 0xff] ^
+           T3[(s2 >> 24) & 0xff] ^ rk[4 * r + 3];
+      s0 = t0; s1 = t1; s2 = t2; s3 = t3;
+    }
+    // final round (no MixColumns)
+    uint8_t tmp[16];
+    const uint32_t st[4] = {s0, s1, s2, s3};
+    for (int c = 0; c < 4; ++c)
+      for (int b = 0; b < 4; ++b)
+        tmp[4 * c + b] = SBOX[(st[(c + b) % 4] >> (8 * b)) & 0xff];
+    for (int c = 0; c < 4; ++c) {
+      uint32_t w = (uint32_t)tmp[4 * c] | ((uint32_t)tmp[4 * c + 1] << 8) |
+                   ((uint32_t)tmp[4 * c + 2] << 16) | ((uint32_t)tmp[4 * c + 3] << 24);
+      w ^= rk[40 + c];
+      out[4 * c] = (uint8_t)w;
+      out[4 * c + 1] = (uint8_t)(w >> 8);
+      out[4 * c + 2] = (uint8_t)(w >> 16);
+      out[4 * c + 3] = (uint8_t)(w >> 24);
+    }
+  }
+};
+
+}  // namespace aes
+
+// ---------------------------------------------------------------- FrodoKEM
+
+namespace frodo {
+
+constexpr int NBAR = 8;
+
+struct Params {
+  const char* name;
+  int n, d, b, len_sec;
+  bool aes;
+  const uint16_t* cdf;
+  int cdf_len;
+  int q_mask() const { return (1 << d) - 1; }
+  int pk_len() const { return 16 + n * NBAR * d / 8; }
+  int sk_len() const { return len_sec + pk_len() + 2 * n * NBAR + len_sec; }
+  int ct_len() const { return (NBAR * n + NBAR * NBAR) * d / 8; }
+  unsigned shake_rate() const { return n == 640 ? 168u : 136u; }
+};
+
+const uint16_t CDF640[] = {4643, 13363, 20579, 25843, 29227, 31145, 32103,
+                           32525, 32689, 32745, 32762, 32766, 32767};
+const uint16_t CDF976[] = {5638, 15915, 23689, 28571, 31116, 32217, 32613,
+                           32731, 32760, 32766, 32767};
+const uint16_t CDF1344[] = {9142, 23462, 30338, 32361, 32725, 32765, 32767};
+
+// ids: 0=640-AES 1=640-SHAKE 2=976-AES 3=976-SHAKE 4=1344-AES 5=1344-SHAKE
+const Params FPARAMS[6] = {
+    {"FrodoKEM-640-AES", 640, 15, 2, 16, true, CDF640, 13},
+    {"FrodoKEM-640-SHAKE", 640, 15, 2, 16, false, CDF640, 13},
+    {"FrodoKEM-976-AES", 976, 16, 3, 24, true, CDF976, 11},
+    {"FrodoKEM-976-SHAKE", 976, 16, 3, 24, false, CDF976, 11},
+    {"FrodoKEM-1344-AES", 1344, 16, 4, 32, true, CDF1344, 7},
+    {"FrodoKEM-1344-SHAKE", 1344, 16, 4, 32, false, CDF1344, 7},
+};
+
+void fshake(const Params& p, const uint8_t* in, size_t inlen, uint8_t* out,
+            size_t outlen) {
+  shake(p.shake_rate(), in, inlen, out, outlen);
+}
+
+// one row of A into row[n] (streamed — A is never materialised)
+struct RowGen {
+  const Params& p;
+  const aes::Aes128* cipher;  // AES variants
+  const uint8_t* seed_a;      // SHAKE variants
+  RowGen(const Params& pp, const aes::Aes128* c, const uint8_t* sa)
+      : p(pp), cipher(c), seed_a(sa) {}
+  void row(int i, uint16_t* out) const {
+    if (p.aes) {
+      uint8_t blk[16] = {0}, ct[16];
+      blk[0] = (uint8_t)(i & 0xff);
+      blk[1] = (uint8_t)(i >> 8);
+      for (int j = 0; j < p.n; j += 8) {
+        blk[2] = (uint8_t)(j & 0xff);
+        blk[3] = (uint8_t)(j >> 8);
+        cipher->encrypt_block(blk, ct);
+        for (int k = 0; k < 8; ++k)
+          out[j + k] = (uint16_t)((ct[2 * k] | (ct[2 * k + 1] << 8)) & p.q_mask());
+      }
+    } else {
+      uint8_t in[18];
+      in[0] = (uint8_t)(i & 0xff);
+      in[1] = (uint8_t)(i >> 8);
+      std::memcpy(in + 2, seed_a, 16);
+      static thread_local uint8_t buf[2 * 1344];
+      shake(168, in, 18, buf, (size_t)(2 * p.n));  // SHAKE-128 per spec GenA
+      for (int j = 0; j < p.n; ++j)
+        out[j] = (uint16_t)((buf[2 * j] | (buf[2 * j + 1] << 8)) & p.q_mask());
+    }
+  }
+};
+
+int16_t fsample(const Params& p, uint16_t r16) {
+  // branch-free CDF inversion: the sampled noise is secret, so neither the
+  // comparison count nor the sign selection may branch on it
+  uint16_t t = (uint16_t)(r16 >> 1);
+  uint16_t e = 0;
+  for (int z = 0; z < p.cdf_len - 1; ++z)
+    e = (uint16_t)(e + ((uint16_t)(p.cdf[z] - t) >> 15));  // 1 iff t > cdf[z]
+  uint16_t sign = (uint16_t)(0 - (r16 & 1));  // 0x0000 or 0xffff
+  return (int16_t)((e ^ sign) + (r16 & 1));
+}
+
+void sample_matrix(const Params& p, const uint8_t* rbytes, int count, int16_t* out) {
+  for (int k = 0; k < count; ++k)
+    out[k] = fsample(p, (uint16_t)(rbytes[2 * k] | (rbytes[2 * k + 1] << 8)));
+}
+
+// D-bit big-endian bit packing (spec Algorithms 3-4)
+void fpack(const Params& p, const uint16_t* vals, int count, uint8_t* out) {
+  uint32_t acc = 0;
+  int bits = 0, pos = 0;
+  for (int k = 0; k < count; ++k) {
+    acc = (acc << p.d) | (uint32_t)(vals[k] & p.q_mask());
+    bits += p.d;
+    while (bits >= 8) {
+      bits -= 8;
+      out[pos++] = (uint8_t)((acc >> bits) & 0xff);
+    }
+  }
+}
+
+void funpack(const Params& p, const uint8_t* data, int count, uint16_t* out) {
+  uint32_t acc = 0;
+  int bits = 0, pos = 0;
+  for (int k = 0; k < count; ++k) {
+    while (bits < p.d) {
+      acc = (acc << 8) | data[pos++];
+      bits += 8;
+    }
+    bits -= p.d;
+    out[k] = (uint16_t)((acc >> bits) & p.q_mask());
+    acc &= (1u << bits) - 1;
+  }
+}
+
+void fencode(const Params& p, const uint8_t* mu, uint16_t* out) {
+  int step_shift = p.d - p.b;
+  for (int k = 0; k < NBAR * NBAR; ++k) {
+    uint16_t v = 0;
+    for (int l = 0; l < p.b; ++l) {
+      int bit = k * p.b + l;
+      v |= (uint16_t)(((mu[bit >> 3] >> (bit & 7)) & 1) << l);
+    }
+    out[k] = (uint16_t)(v << step_shift);
+  }
+}
+
+void fdecode(const Params& p, const uint16_t* m, uint8_t* out) {
+  std::memset(out, 0, (size_t)(NBAR * NBAR * p.b / 8));
+  for (int k = 0; k < NBAR * NBAR; ++k) {
+    uint16_t val = (uint16_t)((((uint32_t)(m[k] & p.q_mask()) << p.b) + (1u << (p.d - 1))) >> p.d);
+    val &= (uint16_t)((1 << p.b) - 1);
+    for (int l = 0; l < p.b; ++l) {
+      int bit = k * p.b + l;
+      out[bit >> 3] |= (uint8_t)(((val >> l) & 1) << (bit & 7));
+    }
+  }
+}
+
+// B' = S'(8 x n) @ A + E' and V-side products, streaming A row by row.
+// sp/ep row-major 8 x n; out row-major 8 x n.
+void sa_plus_e(const Params& p, const RowGen& gen, const int16_t* sp,
+               const int16_t* ep, uint16_t* out) {
+  static thread_local uint16_t arow[1344];
+  for (int i = 0; i < NBAR; ++i)
+    for (int j = 0; j < p.n; ++j) out[i * p.n + j] = (uint16_t)ep[i * p.n + j];
+  for (int k = 0; k < p.n; ++k) {
+    gen.row(k, arow);
+    for (int i = 0; i < NBAR; ++i) {
+      int16_t s = sp[i * p.n + k];
+      if (!s) continue;
+      uint16_t* o = out + i * p.n;
+      for (int j = 0; j < p.n; ++j)
+        o[j] = (uint16_t)(o[j] + s * (int16_t)arow[j]);  // mod 2^16, masked later
+    }
+  }
+  for (int k = 0; k < NBAR * p.n; ++k) out[k] &= (uint16_t)p.q_mask();
+}
+
+// B = A @ S + E, streaming A rows; st row-major NBAR x n (S^T), e n x NBAR.
+void as_plus_e(const Params& p, const RowGen& gen, const int16_t* st,
+               const int16_t* e, uint16_t* out) {
+  static thread_local uint16_t arow[1344];
+  for (int i = 0; i < p.n; ++i) {
+    gen.row(i, arow);
+    for (int j = 0; j < NBAR; ++j) {
+      uint32_t acc = 0;
+      const int16_t* srow = st + j * p.n;  // column j of S = row j of S^T
+      for (int k = 0; k < p.n; ++k) acc += (uint32_t)((int32_t)arow[k] * srow[k]);
+      out[i * NBAR + j] = (uint16_t)((acc + (uint32_t)e[i * NBAR + j]) & (uint32_t)p.q_mask());
+    }
+  }
+}
+
+void keygen(const Params& p, const uint8_t* s, const uint8_t* seed_se,
+            const uint8_t* z, uint8_t* pk, uint8_t* sk) {
+  uint8_t seed_a[16];
+  fshake(p, z, (size_t)p.len_sec, seed_a, 16);
+  aes::Aes128 cipher(seed_a);
+  RowGen gen(p, p.aes ? &cipher : nullptr, seed_a);
+
+  static thread_local uint8_t r[4 * 1344 * NBAR];
+  uint8_t pre[1 + 32];
+  pre[0] = 0x5f;
+  std::memcpy(pre + 1, seed_se, (size_t)p.len_sec);
+  fshake(p, pre, (size_t)(1 + p.len_sec), r, (size_t)(4 * p.n * NBAR));
+  static thread_local int16_t st[NBAR * 1344], e[1344 * NBAR];
+  sample_matrix(p, r, NBAR * p.n, st);
+  sample_matrix(p, r + 2 * p.n * NBAR, p.n * NBAR, e);
+
+  static thread_local uint16_t bmat[1344 * NBAR];
+  as_plus_e(p, gen, st, e, bmat);
+  std::memcpy(pk, seed_a, 16);
+  fpack(p, bmat, p.n * NBAR, pk + 16);
+  // sk = s || pk || S^T (signed int16 LE) || pkh
+  std::memcpy(sk, s, (size_t)p.len_sec);
+  std::memcpy(sk + p.len_sec, pk, (size_t)p.pk_len());
+  uint8_t* stb = sk + p.len_sec + p.pk_len();
+  for (int k = 0; k < NBAR * p.n; ++k) {
+    stb[2 * k] = (uint8_t)(st[k] & 0xff);
+    stb[2 * k + 1] = (uint8_t)((st[k] >> 8) & 0xff);
+  }
+  fshake(p, pk, (size_t)p.pk_len(), sk + p.len_sec + p.pk_len() + 2 * NBAR * p.n,
+         (size_t)p.len_sec);
+  mldsa::secure_wipe(st, sizeof(int16_t) * NBAR * p.n);
+  mldsa::secure_wipe(e, sizeof(int16_t) * p.n * NBAR);
+  mldsa::secure_wipe(r, (size_t)(4 * p.n * NBAR));
+}
+
+// shared encrypt core: mu + seeds -> (bp 8xn, c 8x8); used by encaps + decaps
+void encrypt(const Params& p, const uint8_t* pk, const uint8_t* mu,
+             const uint8_t* seed_se, uint16_t* bp, uint16_t* c) {
+  const uint8_t* seed_a = pk;
+  aes::Aes128 cipher(seed_a);
+  RowGen gen(p, p.aes ? &cipher : nullptr, seed_a);
+
+  static thread_local uint8_t r[(2 * NBAR * 1344 + NBAR * NBAR) * 2];
+  uint8_t pre[1 + 32];
+  pre[0] = 0x96;
+  std::memcpy(pre + 1, seed_se, (size_t)p.len_sec);
+  fshake(p, pre, (size_t)(1 + p.len_sec),
+         r, (size_t)((2 * NBAR * p.n + NBAR * NBAR) * 2));
+  static thread_local int16_t sp[NBAR * 1344], ep[NBAR * 1344];
+  int16_t epp[NBAR * NBAR];
+  sample_matrix(p, r, NBAR * p.n, sp);
+  sample_matrix(p, r + 2 * NBAR * p.n, NBAR * p.n, ep);
+  sample_matrix(p, r + 4 * NBAR * p.n, NBAR * NBAR, epp);
+
+  sa_plus_e(p, gen, sp, ep, bp);
+  // V = S' @ B + E'' + Encode(mu)
+  static thread_local uint16_t bmat[1344 * NBAR];
+  funpack(p, pk + 16, p.n * NBAR, bmat);
+  uint16_t enc_mu[NBAR * NBAR];
+  fencode(p, mu, enc_mu);
+  for (int i = 0; i < NBAR; ++i)
+    for (int j = 0; j < NBAR; ++j) {
+      uint32_t acc = 0;
+      for (int k = 0; k < p.n; ++k)
+        acc += (uint32_t)((int32_t)sp[i * p.n + k] * (int32_t)bmat[k * NBAR + j]);
+      c[i * NBAR + j] = (uint16_t)((acc + (uint32_t)epp[i * NBAR + j] +
+                                    enc_mu[i * NBAR + j]) & (uint32_t)p.q_mask());
+    }
+  mldsa::secure_wipe(enc_mu, sizeof(enc_mu));
+  mldsa::secure_wipe(sp, sizeof(int16_t) * NBAR * p.n);
+  mldsa::secure_wipe(ep, sizeof(int16_t) * NBAR * p.n);
+  mldsa::secure_wipe(epp, sizeof(epp));
+  mldsa::secure_wipe(r, (size_t)((2 * NBAR * p.n + NBAR * NBAR) * 2));
+}
+
+void encaps(const Params& p, const uint8_t* pk, const uint8_t* mu, uint8_t* ct,
+            uint8_t* ss) {
+  uint8_t pkh[32], se_k[64];
+  fshake(p, pk, (size_t)p.pk_len(), pkh, (size_t)p.len_sec);
+  static thread_local uint8_t buf[32 + 32];
+  std::memcpy(buf, pkh, (size_t)p.len_sec);
+  std::memcpy(buf + p.len_sec, mu, (size_t)p.len_sec);
+  fshake(p, buf, (size_t)(2 * p.len_sec), se_k, (size_t)(2 * p.len_sec));
+  const uint8_t* seed_se = se_k;
+  const uint8_t* k = se_k + p.len_sec;
+
+  static thread_local uint16_t bp[NBAR * 1344];
+  uint16_t c[NBAR * NBAR];
+  encrypt(p, pk, mu, seed_se, bp, c);
+  int c1 = NBAR * p.n * p.d / 8;
+  fpack(p, bp, NBAR * p.n, ct);
+  fpack(p, c, NBAR * NBAR, ct + c1);
+  // ss = SHAKE(ct || k)
+  static thread_local uint8_t tail[21632 + 32];
+  std::memcpy(tail, ct, (size_t)p.ct_len());
+  std::memcpy(tail + p.ct_len(), k, (size_t)p.len_sec);
+  fshake(p, tail, (size_t)(p.ct_len() + p.len_sec), ss, (size_t)p.len_sec);
+  mldsa::secure_wipe(se_k, sizeof(se_k));
+  mldsa::secure_wipe(buf, sizeof(buf));  // held pkh || mu (mu is secret)
+  mldsa::secure_wipe(tail, (size_t)(p.ct_len() + p.len_sec));
+}
+
+void decaps(const Params& p, const uint8_t* sk, const uint8_t* ct, uint8_t* ss) {
+  const uint8_t* s = sk;
+  const uint8_t* pk = sk + p.len_sec;
+  const uint8_t* stb = sk + p.len_sec + p.pk_len();
+  const uint8_t* pkh = stb + 2 * NBAR * p.n;
+
+  int c1 = NBAR * p.n * p.d / 8;
+  static thread_local uint16_t bp[NBAR * 1344];
+  uint16_t c[NBAR * NBAR];
+  funpack(p, ct, NBAR * p.n, bp);
+  funpack(p, ct + c1, NBAR * NBAR, c);
+
+  // M = C - B' S  (S^T stored signed little-endian)
+  static thread_local int16_t st[NBAR * 1344];
+  for (int k = 0; k < NBAR * p.n; ++k)
+    st[k] = (int16_t)(uint16_t)(stb[2 * k] | (stb[2 * k + 1] << 8));
+  uint16_t m[NBAR * NBAR];
+  for (int i = 0; i < NBAR; ++i)
+    for (int j = 0; j < NBAR; ++j) {
+      uint32_t acc = 0;
+      for (int k = 0; k < p.n; ++k)
+        acc += (uint32_t)((int32_t)bp[i * p.n + k] * (int32_t)st[j * p.n + k]);
+      m[i * NBAR + j] = (uint16_t)((c[i * NBAR + j] - acc) & (uint32_t)p.q_mask());
+    }
+  uint8_t mu_p[32];
+  fdecode(p, m, mu_p);
+
+  uint8_t se_k[64];
+  static thread_local uint8_t buf[32 + 32];
+  std::memcpy(buf, pkh, (size_t)p.len_sec);
+  std::memcpy(buf + p.len_sec, mu_p, (size_t)p.len_sec);
+  fshake(p, buf, (size_t)(2 * p.len_sec), se_k, (size_t)(2 * p.len_sec));
+
+  static thread_local uint16_t bpp[NBAR * 1344];
+  uint16_t cp[NBAR * NBAR];
+  encrypt(p, pk, mu_p, se_k, bpp, cp);
+
+  // constant-time compare + select of k' vs s
+  uint32_t diff = 0;
+  for (int k = 0; k < NBAR * p.n; ++k) diff |= (uint32_t)(bp[k] ^ bpp[k]);
+  for (int k = 0; k < NBAR * NBAR; ++k) diff |= (uint32_t)(c[k] ^ cp[k]);
+  uint8_t mask = (uint8_t)(((int32_t)(diff | (0u - diff)) >> 31) & 0xff);  // 0xff iff diff != 0
+  uint8_t sel[32];
+  for (int i = 0; i < p.len_sec; ++i)
+    sel[i] = (uint8_t)((se_k[p.len_sec + i] & (uint8_t)~mask) | (s[i] & mask));
+
+  static thread_local uint8_t tail[21632 + 32];
+  std::memcpy(tail, ct, (size_t)p.ct_len());
+  std::memcpy(tail + p.ct_len(), sel, (size_t)p.len_sec);
+  fshake(p, tail, (size_t)(p.ct_len() + p.len_sec), ss, (size_t)p.len_sec);
+  mldsa::secure_wipe(st, sizeof(int16_t) * NBAR * p.n);
+  mldsa::secure_wipe(se_k, sizeof(se_k));
+  mldsa::secure_wipe(sel, sizeof(sel));
+  mldsa::secure_wipe(tail, (size_t)(p.ct_len() + p.len_sec));
+  // the decrypted message seed mu' and everything holding it are secret
+  mldsa::secure_wipe(mu_p, sizeof(mu_p));
+  mldsa::secure_wipe(m, sizeof(m));
+  mldsa::secure_wipe(buf, sizeof(buf));
+}
+
+}  // namespace frodo
+
 }  // namespace
 
 extern "C" {
@@ -1871,6 +2310,36 @@ int qrp_slhdsa_verify(int param_id, const uint8_t* pk, const uint8_t* msg,
              : 0;
 }
 
-int qrp_version(void) { return 3; }
+// -------- AES-128-ECB (FrodoKEM matrix generation; FIPS-197-testable) -------
+
+void qrp_aes128_ecb(const uint8_t* key, const uint8_t* in, size_t nblocks,
+                    uint8_t* out) {
+  aes::Aes128 c(key);
+  for (size_t i = 0; i < nblocks; ++i)
+    c.encrypt_block(in + 16 * i, out + 16 * i);
+}
+
+// -------- FrodoKEM (round-3/ISO spec internal forms) ------------------------
+//
+// param_id: 0=640-AES 1=640-SHAKE 2=976-AES 3=976-SHAKE 4=1344-AES
+// 5=1344-SHAKE.  Deterministic seams match pyref/frodo_ref.py:
+// keygen(s, seedSE, z), encaps(pk, mu), decaps(sk, ct).
+
+void qrp_frodo_keygen(int param_id, const uint8_t* s, const uint8_t* seed_se,
+                      const uint8_t* z, uint8_t* pk, uint8_t* sk) {
+  frodo::keygen(frodo::FPARAMS[param_id], s, seed_se, z, pk, sk);
+}
+
+void qrp_frodo_encaps(int param_id, const uint8_t* pk, const uint8_t* mu,
+                      uint8_t* ct, uint8_t* ss) {
+  frodo::encaps(frodo::FPARAMS[param_id], pk, mu, ct, ss);
+}
+
+void qrp_frodo_decaps(int param_id, const uint8_t* sk, const uint8_t* ct,
+                      uint8_t* ss) {
+  frodo::decaps(frodo::FPARAMS[param_id], sk, ct, ss);
+}
+
+int qrp_version(void) { return 4; }
 
 }  // extern "C"

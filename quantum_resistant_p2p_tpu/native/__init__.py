@@ -99,6 +99,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ("qrp_hmac_sha256", [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t, u8p]),
         ("qrp_slhdsa_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p, u8p]),
         ("qrp_slhdsa_sign", [ctypes.c_int, u8p, u8p, ctypes.c_size_t, u8p, u8p]),
+        ("qrp_aes128_ecb", [u8p, u8p, ctypes.c_size_t, u8p]),
+        ("qrp_frodo_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p, u8p]),
+        ("qrp_frodo_encaps", [ctypes.c_int, u8p, u8p, u8p, u8p]),
+        ("qrp_frodo_decaps", [ctypes.c_int, u8p, u8p, u8p]),
     ):
         fn = getattr(lib, name)
         fn.argtypes = argtypes
@@ -111,6 +115,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.qrp_slhdsa_verify.restype = ctypes.c_int
     lib.qrp_version.restype = ctypes.c_int
     return lib
+
+
+def _expect(data: bytes, n: int, what: str) -> None:
+    # Wrong lengths never reach the native core (it reads fixed param-set
+    # sizes unconditionally) — same seam contract as the pyref oracles.
+    if len(data) != n:
+        raise ValueError(f"{what} must be {n} bytes, got {len(data)}")
 
 
 def _buf(data: bytes):
@@ -168,22 +179,15 @@ class NativeMLDSA:
         p = mldsa_ref.PARAMS[name]
         self.pk_len, self.sk_len, self.sig_len = p.pk_len, p.sk_len, p.sig_len
 
-    @staticmethod
-    def _expect(data: bytes, n: int, what: str) -> None:
-        # Same seam contract as pyref.mldsa_ref: wrong lengths never reach
-        # the native core (it reads fixed param-set sizes unconditionally).
-        if len(data) != n:
-            raise ValueError(f"{what} must be {n} bytes, got {len(data)}")
-
     def keygen(self, xi: bytes) -> tuple[bytes, bytes]:
-        self._expect(xi, 32, "xi")
+        _expect(xi, 32, "xi")
         pk, sk = _out(self.pk_len), _out(self.sk_len)
         self.lib.qrp_mldsa_keygen(self.level, _buf(xi), pk, sk)
         return bytes(pk), bytes(sk)
 
     def sign_internal(self, sk: bytes, m_prime: bytes, rnd: bytes) -> bytes:
-        self._expect(sk, self.sk_len, "secret key")
-        self._expect(rnd, 32, "rnd")
+        _expect(sk, self.sk_len, "secret key")
+        _expect(rnd, 32, "rnd")
         sig = _out(self.sig_len)
         ok = self.lib.qrp_mldsa_sign(
             self.level, _buf(sk), _buf(m_prime), len(m_prime), _buf(rnd), sig
@@ -231,8 +235,7 @@ class NativeSLHDSA:
 
     def keygen(self, sk_seed: bytes, sk_prf: bytes, pk_seed: bytes) -> tuple[bytes, bytes]:
         for nm, s in (("sk_seed", sk_seed), ("sk_prf", sk_prf), ("pk_seed", pk_seed)):
-            if len(s) != self.n:
-                raise ValueError(f"{nm} must be {self.n} bytes, got {len(s)}")
+            _expect(s, self.n, nm)
         pk, sk = _out(self.pk_len), _out(self.sk_len)
         self.lib.qrp_slhdsa_keygen(
             self.param_id, _buf(sk_seed), _buf(sk_prf), _buf(pk_seed), pk, sk
@@ -240,10 +243,9 @@ class NativeSLHDSA:
         return bytes(pk), bytes(sk)
 
     def sign_internal(self, msg: bytes, sk: bytes, addrnd: bytes | None = None) -> bytes:
-        if len(sk) != self.sk_len:
-            raise ValueError(f"secret key must be {self.sk_len} bytes, got {len(sk)}")
-        if addrnd is not None and len(addrnd) != self.n:
-            raise ValueError(f"addrnd must be {self.n} bytes, got {len(addrnd)}")
+        _expect(sk, self.sk_len, "secret key")
+        if addrnd is not None:
+            _expect(addrnd, self.n, "addrnd")
         sig = _out(self.sig_len)
         self.lib.qrp_slhdsa_sign(
             self.param_id, _buf(sk), _buf(msg), len(msg),
@@ -257,6 +259,49 @@ class NativeSLHDSA:
         return bool(
             self.lib.qrp_slhdsa_verify(self.param_id, _buf(pk), _buf(msg), len(msg), _buf(sig))
         )
+
+
+class NativeFrodoKEM:
+    """Scalar FrodoKEM over the native core (same seams as pyref.frodo_ref:
+    keygen(s, seedSE, z), encaps(pk, mu), decaps(sk, ct))."""
+
+    _ID = {
+        "FrodoKEM-640-AES": 0, "FrodoKEM-640-SHAKE": 1,
+        "FrodoKEM-976-AES": 2, "FrodoKEM-976-SHAKE": 3,
+        "FrodoKEM-1344-AES": 4, "FrodoKEM-1344-SHAKE": 5,
+    }
+
+    def __init__(self, name: str):
+        from ..pyref import frodo_ref  # single authority for sizes
+
+        self.lib = load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable")
+        self.param_id = self._ID[name]
+        p = frodo_ref.PARAMS[name]
+        self.len_sec = p.len_sec
+        self.pk_len, self.sk_len, self.ct_len = p.pk_len, p.sk_len, p.ct_len
+
+    def keygen(self, s: bytes, seed_se: bytes, z: bytes) -> tuple[bytes, bytes]:
+        for nm, v in (("s", s), ("seedSE", seed_se), ("z", z)):
+            _expect(v, self.len_sec, nm)
+        pk, sk = _out(self.pk_len), _out(self.sk_len)
+        self.lib.qrp_frodo_keygen(self.param_id, _buf(s), _buf(seed_se), _buf(z), pk, sk)
+        return bytes(pk), bytes(sk)
+
+    def encaps(self, pk: bytes, mu: bytes) -> tuple[bytes, bytes]:
+        _expect(pk, self.pk_len, "public key")
+        _expect(mu, self.len_sec, "mu")
+        ct, ss = _out(self.ct_len), _out(self.len_sec)
+        self.lib.qrp_frodo_encaps(self.param_id, _buf(pk), _buf(mu), ct, ss)
+        return bytes(ct), bytes(ss)
+
+    def decaps(self, sk: bytes, ct: bytes) -> bytes:
+        _expect(sk, self.sk_len, "secret key")
+        _expect(ct, self.ct_len, "ciphertext")
+        ss = _out(self.len_sec)
+        self.lib.qrp_frodo_decaps(self.param_id, _buf(sk), _buf(ct), ss)
+        return bytes(ss)
 
 
 def shake256(data: bytes, out_len: int) -> bytes:

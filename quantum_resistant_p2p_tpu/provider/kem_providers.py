@@ -155,11 +155,6 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         self.use_aes = use_aes
         self.name = self.params.name
         self.display_name = f"{self.params.name} ({backend})"
-        self.description = (
-            f"Dense-LWE KEM (FrodoKEM round 3), NIST level {security_level}, "
-            f"{'AES' if use_aes else 'SHAKE'} matrix generation, "
-            f"{'batched JAX/TPU (MXU matmul)' if backend == 'tpu' else 'pure-Python CPU'} backend"
-        )
         self.public_key_len = self.params.pk_len
         self.secret_key_len = self.params.sk_len
         self.ciphertext_len = self.params.ct_len
@@ -169,6 +164,17 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
 
             self._kg, self._enc, self._dec = _jax_frodo.get(self.params.name)
             self._max_dispatch = _jax_frodo.MAX_DEVICE_BATCH
+        self._native = None
+        if backend == "cpu":
+            # Native C++ fast path (the role liboqs plays for the reference);
+            # pyref stays the fallback + oracle.
+            self._native = try_native("NativeFrodoKEM", self.params.name)
+        self.description = (
+            f"Dense-LWE KEM (FrodoKEM round 3), NIST level {security_level}, "
+            f"{'AES' if use_aes else 'SHAKE'} matrix generation, "
+            f"{'batched JAX/TPU (MXU matmul)' if backend == 'tpu' else cpu_impl_desc(self._native)}"
+            " backend"
+        )
 
     def _sliced(self, fn, *arrays):
         """Dispatch in MAX_DEVICE_BATCH slices — larger single Frodo batches
@@ -218,9 +224,12 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         seeds = np.frombuffer(os.urandom(3 * sec * n), np.uint8).reshape(3, n, sec)
         if self.backend == "tpu":
             return self._sliced(self._kg, seeds[0], seeds[1], seeds[2])
+        impl = self._native
         pairs = [
-            frodo_ref.keygen(p, seeds[0, i].tobytes(), seeds[1, i].tobytes(),
-                             seeds[2, i].tobytes())
+            (impl.keygen(seeds[0, i].tobytes(), seeds[1, i].tobytes(),
+                         seeds[2, i].tobytes()) if impl
+             else frodo_ref.keygen(p, seeds[0, i].tobytes(), seeds[1, i].tobytes(),
+                                   seeds[2, i].tobytes()))
             for i in range(n)
         ]
         return (
@@ -235,8 +244,10 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         mu = np.frombuffer(os.urandom(p.len_sec * n), np.uint8).reshape(n, p.len_sec)
         if self.backend == "tpu":
             return self._sliced(self._enc, np.asarray(public_keys), mu)
+        impl = self._native
         outs = [
-            frodo_ref.encaps(p, public_keys[i].tobytes(), mu[i].tobytes())
+            (impl.encaps(public_keys[i].tobytes(), mu[i].tobytes()) if impl
+             else frodo_ref.encaps(p, public_keys[i].tobytes(), mu[i].tobytes()))
             for i in range(n)
         ]
         return (
@@ -250,10 +261,15 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         p = self.params
         if self.backend == "tpu":
             return self._sliced(self._dec, np.asarray(secret_keys), np.asarray(ciphertexts))
+        impl = self._native
         return np.stack(
             [
                 np.frombuffer(
-                    frodo_ref.decaps(p, secret_keys[i].tobytes(), ciphertexts[i].tobytes()),
+                    (impl.decaps(secret_keys[i].tobytes(), ciphertexts[i].tobytes())
+                     if impl
+                     else frodo_ref.decaps(
+                         p, secret_keys[i].tobytes(), ciphertexts[i].tobytes()
+                     )),
                     np.uint8,
                 )
                 for i in range(secret_keys.shape[0])

@@ -150,6 +150,68 @@ def test_slhdsa_provider_native_cpu_interop():
     assert s128.verify(pk, b"small sig", sig)
 
 
+def test_aes128_matches_fips197_and_openssl():
+    import ctypes
+
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    lib = native.load()
+    out = (ctypes.c_uint8 * 16)()
+    # FIPS-197 Appendix C.1
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    lib.qrp_aes128_ecb(native._buf(key), native._buf(pt), 1, out)
+    assert bytes(out).hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+    for _ in range(20):
+        key = bytes(RNG.integers(0, 256, size=16, dtype=np.uint8))
+        pt = bytes(RNG.integers(0, 256, size=16, dtype=np.uint8))
+        ref = Cipher(algorithms.AES(key), modes.ECB()).encryptor().update(pt)
+        lib.qrp_aes128_ecb(native._buf(key), native._buf(pt), 1, out)
+        assert bytes(out) == ref
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "FrodoKEM-640-AES",
+        "FrodoKEM-640-SHAKE",
+        pytest.param("FrodoKEM-976-AES", marks=pytest.mark.slow),
+        pytest.param("FrodoKEM-976-SHAKE", marks=pytest.mark.slow),
+        pytest.param("FrodoKEM-1344-AES", marks=pytest.mark.slow),
+        pytest.param("FrodoKEM-1344-SHAKE", marks=pytest.mark.slow),
+    ],
+)
+def test_frodo_matches_pyref(name):
+    from quantum_resistant_p2p_tpu.pyref import frodo_ref
+
+    p = frodo_ref.PARAMS[name]
+    nf = native.NativeFrodoKEM(name)
+    s, se, z, mu = (
+        bytes(RNG.integers(0, 256, size=p.len_sec, dtype=np.uint8)) for _ in range(4)
+    )
+    pk, sk = nf.keygen(s, se, z)
+    rpk, rsk = frodo_ref.keygen(p, s, se, z)
+    assert pk == rpk and sk == rsk
+    ct, ss = nf.encaps(pk, mu)
+    rct, rss = frodo_ref.encaps(p, pk, mu)
+    assert ct == rct and ss == rss
+    assert nf.decaps(sk, ct) == ss
+    bad = bytearray(ct)
+    bad[5] ^= 1
+    assert nf.decaps(sk, bytes(bad)) == frodo_ref.decaps(p, sk, bytes(bad))
+
+
+def test_frodo_provider_native_cpu_interop():
+    from quantum_resistant_p2p_tpu.provider.kem_providers import FrodoKEMKeyExchange
+
+    alg = FrodoKEMKeyExchange(security_level=1, backend="cpu", use_aes=True)
+    assert alg._native is not None
+    pk, sk = alg.generate_keypair()
+    ct, ss = alg.encapsulate(pk)
+    assert alg.decapsulate(sk, ct) == ss
+    assert "native C++" in alg.description
+
+
 def test_zeroize():
     buf = bytearray(b"secret material")
     native.zeroize(buf)
