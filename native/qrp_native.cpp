@@ -1839,6 +1839,10 @@ struct Aes128 {
 namespace frodo {
 
 constexpr int NBAR = 8;
+// capacity bounds for the static thread_local buffers; a new parameter set
+// exceeding these must raise them (runtime-checked in the extern entries)
+constexpr int FRODO_MAX_N = 1344;
+constexpr int FRODO_MAX_CT = 21632;
 
 struct Params {
   const char* name;
@@ -1898,7 +1902,7 @@ struct RowGen {
       in[0] = (uint8_t)(i & 0xff);
       in[1] = (uint8_t)(i >> 8);
       std::memcpy(in + 2, seed_a, 16);
-      static thread_local uint8_t buf[2 * 1344];
+      static thread_local uint8_t buf[2 * FRODO_MAX_N];
       shake(168, in, 18, buf, (size_t)(2 * p.n));  // SHAKE-128 per spec GenA
       for (int j = 0; j < p.n; ++j)
         out[j] = (uint16_t)((buf[2 * j] | (buf[2 * j + 1] << 8)) & p.q_mask());
@@ -1978,14 +1982,15 @@ void fdecode(const Params& p, const uint16_t* m, uint8_t* out) {
 // sp/ep row-major 8 x n; out row-major 8 x n.
 void sa_plus_e(const Params& p, const RowGen& gen, const int16_t* sp,
                const int16_t* ep, uint16_t* out) {
-  static thread_local uint16_t arow[1344];
+  static thread_local uint16_t arow[FRODO_MAX_N];
   for (int i = 0; i < NBAR; ++i)
     for (int j = 0; j < p.n; ++j) out[i * p.n + j] = (uint16_t)ep[i * p.n + j];
   for (int k = 0; k < p.n; ++k) {
     gen.row(k, arow);
     for (int i = 0; i < NBAR; ++i) {
+      // no skip for s == 0: the noise coefficients are secret, and in the FO
+      // re-encryption path a data-dependent row skip is a timing signal
       int16_t s = sp[i * p.n + k];
-      if (!s) continue;
       uint16_t* o = out + i * p.n;
       for (int j = 0; j < p.n; ++j)
         o[j] = (uint16_t)(o[j] + s * (int16_t)arow[j]);  // mod 2^16, masked later
@@ -1997,7 +2002,7 @@ void sa_plus_e(const Params& p, const RowGen& gen, const int16_t* sp,
 // B = A @ S + E, streaming A rows; st row-major NBAR x n (S^T), e n x NBAR.
 void as_plus_e(const Params& p, const RowGen& gen, const int16_t* st,
                const int16_t* e, uint16_t* out) {
-  static thread_local uint16_t arow[1344];
+  static thread_local uint16_t arow[FRODO_MAX_N];
   for (int i = 0; i < p.n; ++i) {
     gen.row(i, arow);
     for (int j = 0; j < NBAR; ++j) {
@@ -2016,16 +2021,17 @@ void keygen(const Params& p, const uint8_t* s, const uint8_t* seed_se,
   aes::Aes128 cipher(seed_a);
   RowGen gen(p, p.aes ? &cipher : nullptr, seed_a);
 
-  static thread_local uint8_t r[4 * 1344 * NBAR];
+  static thread_local uint8_t r[4 * FRODO_MAX_N * NBAR];
   uint8_t pre[1 + 32];
   pre[0] = 0x5f;
   std::memcpy(pre + 1, seed_se, (size_t)p.len_sec);
   fshake(p, pre, (size_t)(1 + p.len_sec), r, (size_t)(4 * p.n * NBAR));
-  static thread_local int16_t st[NBAR * 1344], e[1344 * NBAR];
+  static thread_local int16_t st[NBAR * FRODO_MAX_N], e[FRODO_MAX_N * NBAR];
   sample_matrix(p, r, NBAR * p.n, st);
   sample_matrix(p, r + 2 * p.n * NBAR, p.n * NBAR, e);
+  mldsa::secure_wipe(pre, sizeof(pre));  // held seedSE
 
-  static thread_local uint16_t bmat[1344 * NBAR];
+  static thread_local uint16_t bmat[FRODO_MAX_N * NBAR];
   as_plus_e(p, gen, st, e, bmat);
   std::memcpy(pk, seed_a, 16);
   fpack(p, bmat, p.n * NBAR, pk + 16);
@@ -2051,21 +2057,22 @@ void encrypt(const Params& p, const uint8_t* pk, const uint8_t* mu,
   aes::Aes128 cipher(seed_a);
   RowGen gen(p, p.aes ? &cipher : nullptr, seed_a);
 
-  static thread_local uint8_t r[(2 * NBAR * 1344 + NBAR * NBAR) * 2];
+  static thread_local uint8_t r[(2 * NBAR * FRODO_MAX_N + NBAR * NBAR) * 2];
   uint8_t pre[1 + 32];
   pre[0] = 0x96;
   std::memcpy(pre + 1, seed_se, (size_t)p.len_sec);
   fshake(p, pre, (size_t)(1 + p.len_sec),
          r, (size_t)((2 * NBAR * p.n + NBAR * NBAR) * 2));
-  static thread_local int16_t sp[NBAR * 1344], ep[NBAR * 1344];
+  static thread_local int16_t sp[NBAR * FRODO_MAX_N], ep[NBAR * FRODO_MAX_N];
   int16_t epp[NBAR * NBAR];
   sample_matrix(p, r, NBAR * p.n, sp);
   sample_matrix(p, r + 2 * NBAR * p.n, NBAR * p.n, ep);
   sample_matrix(p, r + 4 * NBAR * p.n, NBAR * NBAR, epp);
+  mldsa::secure_wipe(pre, sizeof(pre));  // held seedSE'
 
   sa_plus_e(p, gen, sp, ep, bp);
   // V = S' @ B + E'' + Encode(mu)
-  static thread_local uint16_t bmat[1344 * NBAR];
+  static thread_local uint16_t bmat[FRODO_MAX_N * NBAR];
   funpack(p, pk + 16, p.n * NBAR, bmat);
   uint16_t enc_mu[NBAR * NBAR];
   fencode(p, mu, enc_mu);
@@ -2095,14 +2102,14 @@ void encaps(const Params& p, const uint8_t* pk, const uint8_t* mu, uint8_t* ct,
   const uint8_t* seed_se = se_k;
   const uint8_t* k = se_k + p.len_sec;
 
-  static thread_local uint16_t bp[NBAR * 1344];
+  static thread_local uint16_t bp[NBAR * FRODO_MAX_N];
   uint16_t c[NBAR * NBAR];
   encrypt(p, pk, mu, seed_se, bp, c);
   int c1 = NBAR * p.n * p.d / 8;
   fpack(p, bp, NBAR * p.n, ct);
   fpack(p, c, NBAR * NBAR, ct + c1);
   // ss = SHAKE(ct || k)
-  static thread_local uint8_t tail[21632 + 32];
+  static thread_local uint8_t tail[FRODO_MAX_CT + 32];
   std::memcpy(tail, ct, (size_t)p.ct_len());
   std::memcpy(tail + p.ct_len(), k, (size_t)p.len_sec);
   fshake(p, tail, (size_t)(p.ct_len() + p.len_sec), ss, (size_t)p.len_sec);
@@ -2118,13 +2125,13 @@ void decaps(const Params& p, const uint8_t* sk, const uint8_t* ct, uint8_t* ss) 
   const uint8_t* pkh = stb + 2 * NBAR * p.n;
 
   int c1 = NBAR * p.n * p.d / 8;
-  static thread_local uint16_t bp[NBAR * 1344];
+  static thread_local uint16_t bp[NBAR * FRODO_MAX_N];
   uint16_t c[NBAR * NBAR];
   funpack(p, ct, NBAR * p.n, bp);
   funpack(p, ct + c1, NBAR * NBAR, c);
 
   // M = C - B' S  (S^T stored signed little-endian)
-  static thread_local int16_t st[NBAR * 1344];
+  static thread_local int16_t st[NBAR * FRODO_MAX_N];
   for (int k = 0; k < NBAR * p.n; ++k)
     st[k] = (int16_t)(uint16_t)(stb[2 * k] | (stb[2 * k + 1] << 8));
   uint16_t m[NBAR * NBAR];
@@ -2144,7 +2151,7 @@ void decaps(const Params& p, const uint8_t* sk, const uint8_t* ct, uint8_t* ss) 
   std::memcpy(buf + p.len_sec, mu_p, (size_t)p.len_sec);
   fshake(p, buf, (size_t)(2 * p.len_sec), se_k, (size_t)(2 * p.len_sec));
 
-  static thread_local uint16_t bpp[NBAR * 1344];
+  static thread_local uint16_t bpp[NBAR * FRODO_MAX_N];
   uint16_t cp[NBAR * NBAR];
   encrypt(p, pk, mu_p, se_k, bpp, cp);
 
@@ -2157,7 +2164,7 @@ void decaps(const Params& p, const uint8_t* sk, const uint8_t* ct, uint8_t* ss) 
   for (int i = 0; i < p.len_sec; ++i)
     sel[i] = (uint8_t)((se_k[p.len_sec + i] & (uint8_t)~mask) | (s[i] & mask));
 
-  static thread_local uint8_t tail[21632 + 32];
+  static thread_local uint8_t tail[FRODO_MAX_CT + 32];
   std::memcpy(tail, ct, (size_t)p.ct_len());
   std::memcpy(tail + p.ct_len(), sel, (size_t)p.len_sec);
   fshake(p, tail, (size_t)(p.ct_len() + p.len_sec), ss, (size_t)p.len_sec);
@@ -2165,13 +2172,516 @@ void decaps(const Params& p, const uint8_t* sk, const uint8_t* ct, uint8_t* ss) 
   mldsa::secure_wipe(se_k, sizeof(se_k));
   mldsa::secure_wipe(sel, sizeof(sel));
   mldsa::secure_wipe(tail, (size_t)(p.ct_len() + p.len_sec));
-  // the decrypted message seed mu' and everything holding it are secret
+  // the decrypted message seed mu' and everything holding or derived from it
+  // is secret — including the thread_local re-encryption outputs, which
+  // would otherwise persist for the thread's lifetime
   mldsa::secure_wipe(mu_p, sizeof(mu_p));
   mldsa::secure_wipe(m, sizeof(m));
   mldsa::secure_wipe(buf, sizeof(buf));
+  mldsa::secure_wipe(bpp, sizeof(uint16_t) * (size_t)(NBAR * p.n));
+  mldsa::secure_wipe(cp, sizeof(cp));
 }
 
 }  // namespace frodo
+
+// ---------------------------------------------------------------- HQC
+
+namespace hqc {
+
+constexpr int RM_N = 128;
+
+struct Params {
+  const char* name;
+  int n, n1, k, delta, dup, w, wr;
+  int n2() const { return RM_N * dup; }
+  int n_bytes() const { return (n + 7) / 8; }
+  int n_words() const { return (n + 63) / 64; }
+  int n1n2_bits() const { return n1 * n2(); }
+  int n1n2_bytes() const { return n1 * n2() / 8; }
+  int pk_len() const { return 40 + n_bytes(); }
+  int sk_len() const { return 40 + k + pk_len(); }
+  int ct_len() const { return n_bytes() + n1n2_bytes() + 16; }
+};
+
+// capacity bounds for the static buffers (runtime-checked in the entries)
+constexpr int HQC_MAX_W = 901;   // words for the largest n (57637)
+constexpr int HQC_MAX_WT = 149;  // largest fixed weight (wr of HQC-256)
+
+// ids: 0=HQC-128 1=HQC-192 2=HQC-256
+const Params HPARAMS[3] = {
+    {"HQC-128", 17669, 46, 16, 15, 3, 66, 75},
+    {"HQC-192", 35851, 56, 24, 16, 5, 100, 114},
+    {"HQC-256", 57637, 90, 32, 29, 5, 131, 149},
+};
+
+// -- GF(2^8), modulus 0x11D --------------------------------------------------
+
+uint8_t GEXP[512];
+uint8_t GLOG[256];
+struct GfInit {
+  GfInit() {
+    int x = 1;
+    for (int i = 0; i < 255; ++i) {
+      GEXP[i] = (uint8_t)x;
+      GLOG[x] = (uint8_t)i;
+      x <<= 1;
+      if (x & 0x100) x ^= 0x11D;
+    }
+    for (int i = 255; i < 512; ++i) GEXP[i] = GEXP[i - 255];
+  }
+} gf_init;
+
+inline uint8_t gmul(uint8_t a, uint8_t b) {
+  if (!a || !b) return 0;
+  return GEXP[GLOG[a] + GLOG[b]];
+}
+inline uint8_t ginv(uint8_t a) { return GEXP[255 - GLOG[a]]; }
+
+// -- Reed-Solomon over GF(2^8) (mirrors pyref rs_encode/rs_decode) -----------
+
+void rs_gen_poly(const Params& p, uint8_t* g, int* glen) {
+  g[0] = 1;
+  int len = 1;
+  for (int i = 1; i <= 2 * p.delta; ++i) {
+    uint8_t root = GEXP[i];
+    uint8_t ng[128] = {0};
+    for (int j = 0; j < len; ++j) {
+      ng[j] ^= gmul(g[j], root);
+      ng[j + 1] ^= g[j];
+    }
+    ++len;
+    std::memcpy(g, ng, (size_t)len);
+  }
+  *glen = len;
+}
+
+void rs_encode(const Params& p, const uint8_t* msg, uint8_t* cw) {
+  uint8_t g[128];
+  int glen;
+  rs_gen_poly(p, g, &glen);
+  int red = 2 * p.delta;
+  uint8_t rem[128] = {0};
+  for (int bi = p.k - 1; bi >= 0; --bi) {
+    uint8_t coef = (uint8_t)(msg[bi] ^ rem[red - 1]);
+    std::memmove(rem + 1, rem, (size_t)(red - 1));
+    rem[0] = 0;
+    if (coef)
+      for (int j = 0; j < red; ++j) rem[j] ^= gmul(g[j], coef);
+  }
+  std::memcpy(cw, rem, (size_t)red);
+  std::memcpy(cw + red, msg, (size_t)p.k);
+}
+
+void rs_decode(const Params& p, const uint8_t* cw_in, uint8_t* msg) {
+  int red = 2 * p.delta;
+  uint8_t c[128];
+  std::memcpy(c, cw_in, (size_t)p.n1);
+  uint8_t synd[58];
+  bool any = false;
+  for (int i = 1; i <= red; ++i) {
+    uint8_t s = 0;
+    for (int j = 0; j < p.n1; ++j)
+      if (c[j]) s ^= GEXP[(GLOG[c[j]] + i * j) % 255];
+    synd[i - 1] = s;
+    any |= (s != 0);
+  }
+  if (!any) {
+    std::memcpy(msg, c + red, (size_t)p.k);
+    return;
+  }
+  // Berlekamp-Massey (mirrors the oracle's variable-length polynomials)
+  uint8_t sigma[128] = {1}, b[128] = {1}, t[128];
+  int slen = 1, blen = 1;
+  int L = 0, m = 1;
+  uint8_t bb = 1;
+  for (int n_it = 0; n_it < red; ++n_it) {
+    uint8_t d = synd[n_it];
+    for (int i = 1; i <= L; ++i)
+      if (i < slen && sigma[i] && synd[n_it - i]) d ^= gmul(sigma[i], synd[n_it - i]);
+    if (d == 0) {
+      ++m;
+    } else {
+      uint8_t coef = gmul(d, ginv(bb));
+      int shlen = m + blen;
+      int nlen = slen > shlen ? slen : shlen;
+      bool grow = 2 * L <= n_it;
+      int old_slen = slen;
+      if (grow) std::memcpy(t, sigma, (size_t)old_slen);
+      for (int i = 0; i < nlen; ++i) {
+        uint8_t sv = i < slen ? sigma[i] : 0;
+        uint8_t hv = (i >= m && i - m < blen) ? gmul(coef, b[i - m]) : 0;
+        sigma[i] = (uint8_t)(sv ^ hv);
+      }
+      slen = nlen;
+      if (grow) {
+        L = n_it + 1 - L;
+        std::memcpy(b, t, (size_t)old_slen);  // b <- pre-update sigma
+        blen = old_slen;
+        bb = d;
+        m = 1;
+      } else {
+        ++m;
+      }
+    }
+  }
+  // Chien search
+  int err_pos[128], nerr = 0;
+  for (int j = 0; j < p.n1; ++j) {
+    uint8_t val = 0;
+    for (int i = 0; i < slen; ++i)
+      if (sigma[i]) val ^= GEXP[(GLOG[sigma[i]] + i * ((255 - j) % 255)) % 255];
+    if (val == 0) err_pos[nerr++] = j;
+  }
+  // Forney
+  uint8_t omega[58] = {0};
+  for (int i = 0; i < slen; ++i)
+    for (int j = 0; j < red; ++j)
+      if (i + j < red && sigma[i] && synd[j]) omega[i + j] ^= gmul(sigma[i], synd[j]);
+  for (int e = 0; e < nerr; ++e) {
+    int j = err_pos[e];
+    uint8_t xinv = GEXP[(255 - j) % 255];
+    uint8_t num = 0, xp = 1;
+    for (int i = 0; i < red; ++i) {
+      if (omega[i]) num ^= gmul(omega[i], xp);
+      xp = gmul(xp, xinv);
+    }
+    uint8_t den = 0;
+    uint8_t x2 = gmul(xinv, xinv);
+    xp = 1;
+    for (int i = 1; i < slen; i += 2) {
+      if (sigma[i]) den ^= gmul(sigma[i], xp);
+      xp = gmul(xp, x2);
+    }
+    if (den == 0) continue;
+    c[j] ^= gmul(num, ginv(den));
+  }
+  std::memcpy(msg, c + red, (size_t)p.k);
+}
+
+// -- duplicated RM(1,7) ------------------------------------------------------
+
+uint64_t RM_TABLE[256][2];
+struct RmInit {
+  RmInit() {
+    for (int bnum = 0; bnum < 256; ++bnum) {
+      uint64_t lo = 0, hi = 0;
+      for (int j = 0; j < RM_N; ++j) {
+        int bit = bnum & 1;
+        for (int tt = 0; tt < 7; ++tt)
+          if (((bnum >> (tt + 1)) & 1) && ((j >> tt) & 1)) bit ^= 1;
+        if (bit) {
+          if (j < 64) lo |= 1ull << j;
+          else hi |= 1ull << (j - 64);
+        }
+      }
+      RM_TABLE[bnum][0] = lo;
+      RM_TABLE[bnum][1] = hi;
+    }
+  }
+} rm_init;
+
+// bits: n2-per-block view into the big vector (bit getter below)
+struct BitVec {
+  const uint64_t* w;
+  bool get(int i) const { return (w[i >> 6] >> (i & 63)) & 1; }
+};
+
+uint8_t rm_decode_block(const Params& p, const BitVec& v, int base) {
+  int16_t f[RM_N];
+  for (int j = 0; j < RM_N; ++j) {
+    int acc = 0;
+    for (int d = 0; d < p.dup; ++d)
+      acc += 1 - 2 * (int)v.get(base + d * RM_N + j);
+    f[j] = (int16_t)acc;
+  }
+  for (int h = 1; h < RM_N; h <<= 1)
+    for (int i = 0; i < RM_N; i += 2 * h)
+      for (int j = i; j < i + h; ++j) {
+        int16_t a = f[j], b2 = f[j + h];
+        f[j] = (int16_t)(a + b2);
+        f[j + h] = (int16_t)(a - b2);
+      }
+  int best = 0, bestv = f[0] < 0 ? -f[0] : f[0];
+  for (int i = 1; i < RM_N; ++i) {
+    int av = f[i] < 0 ? -f[i] : f[i];
+    if (av > bestv) { bestv = av; best = i; }  // first max, like the oracle
+  }
+  int b0 = f[best] < 0 ? 1 : 0;
+  return (uint8_t)((best << 1) | b0);
+}
+
+// -- bit-vector helpers (LE words; byte image == LE byte string) -------------
+
+inline void vec_xor_shift(uint64_t* acc, int acc_words, const uint64_t* a,
+                          int a_words, int pos) {
+  int ws = pos >> 6, bs = pos & 63;
+  if (bs == 0) {
+    for (int i = 0; i < a_words && ws + i < acc_words; ++i) acc[ws + i] ^= a[i];
+  } else {
+    for (int i = 0; i < a_words && ws + i < acc_words; ++i)
+      acc[ws + i] ^= a[i] << bs;
+    for (int i = 0; i < a_words && ws + i + 1 < acc_words; ++i)
+      acc[ws + i + 1] ^= a[i] >> (64 - bs);
+  }
+}
+
+// out = x rotated left by STATIC amount c in GF(2)[x]/(x^n - 1).
+// c is public (a fixed barrel-stage constant); all indexing is static.
+void rotl_fixed(const Params& p, const uint64_t* x, int c, uint64_t* out) {
+  int W = p.n_words();
+  std::memset(out, 0, sizeof(uint64_t) * (size_t)W);
+  vec_xor_shift(out, W, x, W, c);  // bits j >= c get x[j - c]
+  int s = p.n - c;                 // bits j < c get x[j + n - c]
+  int ws = s >> 6, bs = s & 63;
+  for (int i = 0; i + ws < W; ++i) {
+    uint64_t w = x[i + ws] >> bs;
+    if (bs && i + ws + 1 < W) w |= x[i + ws + 1] << (64 - bs);
+    out[i] ^= w;
+  }
+  int topbits = p.n & 63;
+  if (topbits) out[W - 1] &= (1ull << topbits) - 1;
+}
+
+// out = a * sparse(sup) in GF(2)[x]/(x^n - 1); out may not alias a.
+//
+// Constant-time: a << pos (mod x^n - 1) is a cyclic rotation by pos, and the
+// support positions are secret (y of the long-term key, r2/e/r1 of a
+// session), so each rotation runs as a barrel shifter — log2(n) stages of
+// STATIC-amount rotations composed with branchless mask selects.  Memory
+// access patterns and branch behavior are independent of the secrets;
+// secret bits appear only in data (the select masks).
+void cyclic_mul_sparse(const Params& p, const uint64_t* a, const uint32_t* sup,
+                       int wt, uint64_t* out) {
+  int W = p.n_words();
+  static thread_local uint64_t t1[HQC_MAX_W], t2[HQC_MAX_W];
+  std::memset(out, 0, sizeof(uint64_t) * (size_t)W);
+  for (int i = 0; i < wt; ++i) {
+    uint32_t pos = sup[i];
+    std::memcpy(t1, a, sizeof(uint64_t) * (size_t)W);
+    for (int k = 0; (1 << k) < p.n; ++k) {
+      rotl_fixed(p, t1, (1 << k) % p.n, t2);
+      uint64_t m = (uint64_t)0 - (uint64_t)((pos >> k) & 1);
+      for (int j = 0; j < W; ++j) t1[j] = (t2[j] & m) | (t1[j] & ~m);
+    }
+    for (int j = 0; j < W; ++j) out[j] ^= t1[j];
+  }
+  // t1/t2 hold the last secret rotation offset's image
+  mldsa::secure_wipe(t1, sizeof(uint64_t) * (size_t)W);
+  mldsa::secure_wipe(t2, sizeof(uint64_t) * (size_t)W);
+}
+
+// -- sampling (official seedexpander structure; pyref SeedExpander) ----------
+
+struct SeedExpander {
+  Sponge sp;
+  explicit SeedExpander(const uint8_t* seed, size_t len) : sp(136) {
+    sp.absorb(seed, len);
+    uint8_t dom = 0x02;
+    sp.absorb(&dom, 1);
+    sp.finish(0x1f);
+  }
+  void read(uint8_t* out, size_t n) { sp.squeeze(out, n); }
+};
+
+void sample_fixed_weight(const Params& p, SeedExpander& ctx, int wt, uint32_t* sup) {
+  uint8_t buf[4 * HQC_MAX_WT];
+  ctx.read(buf, (size_t)(4 * wt));
+  for (int i = 0; i < wt; ++i) {
+    uint32_t r = (uint32_t)buf[4 * i] | ((uint32_t)buf[4 * i + 1] << 8) |
+                 ((uint32_t)buf[4 * i + 2] << 16) | ((uint32_t)buf[4 * i + 3] << 24);
+    sup[i] = (uint32_t)i + (uint32_t)(((uint64_t)r * (uint64_t)(p.n - i)) >> 32);
+  }
+  for (int i = wt - 2; i >= 0; --i) {
+    bool dup = false;
+    for (int j = i + 1; j < wt; ++j) dup |= (sup[j] == sup[i]);
+    if (dup) sup[i] = (uint32_t)i;
+  }
+}
+
+void sample_random_vector(const Params& p, SeedExpander& ctx, uint64_t* out) {
+  int W = p.n_words();
+  std::memset(out, 0, sizeof(uint64_t) * (size_t)W);
+  ctx.read(reinterpret_cast<uint8_t*>(out), (size_t)p.n_bytes());
+  int topbits = p.n & 63;
+  if (topbits) out[W - 1] &= (1ull << topbits) - 1;
+}
+
+inline void support_to_vec(const Params& p, const uint32_t* sup, int wt, uint64_t* out) {
+  std::memset(out, 0, sizeof(uint64_t) * (size_t)p.n_words());
+  for (int i = 0; i < wt; ++i) out[sup[i] >> 6] |= 1ull << (sup[i] & 63);
+}
+
+void hash_ds(const uint8_t* data, size_t len, uint8_t dom, uint8_t* out64) {
+  Sponge sp(136);
+  sp.absorb(data, len);
+  sp.absorb(&dom, 1);
+  sp.finish(0x1f);
+  sp.squeeze(out64, 64);
+}
+
+// -- KEM ---------------------------------------------------------------------
+
+void code_encode(const Params& p, const uint8_t* msg, uint64_t* out) {
+  uint8_t rs[128];
+  rs_encode(p, msg, rs);
+  std::memset(out, 0, sizeof(uint64_t) * (size_t)p.n_words());
+  uint64_t cw[2];
+  for (int i = 0; i < p.n1; ++i) {
+    cw[0] = RM_TABLE[rs[i]][0];
+    cw[1] = RM_TABLE[rs[i]][1];
+    for (int d = 0; d < p.dup; ++d)
+      vec_xor_shift(out, p.n_words(), cw, 2, i * p.n2() + d * RM_N);
+  }
+}
+
+void code_decode(const Params& p, const uint64_t* v, uint8_t* msg) {
+  uint8_t rs[128];
+  BitVec bv{v};
+  for (int i = 0; i < p.n1; ++i) rs[i] = rm_decode_block(p, bv, i * p.n2());
+  rs_decode(p, rs, msg);
+}
+
+void keygen(const Params& p, const uint8_t* sk_seed, const uint8_t* sigma,
+            const uint8_t* pk_seed, uint8_t* pk, uint8_t* sk) {
+  SeedExpander sk_ctx(sk_seed, 40);
+  uint32_t ysup[HQC_MAX_WT], xsup[HQC_MAX_WT];
+  sample_fixed_weight(p, sk_ctx, p.w, ysup);   // y first (pyref order)
+  sample_fixed_weight(p, sk_ctx, p.w, xsup);
+  SeedExpander pk_ctx(pk_seed, 40);
+  static thread_local uint64_t h[HQC_MAX_W], s[HQC_MAX_W], x[HQC_MAX_W];
+  sample_random_vector(p, pk_ctx, h);
+  cyclic_mul_sparse(p, h, ysup, p.w, s);
+  support_to_vec(p, xsup, p.w, x);
+  for (int i = 0; i < p.n_words(); ++i) s[i] ^= x[i];
+  std::memcpy(pk, pk_seed, 40);
+  std::memcpy(pk + 40, reinterpret_cast<uint8_t*>(s), (size_t)p.n_bytes());
+  std::memcpy(sk, sk_seed, 40);
+  std::memcpy(sk + 40, sigma, (size_t)p.k);
+  std::memcpy(sk + 40 + p.k, pk, (size_t)p.pk_len());
+  mldsa::secure_wipe(ysup, sizeof(ysup));
+  mldsa::secure_wipe(xsup, sizeof(xsup));
+  mldsa::secure_wipe(x, sizeof(uint64_t) * (size_t)p.n_words());
+}
+
+// (u, v) = encrypt(pk, m, theta); u/v as n-bit vectors (v truncated later)
+void encrypt(const Params& p, const uint8_t* pk, const uint8_t* m,
+             const uint8_t* theta, uint64_t* u, uint64_t* v) {
+  int W = p.n_words();
+  SeedExpander pk_ctx(pk, 40);
+  static thread_local uint64_t h[HQC_MAX_W], sv[HQC_MAX_W], tmp[HQC_MAX_W], code[HQC_MAX_W];
+  sample_random_vector(p, pk_ctx, h);
+  std::memset(sv, 0, sizeof(uint64_t) * (size_t)W);
+  std::memcpy(reinterpret_cast<uint8_t*>(sv), pk + 40, (size_t)p.n_bytes());
+
+  SeedExpander ctx(theta, 64);
+  uint32_t r2[HQC_MAX_WT], e[HQC_MAX_WT], r1[HQC_MAX_WT];
+  sample_fixed_weight(p, ctx, p.wr, r2);  // pyref order: r2, e, r1
+  sample_fixed_weight(p, ctx, p.wr, e);
+  sample_fixed_weight(p, ctx, p.wr, r1);
+
+  cyclic_mul_sparse(p, h, r2, p.wr, u);
+  support_to_vec(p, r1, p.wr, tmp);
+  for (int i = 0; i < W; ++i) u[i] ^= tmp[i];
+
+  code_encode(p, m, code);
+  cyclic_mul_sparse(p, sv, r2, p.wr, v);
+  support_to_vec(p, e, p.wr, tmp);
+  for (int i = 0; i < W; ++i) v[i] ^= code[i] ^ tmp[i];
+  // truncate v to n1*n2 bits
+  int nb = p.n1n2_bits();
+  int ws = nb >> 6, bs = nb & 63;
+  if (bs) v[ws] &= (1ull << bs) - 1;
+  for (int i = ws + (bs ? 1 : 0); i < W; ++i) v[i] = 0;
+  mldsa::secure_wipe(r2, sizeof(r2));
+  mldsa::secure_wipe(e, sizeof(e));
+  mldsa::secure_wipe(r1, sizeof(r1));
+}
+
+void encaps(const Params& p, const uint8_t* pk, const uint8_t* m,
+            const uint8_t* salt, uint8_t* ct, uint8_t* ss) {
+  static thread_local uint8_t gin[32 + 32 + 16];
+  std::memcpy(gin, m, (size_t)p.k);
+  std::memcpy(gin + p.k, pk, 32);
+  std::memcpy(gin + p.k + 32, salt, 16);
+  uint8_t theta[64];
+  hash_ds(gin, (size_t)(p.k + 32 + 16), 0x03, theta);
+
+  static thread_local uint64_t u[HQC_MAX_W], v[HQC_MAX_W];
+  encrypt(p, pk, m, theta, u, v);
+  std::memcpy(ct, reinterpret_cast<uint8_t*>(u), (size_t)p.n_bytes());
+  std::memcpy(ct + p.n_bytes(), reinterpret_cast<uint8_t*>(v), (size_t)p.n1n2_bytes());
+  std::memcpy(ct + p.n_bytes() + p.n1n2_bytes(), salt, 16);
+
+  static thread_local uint8_t kin[32 + (HQC_MAX_W + 1) * 8 + HQC_MAX_W * 8];
+  std::memcpy(kin, m, (size_t)p.k);
+  std::memcpy(kin + p.k, ct, (size_t)(p.n_bytes() + p.n1n2_bytes()));
+  hash_ds(kin, (size_t)(p.k + p.n_bytes() + p.n1n2_bytes()), 0x04, ss);
+  mldsa::secure_wipe(theta, sizeof(theta));
+  mldsa::secure_wipe(gin, (size_t)(p.k + 48));
+  mldsa::secure_wipe(kin, (size_t)p.k);
+}
+
+void decaps(const Params& p, const uint8_t* sk, const uint8_t* ct, uint8_t* ss) {
+  const uint8_t* sk_seed = sk;
+  const uint8_t* sigma = sk + 40;
+  const uint8_t* pk = sk + 40 + p.k;
+  int W = p.n_words();
+
+  static thread_local uint64_t u[HQC_MAX_W], v[HQC_MAX_W], uy[HQC_MAX_W];
+  std::memset(u, 0, sizeof(uint64_t) * (size_t)W);
+  std::memset(v, 0, sizeof(uint64_t) * (size_t)W);
+  std::memcpy(reinterpret_cast<uint8_t*>(u), ct, (size_t)p.n_bytes());
+  std::memcpy(reinterpret_cast<uint8_t*>(v), ct + p.n_bytes(), (size_t)p.n1n2_bytes());
+  const uint8_t* salt = ct + p.n_bytes() + p.n1n2_bytes();
+
+  SeedExpander sk_ctx(sk_seed, 40);
+  uint32_t ysup[HQC_MAX_WT];
+  sample_fixed_weight(p, sk_ctx, p.w, ysup);  // first draw = y
+  cyclic_mul_sparse(p, u, ysup, p.w, uy);
+  // v ^ uy truncated to n1*n2 bits
+  int nb = p.n1n2_bits();
+  int ws = nb >> 6, bs = nb & 63;
+  if (bs) uy[ws] &= (1ull << bs) - 1;
+  for (int i = ws + (bs ? 1 : 0); i < W; ++i) uy[i] = 0;
+  static thread_local uint64_t vx[HQC_MAX_W];
+  for (int i = 0; i < W; ++i) vx[i] = v[i] ^ uy[i];
+  uint8_t m_p[32];
+  code_decode(p, vx, m_p);
+
+  static thread_local uint8_t gin[32 + 32 + 16];
+  std::memcpy(gin, m_p, (size_t)p.k);
+  std::memcpy(gin + p.k, pk, 32);
+  std::memcpy(gin + p.k + 32, salt, 16);
+  uint8_t theta[64];
+  hash_ds(gin, (size_t)(p.k + 32 + 16), 0x03, theta);
+
+  static thread_local uint64_t u2[HQC_MAX_W], v2[HQC_MAX_W];
+  encrypt(p, pk, m_p, theta, u2, v2);
+  uint64_t diff = 0;
+  for (int i = 0; i < W; ++i) diff |= (u[i] ^ u2[i]) | (v[i] ^ v2[i]);
+  // constant-time select: m' on match, sigma on mismatch
+  uint8_t mask = (uint8_t)(0 - (uint8_t)(diff != 0));  // data-dependent but
+  // the compare itself is over public ct vs recomputed ct'; branchless select:
+  uint8_t sel[32];
+  for (int i = 0; i < p.k; ++i)
+    sel[i] = (uint8_t)((m_p[i] & (uint8_t)~mask) | (sigma[i] & mask));
+
+  static thread_local uint8_t kin[32 + (HQC_MAX_W + 1) * 8 + HQC_MAX_W * 8];
+  std::memcpy(kin, sel, (size_t)p.k);
+  std::memcpy(kin + p.k, ct, (size_t)(p.n_bytes() + p.n1n2_bytes()));
+  hash_ds(kin, (size_t)(p.k + p.n_bytes() + p.n1n2_bytes()), 0x04, ss);
+  mldsa::secure_wipe(ysup, sizeof(ysup));
+  mldsa::secure_wipe(m_p, sizeof(m_p));
+  mldsa::secure_wipe(sel, sizeof(sel));
+  mldsa::secure_wipe(theta, sizeof(theta));
+  mldsa::secure_wipe(gin, (size_t)(p.k + 48));
+  mldsa::secure_wipe(kin, (size_t)p.k);
+  mldsa::secure_wipe(vx, sizeof(uint64_t) * (size_t)W);
+  mldsa::secure_wipe(u2, sizeof(uint64_t) * (size_t)W);  // re-encryption of m'
+  mldsa::secure_wipe(v2, sizeof(uint64_t) * (size_t)W);
+}
+
+}  // namespace hqc
 
 }  // namespace
 
@@ -2327,7 +2837,9 @@ void qrp_aes128_ecb(const uint8_t* key, const uint8_t* in, size_t nblocks,
 
 void qrp_frodo_keygen(int param_id, const uint8_t* s, const uint8_t* seed_se,
                       const uint8_t* z, uint8_t* pk, uint8_t* sk) {
-  frodo::keygen(frodo::FPARAMS[param_id], s, seed_se, z, pk, sk);
+  const frodo::Params& p = frodo::FPARAMS[param_id];
+  if (p.n > frodo::FRODO_MAX_N || p.ct_len() > frodo::FRODO_MAX_CT) return;
+  frodo::keygen(p, s, seed_se, z, pk, sk);
 }
 
 void qrp_frodo_encaps(int param_id, const uint8_t* pk, const uint8_t* mu,
@@ -2340,6 +2852,29 @@ void qrp_frodo_decaps(int param_id, const uint8_t* sk, const uint8_t* ct,
   frodo::decaps(frodo::FPARAMS[param_id], sk, ct, ss);
 }
 
-int qrp_version(void) { return 4; }
+// -------- HQC (round-4-shaped internal forms) -------------------------------
+//
+// param_id: 0=HQC-128 1=HQC-192 2=HQC-256.  Deterministic seams match
+// pyref/hqc_ref.py: keygen(sk_seed 40, sigma k, pk_seed 40),
+// encaps(pk, m k, salt 16), decaps(sk, ct).
+
+void qrp_hqc_keygen(int param_id, const uint8_t* sk_seed, const uint8_t* sigma,
+                    const uint8_t* pk_seed, uint8_t* pk, uint8_t* sk) {
+  const hqc::Params& p = hqc::HPARAMS[param_id];
+  if (p.n_words() > hqc::HQC_MAX_W || p.wr > hqc::HQC_MAX_WT) return;
+  hqc::keygen(p, sk_seed, sigma, pk_seed, pk, sk);
+}
+
+void qrp_hqc_encaps(int param_id, const uint8_t* pk, const uint8_t* m,
+                    const uint8_t* salt, uint8_t* ct, uint8_t* ss) {
+  hqc::encaps(hqc::HPARAMS[param_id], pk, m, salt, ct, ss);
+}
+
+void qrp_hqc_decaps(int param_id, const uint8_t* sk, const uint8_t* ct,
+                    uint8_t* ss) {
+  hqc::decaps(hqc::HPARAMS[param_id], sk, ct, ss);
+}
+
+int qrp_version(void) { return 5; }
 
 }  // extern "C"

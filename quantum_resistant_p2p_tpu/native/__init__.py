@@ -103,6 +103,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ("qrp_frodo_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p, u8p]),
         ("qrp_frodo_encaps", [ctypes.c_int, u8p, u8p, u8p, u8p]),
         ("qrp_frodo_decaps", [ctypes.c_int, u8p, u8p, u8p]),
+        ("qrp_hqc_keygen", [ctypes.c_int, u8p, u8p, u8p, u8p, u8p]),
+        ("qrp_hqc_encaps", [ctypes.c_int, u8p, u8p, u8p, u8p, u8p]),
+        ("qrp_hqc_decaps", [ctypes.c_int, u8p, u8p, u8p]),
     ):
         fn = getattr(lib, name)
         fn.argtypes = argtypes
@@ -301,6 +304,50 @@ class NativeFrodoKEM:
         _expect(ct, self.ct_len, "ciphertext")
         ss = _out(self.len_sec)
         self.lib.qrp_frodo_decaps(self.param_id, _buf(sk), _buf(ct), ss)
+        return bytes(ss)
+
+
+class NativeHQC:
+    """Scalar HQC over the native core (same seams as pyref.hqc_ref:
+    keygen(sk_seed, sigma, pk_seed), encaps(pk, m, salt), decaps(sk, ct))."""
+
+    _ID = {"HQC-128": 0, "HQC-192": 1, "HQC-256": 2}
+
+    def __init__(self, name: str):
+        from ..pyref import hqc_ref  # single authority for sizes
+
+        self.lib = load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable")
+        self.param_id = self._ID[name]
+        p = hqc_ref.PARAMS[name]
+        self.k = p.k
+        self.pk_len, self.sk_len = p.pk_len, p.sk_len
+        self.ct_len, self.ss_len = p.ct_len, p.ss_len
+
+    def keygen(self, sk_seed: bytes, sigma: bytes, pk_seed: bytes) -> tuple[bytes, bytes]:
+        _expect(sk_seed, 40, "sk_seed")
+        _expect(sigma, self.k, "sigma")
+        _expect(pk_seed, 40, "pk_seed")
+        pk, sk = _out(self.pk_len), _out(self.sk_len)
+        self.lib.qrp_hqc_keygen(
+            self.param_id, _buf(sk_seed), _buf(sigma), _buf(pk_seed), pk, sk
+        )
+        return bytes(pk), bytes(sk)
+
+    def encaps(self, pk: bytes, m: bytes, salt: bytes) -> tuple[bytes, bytes]:
+        _expect(pk, self.pk_len, "public key")
+        _expect(m, self.k, "m")
+        _expect(salt, 16, "salt")
+        ct, ss = _out(self.ct_len), _out(self.ss_len)
+        self.lib.qrp_hqc_encaps(self.param_id, _buf(pk), _buf(m), _buf(salt), ct, ss)
+        return bytes(ct), bytes(ss)
+
+    def decaps(self, sk: bytes, ct: bytes) -> bytes:
+        _expect(sk, self.sk_len, "secret key")
+        _expect(ct, self.ct_len, "ciphertext")
+        ss = _out(self.ss_len)
+        self.lib.qrp_hqc_decaps(self.param_id, _buf(sk), _buf(ct), ss)
         return bytes(ss)
 
 

@@ -295,11 +295,6 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         self.backend = backend
         self.name = self.params.name
         self.display_name = f"{self.params.name} ({backend})"
-        self.description = (
-            f"Quasi-cyclic code-based KEM (HQC round 4 shape), NIST level "
-            f"{security_level}, "
-            f"{'batched JAX/TPU' if backend == 'tpu' else 'pure-Python CPU'} backend"
-        )
         self.public_key_len = self.params.pk_len
         self.secret_key_len = self.params.sk_len
         self.ciphertext_len = self.params.ct_len
@@ -308,6 +303,16 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
             from ..kem import hqc as _jax_hqc  # deferred: pulls in jax
 
             self._kg, self._enc, self._dec = _jax_hqc.get(self.params.name)
+        self._native = None
+        if backend == "cpu":
+            # Native C++ fast path (the role liboqs plays for the reference);
+            # pyref stays the fallback + oracle.
+            self._native = try_native("NativeHQC", self.params.name)
+        self.description = (
+            f"Quasi-cyclic code-based KEM (HQC round 4 shape), NIST level "
+            f"{security_level}, "
+            f"{'batched JAX/TPU' if backend == 'tpu' else cpu_impl_desc(self._native)} backend"
+        )
 
     def generate_keypair(self) -> tuple[bytes, bytes]:
         pk, sk = self.generate_keypair_batch(1)
@@ -333,8 +338,12 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         if self.backend == "tpu":
             pk, sk = self._kg(sk_seed, sigma, pk_seed)
             return np.asarray(pk), np.asarray(sk)
+        impl = self._native
         pairs = [
-            hqc_ref.keygen(p, sk_seed[i].tobytes(), sigma[i].tobytes(), pk_seed[i].tobytes())
+            (impl.keygen(sk_seed[i].tobytes(), sigma[i].tobytes(), pk_seed[i].tobytes())
+             if impl
+             else hqc_ref.keygen(p, sk_seed[i].tobytes(), sigma[i].tobytes(),
+                                 pk_seed[i].tobytes()))
             for i in range(n)
         ]
         return (
@@ -351,8 +360,12 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         if self.backend == "tpu":
             ct, ss = self._enc(public_keys, m, salt)
             return np.asarray(ct), np.asarray(ss)
+        impl = self._native
         outs = [
-            hqc_ref.encaps(p, public_keys[i].tobytes(), m[i].tobytes(), salt[i].tobytes())
+            (impl.encaps(public_keys[i].tobytes(), m[i].tobytes(), salt[i].tobytes())
+             if impl
+             else hqc_ref.encaps(p, public_keys[i].tobytes(), m[i].tobytes(),
+                                 salt[i].tobytes()))
             for i in range(n)
         ]
         return (
@@ -366,10 +379,15 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         p = self.params
         if self.backend == "tpu":
             return np.asarray(self._dec(secret_keys, ciphertexts))
+        impl = self._native
         return np.stack(
             [
                 np.frombuffer(
-                    hqc_ref.decaps(p, secret_keys[i].tobytes(), ciphertexts[i].tobytes()),
+                    (impl.decaps(secret_keys[i].tobytes(), ciphertexts[i].tobytes())
+                     if impl
+                     else hqc_ref.decaps(
+                         p, secret_keys[i].tobytes(), ciphertexts[i].tobytes()
+                     )),
                     np.uint8,
                 )
                 for i in range(secret_keys.shape[0])
