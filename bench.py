@@ -32,16 +32,31 @@ def main() -> None:
 
     enable_compile_cache()
 
+    # The 4096 batch runs as MAX_DEVICE_BATCH-row back-to-back dispatches
+    # (same slice size the provider uses): the op is HBM-bound and
+    # per-dispatch throughput peaks near 512 rows (scaling curve in
+    # bench_report.md).  Raw-ops methodology: operands stay device-resident
+    # between dispatches; the provider's per-slice host work and the 0.4 MB/s
+    # tunnel are excluded here and measured by the swarm benchmark instead.
+    step = mlkem.MAX_DEVICE_BATCH
+    assert BATCH % step == 0, "ops_per_s below assumes reps * step == BATCH"
+    reps = BATCH // step
     rng = np.random.default_rng(0)
-    d = rng.integers(0, 256, size=(BATCH, 32), dtype=np.uint8)
-    z = rng.integers(0, 256, size=(BATCH, 32), dtype=np.uint8)
-    m = rng.integers(0, 256, size=(BATCH, 32), dtype=np.uint8)
+    d = rng.integers(0, 256, size=(step, 32), dtype=np.uint8)
+    z = rng.integers(0, 256, size=(step, 32), dtype=np.uint8)
+    m = rng.integers(0, 256, size=(step, 32), dtype=np.uint8)
 
     kg, enc, _ = mlkem.get("ML-KEM-768")
     ek, _ = kg(d, z)
     sync(ek)
 
-    secs = timeit(enc, ek, m)
+    def run():
+        out = None
+        for _ in range(reps):
+            out = enc(ek, m)
+        return out
+
+    secs = timeit(run)
     ops_per_s = BATCH / secs
     print(
         json.dumps(

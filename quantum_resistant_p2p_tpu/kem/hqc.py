@@ -46,6 +46,10 @@ from ..pyref.hqc_ref import (
     _rs_gen_poly,
 )
 
+#: Single-dispatch batch cap, matching the other KEMs' dispatch policy
+#: (provider/base.py sliced_dispatch; see kem/mlkem.py MAX_DEVICE_BATCH).
+MAX_DEVICE_BATCH = 512
+
 _EXP = np.asarray(_GF_EXP, dtype=np.int32)  # length 512
 _LOG = np.asarray(_GF_LOG, dtype=np.int32)
 

@@ -46,6 +46,13 @@ from ..pyref.mlkem_ref import (  # parameter sets + computed constant tables
 
 Q = 3329
 N = 256
+
+#: Throughput-optimal single-dispatch batch on this hardware: the op is
+#: HBM-bound and per-dispatch ops/s FALLS beyond this size (scaling curve in
+#: bench_report.md; bench.py measures ~170-174k encaps/s dispatching 4096 as
+#: 8x512 vs ~110k as one dispatch).  Providers slice larger batches
+#: (provider/base.py sliced_dispatch).
+MAX_DEVICE_BATCH = 512
 _N_INV = 3303  # 128^-1 mod q
 
 _ZETAS = np.asarray(ZETAS, dtype=np.int32)

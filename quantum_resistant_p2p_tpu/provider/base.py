@@ -45,6 +45,37 @@ def cpu_impl_desc(native_obj) -> str:
     return "native C++ CPU" if native_obj is not None else "pure-Python CPU"
 
 
+def sliced_dispatch(fn, step: int, *arrays):
+    """Run a jitted batch fn in ``step``-row slices and concatenate.
+
+    Two reasons to slice device batches: FrodoKEM dispatches >= 1024 crash
+    this environment's TPU worker (kem/frodo.py), and ML-KEM throughput peaks
+    well below the queue's max batch (working set vs HBM/caches — see
+    bench_report.md's scaling curve).  A non-divisible tail is padded to a
+    full slice (last row repeated) so every dispatch hits an already-compiled
+    shape, then trimmed.
+    """
+    n = arrays[0].shape[0]
+    if n <= step:
+        out = fn(*arrays)
+        return tuple(np.asarray(o) for o in out) if isinstance(out, tuple) else np.asarray(out)
+
+    def slice_of(a, i):
+        part = a[i : i + step]
+        if part.shape[0] < step:
+            pad = np.broadcast_to(part[-1:], (step - part.shape[0],) + part.shape[1:])
+            part = np.concatenate([np.asarray(part), pad], axis=0)
+        return part
+
+    parts = [fn(*(slice_of(a, i) for a in arrays)) for i in range(0, n, step)]
+    if isinstance(parts[0], tuple):
+        return tuple(
+            np.concatenate([np.asarray(p[j]) for p in parts])[:n]
+            for j in range(len(parts[0]))
+        )
+    return np.concatenate([np.asarray(p) for p in parts])[:n]
+
+
 class CryptoAlgorithm(abc.ABC):
     """Common metadata for all algorithms (reference: crypto/algorithm_base.py)."""
 

@@ -19,7 +19,8 @@ import os
 import numpy as np
 
 from ..pyref import frodo_ref, hqc_ref, mlkem_ref
-from .base import KeyExchangeAlgorithm, cpu_impl_desc, expect_cols, expect_len, try_native
+from .base import (KeyExchangeAlgorithm, cpu_impl_desc, expect_cols, expect_len,
+                   sliced_dispatch, try_native)
 
 _LEVEL_TO_MLKEM = {1: mlkem_ref.MLKEM512, 3: mlkem_ref.MLKEM768, 5: mlkem_ref.MLKEM1024}
 
@@ -51,6 +52,7 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
             from ..kem import mlkem as _jax_mlkem  # deferred: pulls in jax
 
             self._kg, self._enc, self._dec = _jax_mlkem.get(self.params.name)
+            self._max_dispatch = _jax_mlkem.MAX_DEVICE_BATCH
         self._native = None
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference);
@@ -86,8 +88,7 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         d = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
         z = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
         if self.backend == "tpu":
-            ek, dk = self._kg(d, z)
-            return np.asarray(ek), np.asarray(dk)
+            return sliced_dispatch(self._kg, self._max_dispatch, d, z)
         impl = self._native if self._native is not None else None
         pairs = [
             (impl.keygen(d[i].tobytes(), z[i].tobytes()) if impl
@@ -104,8 +105,9 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         n = public_keys.shape[0]
         m = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
         if self.backend == "tpu":
-            key, ct = self._enc(public_keys, m)
-            return np.asarray(ct), np.asarray(key)
+            key, ct = sliced_dispatch(self._enc, self._max_dispatch,
+                                      np.asarray(public_keys), m)
+            return ct, key
         impl = self._native
         outs = [
             (impl.encaps(public_keys[i].tobytes(), m[i].tobytes()) if impl
@@ -121,7 +123,8 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         expect_cols(secret_keys, self.secret_key_len, "secret keys", self.name)
         expect_cols(ciphertexts, self.ciphertext_len, "ciphertexts", self.name)
         if self.backend == "tpu":
-            return np.asarray(self._dec(secret_keys, ciphertexts))
+            return sliced_dispatch(self._dec, self._max_dispatch,
+                                   np.asarray(secret_keys), np.asarray(ciphertexts))
         impl = self._native
         return np.stack(
             [
@@ -176,32 +179,6 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
             " backend"
         )
 
-    def _sliced(self, fn, *arrays):
-        """Dispatch in MAX_DEVICE_BATCH slices — larger single Frodo batches
-        crash this environment's TPU worker (kem/frodo.py MAX_DEVICE_BATCH).
-        A non-divisible tail is padded up to a full slice (last row repeated)
-        so every dispatch hits an already-compiled shape, then trimmed."""
-        n = arrays[0].shape[0]
-        step = self._max_dispatch
-        if n <= step:
-            out = fn(*arrays)
-            return tuple(np.asarray(o) for o in out) if isinstance(out, tuple) else np.asarray(out)
-
-        def slice_of(a, i):
-            part = a[i : i + step]
-            if part.shape[0] < step:
-                pad = np.broadcast_to(part[-1:], (step - part.shape[0],) + part.shape[1:])
-                part = np.concatenate([np.asarray(part), pad], axis=0)
-            return part
-
-        parts = [fn(*(slice_of(a, i) for a in arrays)) for i in range(0, n, step)]
-        if isinstance(parts[0], tuple):
-            return tuple(
-                np.concatenate([np.asarray(p[j]) for p in parts])[:n]
-                for j in range(len(parts[0]))
-            )
-        return np.concatenate([np.asarray(p) for p in parts])[:n]
-
     def generate_keypair(self) -> tuple[bytes, bytes]:
         pk, sk = self.generate_keypair_batch(1)
         return bytes(pk[0]), bytes(sk[0])
@@ -223,7 +200,7 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         sec = p.len_sec
         seeds = np.frombuffer(os.urandom(3 * sec * n), np.uint8).reshape(3, n, sec)
         if self.backend == "tpu":
-            return self._sliced(self._kg, seeds[0], seeds[1], seeds[2])
+            return sliced_dispatch(self._kg, self._max_dispatch, seeds[0], seeds[1], seeds[2])
         impl = self._native
         pairs = [
             (impl.keygen(seeds[0, i].tobytes(), seeds[1, i].tobytes(),
@@ -243,7 +220,8 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         n = public_keys.shape[0]
         mu = np.frombuffer(os.urandom(p.len_sec * n), np.uint8).reshape(n, p.len_sec)
         if self.backend == "tpu":
-            return self._sliced(self._enc, np.asarray(public_keys), mu)
+            return sliced_dispatch(self._enc, self._max_dispatch,
+                                   np.asarray(public_keys), mu)
         impl = self._native
         outs = [
             (impl.encaps(public_keys[i].tobytes(), mu[i].tobytes()) if impl
@@ -260,7 +238,8 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         expect_cols(ciphertexts, self.ciphertext_len, "ciphertexts", self.name)
         p = self.params
         if self.backend == "tpu":
-            return self._sliced(self._dec, np.asarray(secret_keys), np.asarray(ciphertexts))
+            return sliced_dispatch(self._dec, self._max_dispatch,
+                                   np.asarray(secret_keys), np.asarray(ciphertexts))
         impl = self._native
         return np.stack(
             [
@@ -303,6 +282,7 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
             from ..kem import hqc as _jax_hqc  # deferred: pulls in jax
 
             self._kg, self._enc, self._dec = _jax_hqc.get(self.params.name)
+            self._max_dispatch = _jax_hqc.MAX_DEVICE_BATCH
         self._native = None
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference);
@@ -336,8 +316,7 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         sigma = np.frombuffer(os.urandom(p.k * n), np.uint8).reshape(n, p.k)
         pk_seed = np.frombuffer(os.urandom(40 * n), np.uint8).reshape(n, 40)
         if self.backend == "tpu":
-            pk, sk = self._kg(sk_seed, sigma, pk_seed)
-            return np.asarray(pk), np.asarray(sk)
+            return sliced_dispatch(self._kg, self._max_dispatch, sk_seed, sigma, pk_seed)
         impl = self._native
         pairs = [
             (impl.keygen(sk_seed[i].tobytes(), sigma[i].tobytes(), pk_seed[i].tobytes())
@@ -358,8 +337,8 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         m = np.frombuffer(os.urandom(p.k * n), np.uint8).reshape(n, p.k)
         salt = np.frombuffer(os.urandom(16 * n), np.uint8).reshape(n, 16)
         if self.backend == "tpu":
-            ct, ss = self._enc(public_keys, m, salt)
-            return np.asarray(ct), np.asarray(ss)
+            return sliced_dispatch(self._enc, self._max_dispatch,
+                                   np.asarray(public_keys), m, salt)
         impl = self._native
         outs = [
             (impl.encaps(public_keys[i].tobytes(), m[i].tobytes(), salt[i].tobytes())
@@ -378,7 +357,8 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         expect_cols(ciphertexts, self.ciphertext_len, "ciphertexts", self.name)
         p = self.params
         if self.backend == "tpu":
-            return np.asarray(self._dec(secret_keys, ciphertexts))
+            return sliced_dispatch(self._dec, self._max_dispatch,
+                                   np.asarray(secret_keys), np.asarray(ciphertexts))
         impl = self._native
         return np.stack(
             [
