@@ -33,11 +33,14 @@ def main() -> None:
     enable_compile_cache()
 
     # The 4096 batch runs as MAX_DEVICE_BATCH-row back-to-back dispatches
-    # (same slice size the provider uses): the op is HBM-bound and
-    # per-dispatch throughput peaks near 512 rows (scaling curve in
-    # bench_report.md).  Raw-ops methodology: operands stay device-resident
-    # between dispatches; the provider's per-slice host work and the 0.4 MB/s
-    # tunnel are excluded here and measured by the swarm benchmark instead.
+    # (same slice size the provider uses): per-dispatch throughput peaks at
+    # 1024 rows — one full grid step of the fused Pallas SampleNTT kernel
+    # (scaling curve in bench_report.md).  Raw-ops methodology: operands stay
+    # device-resident between dispatches; the provider's per-slice host work
+    # and the slow device tunnel (~0.4-2.2 MB/s across sessions, see
+    # audit_tunnel in bench_results/full_bench_r2.json) are excluded here
+    # and measured by the swarm
+    # benchmark instead.
     step = mlkem.MAX_DEVICE_BATCH
     assert BATCH % step == 0, "ops_per_s below assumes reps * step == BATCH"
     reps = BATCH // step
@@ -49,6 +52,14 @@ def main() -> None:
     kg, enc, _ = mlkem.get("ML-KEM-768")
     ek, _ = kg(d, z)
     sync(ek)
+    # Device-resident operands per the raw-ops methodology above (ek already
+    # lives on device as kg's output; without this, every dispatch re-sends
+    # m through this environment's ~MB/s tunnel and the number measures the
+    # tunnel, not the chip).
+    import jax
+
+    m = jax.device_put(m)
+    sync(m)
 
     def run():
         out = None

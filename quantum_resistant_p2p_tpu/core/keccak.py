@@ -163,6 +163,23 @@ def _bytes_to_words(block: jax.Array) -> tuple[jax.Array, jax.Array]:
     return hi, lo
 
 
+def pad_single_block(data: jax.Array, rate: int, ds_byte: int) -> jax.Array:
+    """Keccak-pad a sub-rate message to one ``rate``-byte block.
+
+    (..., L) uint8 with L < rate -> (..., rate) uint8: message, then the
+    domain-separation byte, zeros, and 0x80 in the final byte.  Single
+    source of truth for callers that feed one-block sponges directly to a
+    Pallas kernel (kem/mlkem.py's fused SampleNTT path) instead of going
+    through :func:`sponge`.
+    """
+    msg_len = data.shape[-1]
+    assert msg_len < rate, (msg_len, rate)
+    block = jnp.zeros(data.shape[:-1] + (rate,), jnp.uint8)
+    block = block.at[..., :msg_len].set(jnp.asarray(data, jnp.uint8))
+    block = block.at[..., msg_len].set(jnp.uint8(ds_byte))
+    return block.at[..., rate - 1].set(block[..., rate - 1] | jnp.uint8(0x80))
+
+
 def _words_to_bytes(hi: jax.Array, lo: jax.Array) -> jax.Array:
     """((..., n), (..., n)) uint32 -> (..., 8*n) uint8."""
     parts = [

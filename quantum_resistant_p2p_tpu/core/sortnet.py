@@ -38,6 +38,38 @@ def bitonic_sort(x: jax.Array) -> jax.Array:
     return x
 
 
+def bitonic_sort_regs(regs: list) -> list:
+    """Bitonic-sort a Python list of same-shaped arrays, elementwise-ascending.
+
+    The network from :func:`bitonic_sort` with the sorted axis unrolled into
+    the *list* dimension: element ``i`` of the result holds, lane-for-lane,
+    the i-th smallest value across the input list.  Every compare-exchange is
+    a static ``minimum``/``maximum`` pair between two named arrays — no
+    reshapes, rolls or gathers — which makes the helper usable inside Pallas
+    TPU kernels where each list element is one resident vector tile
+    (kem/mlkem_pallas.py keeps all 512 SampleNTT candidates in VMEM this way).
+    ``len(regs)`` must be a power of two.
+    """
+    n = len(regs)
+    stages = int(np.log2(n))
+    assert 1 << stages == n, f"bitonic length must be a power of 2, got {n}"
+    regs = list(regs)
+    for k in range(1, stages + 1):
+        for j in range(k - 1, -1, -1):
+            d = 1 << j
+            for i in range(n):
+                p = i | d
+                if p == i:
+                    continue
+                lo = jnp.minimum(regs[i], regs[p])
+                hi = jnp.maximum(regs[i], regs[p])
+                if (i >> k) & 1:
+                    regs[i], regs[p] = hi, lo
+                else:
+                    regs[i], regs[p] = lo, hi
+    return regs
+
+
 def bitonic_sort_pairs(key: jax.Array, val: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Sort ``key`` ascending along the last axis, carrying ``val`` along.
 

@@ -47,12 +47,14 @@ from ..pyref.mlkem_ref import (  # parameter sets + computed constant tables
 Q = 3329
 N = 256
 
-#: Throughput-optimal single-dispatch batch on this hardware: the op is
-#: HBM-bound and per-dispatch ops/s FALLS beyond this size (scaling curve in
-#: bench_report.md; bench.py measures ~170-174k encaps/s dispatching 4096 as
-#: 8x512 vs ~110k as one dispatch).  Providers slice larger batches
-#: (provider/base.py sliced_dispatch).
-MAX_DEVICE_BATCH = 512
+#: Throughput-optimal single-dispatch batch on this hardware (scaling curve
+#: in bench_report.md): per-dispatch ops/s peaks at 1024 rows — the fused
+#: Pallas SampleNTT kernel (kem/mlkem_pallas.py) processes exactly 1024
+#: sponges per grid step, so smaller dispatches pad and waste tile lanes,
+#: and larger single dispatches lose cache locality in the remaining jnp
+#: pipeline (~771k encaps/s at 1024 vs ~555k at one 4096 dispatch).
+#: Providers slice larger batches (provider/base.py sliced_dispatch).
+MAX_DEVICE_BATCH = 1024
 _N_INV = 3303  # 128^-1 mod q
 
 _ZETAS = np.asarray(ZETAS, dtype=np.int32)
@@ -151,7 +153,22 @@ def sample_ntt(seeds: jax.Array) -> jax.Array:
     bitonic network over packed int32 keys (reject | index | value) — XLA's
     argsort/take_along_axis serialise on TPU and measured 200+ ms per batch,
     the entire encaps budget (core/sortnet.py).
+
+    On TPU the whole pipeline (SHAKE squeeze -> extraction -> compaction)
+    runs as one fused Pallas kernel with every intermediate in VMEM
+    (kem/mlkem_pallas.py) — it is ~85% of encaps' HBM traffic otherwise.
     """
+    if keccak._use_pallas():
+        from . import mlkem_pallas  # deferred: pallas import
+
+        batch = seeds.shape[:-1]
+        b = int(np.prod(batch)) if batch else 1
+        flat = jnp.asarray(seeds, jnp.uint8).reshape(b, 34)
+        block = keccak.pad_single_block(flat, 168, 0x1F)
+        ph, plo = keccak._bytes_to_words(block)
+        out = mlkem_pallas.sample_ntt_words(ph.T, plo.T)
+        return out.T.reshape(batch + (N,))
+
     buf = keccak.shake128(seeds, _SAMPLE_NTT_BYTES).astype(jnp.int32)
     t = buf.reshape(buf.shape[:-1] + (-1, 3))
     d1 = t[..., 0] + 256 * (t[..., 1] % 16)

@@ -16,7 +16,8 @@ TPU-native design
   over the SHAKE buffer bytes, maintaining (c, i, sign-bit index) state — same
   fixed-buffer convention as the pyref oracle.
 * ExpandA / ExpandS rejection sampling uses the same fixed-squeeze +
-  stable-argsort compaction trick as kem.mlkem.sample_ntt.
+  gather-free bitonic compaction as kem.mlkem.sample_ntt (XLA argsort /
+  take_along_axis serialise per-lane on TPU; see core/sortnet.py).
 * Variable-length messages are hashed to ``mu = SHAKE256(tr||M', 64)``
   host-side (cheap, public data); the device kernels take fixed-shape mu
   batches.  Key-dependent NTTs (A_hat, s1_hat, s2_hat, t0_hat) are hoisted out
@@ -37,6 +38,7 @@ import numpy as np
 from jax import lax
 
 from ..core import keccak
+from ..core.sortnet import bitonic_sort, bitonic_sort_pairs
 from ..pyref.mldsa_ref import (
     D,
     MLDSA44,
@@ -187,27 +189,48 @@ _REJ_BOUNDED_BYTES = 136 * 4  # 1088 nibbles for 256 slots
 
 
 def rej_ntt_poly(seeds: jax.Array) -> jax.Array:
-    """(..., 34) uint8 -> (..., 256) int32 NTT-domain uniform polys."""
+    """(..., 34) uint8 -> (..., 256) int32 NTT-domain uniform polys.
+
+    Compaction is the gather-free bitonic network (core/sortnet.py) — XLA's
+    stable argsort + take_along_axis serialise per-lane on TPU (the same
+    hazard kem/mlkem.py:sample_ntt documents).  23-bit candidates don't fit
+    an int32 key next to the index, so the pairs variant carries them.
+    """
     buf = keccak.shake128(seeds, _REJ_NTT_BYTES).astype(jnp.int32)
     t = buf.reshape(buf.shape[:-1] + (-1, 3))
     cand = t[..., 0] | (t[..., 1] << 8) | ((t[..., 2] & 0x7F) << 16)
-    reject = (cand >= Q).astype(jnp.int8)
-    order = jnp.argsort(reject, axis=-1, stable=True)
-    return jnp.take_along_axis(cand, order, axis=-1)[..., :N]
+    nc = cand.shape[-1]
+    idx = jnp.arange(nc, dtype=jnp.int32)
+    key = jnp.where(cand < Q, 0, 1 << 10) | idx  # accepted first, spec order
+    np2 = 1 << (nc - 1).bit_length()
+    pad = [(0, 0)] * (key.ndim - 1) + [(0, np2 - nc)]
+    key = jnp.pad(key, pad, constant_values=1 << 11)
+    cand = jnp.pad(cand, pad)
+    _, cand = bitonic_sort_pairs(key, cand)
+    return cand[..., :N]
 
 
 def rej_bounded_poly(eta: int, seeds: jax.Array) -> jax.Array:
-    """(..., 66) uint8 -> (..., 256) int32 coefficients in {q-eta..q+eta mod q}."""
+    """(..., 66) uint8 -> (..., 256) int32 coefficients in {q-eta..q+eta mod q}.
+
+    The raw nibble rides in the low bits of the (unique) sort key, so one
+    int32 bitonic network replaces the serialised argsort; the eta-map is
+    applied after compaction.
+    """
     buf = keccak.shake256(seeds, _REJ_BOUNDED_BYTES).astype(jnp.int32)
     z = jnp.stack([buf & 0xF, buf >> 4], axis=-1).reshape(buf.shape[:-1] + (-1,))
+    ok = z < (15 if eta == 2 else 9)
+    nc = z.shape[-1]
+    idx = jnp.arange(nc, dtype=jnp.int32)
+    key = jnp.where(ok, 0, 1 << 16) | (idx << 4) | z
+    np2 = 1 << (nc - 1).bit_length()
+    key = jnp.pad(
+        key, [(0, 0)] * (key.ndim - 1) + [(0, np2 - nc)], constant_values=1 << 17
+    )
+    z = bitonic_sort(key)[..., :N] & 0xF
     if eta == 2:
-        ok = z < 15
-        val = (2 - z % 5) % Q
-    else:
-        ok = z < 9
-        val = (4 - z) % Q
-    order = jnp.argsort(jnp.logical_not(ok).astype(jnp.int8), axis=-1, stable=True)
-    return jnp.take_along_axis(val, order, axis=-1)[..., :N]
+        return (2 - z % 5) % Q
+    return (4 - z) % Q
 
 
 def expand_a(p: MLDSAParams, rho: jax.Array) -> jax.Array:

@@ -22,7 +22,8 @@ Input residency: large operands (public keys, secret keys, ciphertexts) are
 ``jax.device_put`` BEFORE timing, so configs 2-4 measure device compute
 throughput — the same methodology as liboqs's in-memory speed tests, and
 what "ops/sec/chip" means.  This environment reaches its one chip through a
-~0.4 MB/s tunnel (measured, audit_tunnel), so leaving multi-MB operands on
+MB/s-scale tunnel (measured 0.4-2.2 MB/s across sessions, audit_tunnel),
+so leaving multi-MB operands on
 the host would time the tunnel, not the chip (measured: encaps drops
 110k -> 6.4k/s, and decaps lands at exactly half encaps because dk is twice
 the bytes).  The tunnel
@@ -159,7 +160,7 @@ def bench_config2(out: dict, path: Path) -> None:
     # batch-scaling curve for the headline op
     kg, enc, _ = mlkem.get("ML-KEM-768")
     curve = {}
-    for b in (256, 1024, 4096, 8192, 16384):
+    for b in (256, 512, 1024, 2048, 4096, 8192, 16384):
         d, z, m = _u8((b, 32)), _u8((b, 32)), _u8((b, 32))
         ek, _dk = kg(d, z)
         sync(ek)
