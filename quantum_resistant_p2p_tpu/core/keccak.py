@@ -180,6 +180,20 @@ def pad_single_block(data: jax.Array, rate: int, ds_byte: int) -> jax.Array:
     return block.at[..., rate - 1].set(block[..., rate - 1] | jnp.uint8(0x80))
 
 
+def seed_block_words(seeds: jax.Array, rate: int, ds_byte: int):
+    """Flatten, pad, and word-transpose XOF seeds for a fused sampler kernel.
+
+    (..., L) uint8 seeds -> ((rate//8, B), (rate//8, B)) uint32 hi/lo lane
+    words with the batch flattened onto the minor axis, plus the original
+    batch shape — the input convention of keccak_pallas.sampler_call.
+    """
+    batch = seeds.shape[:-1]
+    b = int(np.prod(batch)) if batch else 1
+    flat = jnp.asarray(seeds, jnp.uint8).reshape(b, seeds.shape[-1])
+    ph, plo = _bytes_to_words(pad_single_block(flat, rate, ds_byte))
+    return ph.T, plo.T, batch
+
+
 def _words_to_bytes(hi: jax.Array, lo: jax.Array) -> jax.Array:
     """((..., n), (..., n)) uint32 -> (..., 8*n) uint8."""
     parts = [

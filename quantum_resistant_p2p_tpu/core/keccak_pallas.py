@@ -86,6 +86,58 @@ def _f1600(sh: list, sl: list) -> tuple[list, list]:
     return sh, sl
 
 
+def block_bytes(sh: list, sl: list, rate_words: int) -> list:
+    """Extract the ``8 * rate_words`` rate bytes of a sponge block.
+
+    Input: 25-element hi/lo lane-word tile lists; output: uint32 tiles with
+    one byte each (little-endian within each 64-bit lane, matching
+    ``core.keccak._words_to_bytes``).  Shared by the fused sampler kernels
+    (kem/mlkem_pallas.py, sig/mldsa_pallas.py).
+    """
+    byts = []
+    for w in range(rate_words):
+        for b in range(8):
+            word = sl[w] if b < 4 else sh[w]
+            byts.append((word >> (8 * (b % 4))) & 0xFF)
+    return byts
+
+
+def sampler_call(kernel, rate_words: int, n_out: int, in_hi: jax.Array,
+                 in_lo: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """Shared launcher for fused sampler kernels: words in, int32 regs out.
+
+    Args:
+      kernel: pallas kernel (in_hi_ref, in_lo_ref, out_ref) over
+        (rate_words|n_out, 8, 128) uint32/int32 blocks.
+      in_hi/in_lo: (rate_words, B) uint32 padded seed-block lane words,
+        batch minor (B need not be a multiple of the 1024-sponge tile).
+
+    Returns:
+      (n_out, B) int32.
+    """
+    in_words, b = in_hi.shape
+    assert in_words == rate_words
+    bp = -(-b // BT) * BT
+    if bp != b:
+        pad = ((0, 0), (0, bp - b))
+        in_hi = jnp.pad(in_hi, pad)
+        in_lo = jnp.pad(in_lo, pad)
+    in_hi = in_hi.reshape(in_words, bp // _TL, _TL)
+    in_lo = in_lo.reshape(in_words, bp // _TL, _TL)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bp // BT,),
+        in_specs=[
+            pl.BlockSpec((in_words, _TS, _TL), lambda i: (0, i, 0)),
+            pl.BlockSpec((in_words, _TS, _TL), lambda i: (0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_out, _TS, _TL), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_out, bp // _TL, _TL), jnp.int32),
+        interpret=interpret,
+    )(in_hi, in_lo)
+    return out.reshape(n_out, bp)[:, :b]
+
+
 def _sponge_kernel(in_hi_ref, in_lo_ref, out_hi_ref, out_lo_ref,
                    *, rate_words: int, n_abs: int, n_sq: int):
     zero = jnp.zeros((_TS, _TL), jnp.uint32)

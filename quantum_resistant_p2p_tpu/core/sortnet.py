@@ -70,6 +70,36 @@ def bitonic_sort_regs(regs: list) -> list:
     return regs
 
 
+def bitonic_sort_pairs_regs(keys: list, vals: list) -> tuple[list, list]:
+    """Register-list variant of :func:`bitonic_sort_pairs`.
+
+    Sorts ``keys`` elementwise-ascending across the list dimension, carrying
+    ``vals`` through the same exchanges — the pairs analog of
+    :func:`bitonic_sort_regs`, for Pallas kernels whose candidate values
+    don't fit in an int32 key beside the index (sig/mldsa_pallas.py's 23-bit
+    RejNTT candidates).  Keys must be elementwise-unique across the list.
+    """
+    n = len(keys)
+    stages = int(np.log2(n))
+    assert 1 << stages == n, f"bitonic length must be a power of 2, got {n}"
+    keys, vals = list(keys), list(vals)
+    for k in range(1, stages + 1):
+        for j in range(k - 1, -1, -1):
+            d = 1 << j
+            for i in range(n):
+                p = i | d
+                if p == i:
+                    continue
+                swap = keys[i] > keys[p] if not ((i >> k) & 1) else keys[i] < keys[p]
+                ki = jnp.where(swap, keys[p], keys[i])
+                kp = jnp.where(swap, keys[i], keys[p])
+                vi = jnp.where(swap, vals[p], vals[i])
+                vp = jnp.where(swap, vals[i], vals[p])
+                keys[i], keys[p] = ki, kp
+                vals[i], vals[p] = vi, vp
+    return keys, vals
+
+
 def bitonic_sort_pairs(key: jax.Array, val: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Sort ``key`` ascending along the last axis, carrying ``val`` along.
 

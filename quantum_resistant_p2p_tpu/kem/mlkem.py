@@ -161,13 +161,8 @@ def sample_ntt(seeds: jax.Array) -> jax.Array:
     if keccak._use_pallas():
         from . import mlkem_pallas  # deferred: pallas import
 
-        batch = seeds.shape[:-1]
-        b = int(np.prod(batch)) if batch else 1
-        flat = jnp.asarray(seeds, jnp.uint8).reshape(b, 34)
-        block = keccak.pad_single_block(flat, 168, 0x1F)
-        ph, plo = keccak._bytes_to_words(block)
-        out = mlkem_pallas.sample_ntt_words(ph.T, plo.T)
-        return out.T.reshape(batch + (N,))
+        ph, plo, batch = keccak.seed_block_words(seeds, 168, 0x1F)
+        return mlkem_pallas.sample_ntt_words(ph, plo).T.reshape(batch + (N,))
 
     buf = keccak.shake128(seeds, _SAMPLE_NTT_BYTES).astype(jnp.int32)
     t = buf.reshape(buf.shape[:-1] + (-1, 3))

@@ -34,9 +34,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
-from ..core.keccak_pallas import _TS, _TL, BT, _f1600
+from ..core.keccak_pallas import _f1600, block_bytes, sampler_call
 from ..core.sortnet import bitonic_sort_regs
 
 Q = 3329
@@ -45,16 +44,6 @@ N_SQUEEZE = 4  # 4 * 168 = 672 bytes -> 448 candidates for 256 slots
 N_CAND = 448
 N_SORT = 512  # candidates padded to the next power of two
 N_OUT = 256
-
-
-def _block_bytes(sh: list, sl: list) -> list:
-    """Extract the 168 rate bytes of a squeeze block as uint32 tiles."""
-    byts = []
-    for w in range(RATE_WORDS):
-        for b in range(8):
-            word = sl[w] if b < 4 else sh[w]
-            byts.append((word >> (8 * (b % 4))) & 0xFF)
-    return byts
 
 
 def _sample_ntt_tiles(in_hi: list, in_lo: list) -> list:
@@ -78,7 +67,7 @@ def _sample_ntt_tiles(in_hi: list, in_lo: list) -> list:
     # candidates d1 = b0 + 256*(b1 mod 16), d2 = (b1 // 16) + 16*b2.
     cand = []
     for blk in range(N_SQUEEZE):
-        byts = _block_bytes(sh, sl)
+        byts = block_bytes(sh, sl, RATE_WORDS)
         for t in range(len(byts) // 3):
             b0, b1, b2 = byts[3 * t], byts[3 * t + 1], byts[3 * t + 2]
             cand.append(b0 | ((b1 & 0xF) << 8))
@@ -121,24 +110,5 @@ def sample_ntt_words(in_hi: jax.Array, in_lo: jax.Array, *, interpret: bool = Fa
     Returns:
       (256, B) int32 NTT-domain polynomial coefficients in [0, q).
     """
-    in_words, b = in_hi.shape
-    assert in_words == RATE_WORDS
-    bp = -(-b // BT) * BT
-    if bp != b:
-        pad = ((0, 0), (0, bp - b))
-        in_hi = jnp.pad(in_hi, pad)
-        in_lo = jnp.pad(in_lo, pad)
-    in_hi = in_hi.reshape(in_words, bp // _TL, _TL)
-    in_lo = in_lo.reshape(in_words, bp // _TL, _TL)
-    out = pl.pallas_call(
-        _sample_ntt_kernel,
-        grid=(bp // BT,),
-        in_specs=[
-            pl.BlockSpec((in_words, _TS, _TL), lambda i: (0, i, 0)),
-            pl.BlockSpec((in_words, _TS, _TL), lambda i: (0, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((N_OUT, _TS, _TL), lambda i: (0, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((N_OUT, bp // _TL, _TL), jnp.int32),
-        interpret=interpret,
-    )(in_hi, in_lo)
-    return out.reshape(N_OUT, bp)[:, :b]
+    return sampler_call(_sample_ntt_kernel, RATE_WORDS, N_OUT, in_hi, in_lo,
+                        interpret=interpret)

@@ -27,6 +27,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..utils.profiling import LatencyHistogram
 from .base import KeyExchangeAlgorithm, SignatureAlgorithm
 
 
@@ -41,16 +42,12 @@ class QueueStats:
     total_dispatch_s: float = 0.0
     #: per-flush batch sizes, most recent last (bounded)
     batch_sizes: list[int] = field(default_factory=list)
-    #: per-flush dispatch seconds, most recent last (bounded)
-    dispatch_times: list[float] = field(default_factory=list)
+    #: per-flush dispatch latency percentiles (utils.profiling)
+    dispatch_hist: LatencyHistogram = field(default_factory=LatencyHistogram)
     BATCH_SIZE_HISTORY = 1024
 
-    @staticmethod
-    def _pct(xs: list[float], q: float) -> float:
-        s = sorted(xs)
-        return s[min(len(s) - 1, int(q * len(s)))] if s else 0.0
-
     def as_dict(self) -> dict[str, Any]:
+        h = self.dispatch_hist
         return {
             "ops": self.ops,
             "flushes": self.flushes,
@@ -59,8 +56,8 @@ class QueueStats:
             "avg_dispatch_ms": (
                 1e3 * self.total_dispatch_s / self.flushes if self.flushes else 0.0
             ),
-            "p50_dispatch_ms": round(1e3 * self._pct(self.dispatch_times, 0.5), 3),
-            "p99_dispatch_ms": round(1e3 * self._pct(self.dispatch_times, 0.99), 3),
+            "p50_dispatch_ms": round(1e3 * (h.percentile(50) or 0.0), 3),
+            "p99_dispatch_ms": round(1e3 * (h.percentile(99) or 0.0), 3),
         }
 
 
@@ -126,8 +123,7 @@ class OpQueue:
             results = await loop.run_in_executor(None, self.batch_fn, items)
             dt = time.perf_counter() - t0
             self.stats.total_dispatch_s += dt
-            self.stats.dispatch_times.append(dt)
-            del self.stats.dispatch_times[: -QueueStats.BATCH_SIZE_HISTORY]
+            self.stats.dispatch_hist.record(dt)
             for f, r in zip(futs, results):
                 if f.cancelled():
                     continue

@@ -186,6 +186,7 @@ def use_hint(p: MLDSAParams, h: jax.Array, r: jax.Array) -> jax.Array:
 
 _REJ_NTT_BYTES = 168 * 7  # 392 candidates for 256 slots (matches oracle buffer)
 _REJ_BOUNDED_BYTES = 136 * 4  # 1088 nibbles for 256 slots
+_REJ_BOUNDED_SORT = 1024  # nibbles fed to the compaction (see rej_bounded_poly)
 
 
 def rej_ntt_poly(seeds: jax.Array) -> jax.Array:
@@ -195,7 +196,18 @@ def rej_ntt_poly(seeds: jax.Array) -> jax.Array:
     stable argsort + take_along_axis serialise per-lane on TPU (the same
     hazard kem/mlkem.py:sample_ntt documents).  23-bit candidates don't fit
     an int32 key next to the index, so the pairs variant carries them.
+
+    On TPU the whole pipeline (SHAKE squeeze -> extraction -> compaction)
+    is one fused Pallas kernel with every intermediate in VMEM
+    (sig/mldsa_pallas.py) — the jnp pairs-network alone moves ~11 GB of
+    HBM per 1024-batch of ExpandA otherwise.
     """
+    if keccak._use_pallas():
+        from . import mldsa_pallas  # deferred: pallas import
+
+        ph, plo, batch = keccak.seed_block_words(seeds, 168, 0x1F)
+        return mldsa_pallas.rej_ntt_words(ph, plo).T.reshape(batch + (N,))
+
     buf = keccak.shake128(seeds, _REJ_NTT_BYTES).astype(jnp.int32)
     t = buf.reshape(buf.shape[:-1] + (-1, 3))
     cand = t[..., 0] | (t[..., 1] << 8) | ((t[..., 2] & 0x7F) << 16)
@@ -215,19 +227,28 @@ def rej_bounded_poly(eta: int, seeds: jax.Array) -> jax.Array:
 
     The raw nibble rides in the low bits of the (unique) sort key, so one
     int32 bitonic network replaces the serialised argsort; the eta-map is
-    applied after compaction.
+    applied after compaction.  Only the first 1024 of the 1088 squeezed
+    nibbles feed the network (1024 is the power of two the sort wants):
+    output differs from the full-buffer formulation only if fewer than 256
+    of the first 1024 candidates are accepted — P < 1e-164 for eta=2
+    (accept 15/16), < 1e-94 for eta=4 (accept 9/16).
+
+    On TPU the whole pipeline is one fused Pallas kernel
+    (sig/mldsa_pallas.py), same recipe as rej_ntt_poly.
     """
-    buf = keccak.shake256(seeds, _REJ_BOUNDED_BYTES).astype(jnp.int32)
-    z = jnp.stack([buf & 0xF, buf >> 4], axis=-1).reshape(buf.shape[:-1] + (-1,))
-    ok = z < (15 if eta == 2 else 9)
-    nc = z.shape[-1]
-    idx = jnp.arange(nc, dtype=jnp.int32)
-    key = jnp.where(ok, 0, 1 << 16) | (idx << 4) | z
-    np2 = 1 << (nc - 1).bit_length()
-    key = jnp.pad(
-        key, [(0, 0)] * (key.ndim - 1) + [(0, np2 - nc)], constant_values=1 << 17
-    )
-    z = bitonic_sort(key)[..., :N] & 0xF
+    if keccak._use_pallas():
+        from . import mldsa_pallas  # deferred: pallas import
+
+        ph, plo, batch = keccak.seed_block_words(seeds, 136, 0x1F)
+        z = mldsa_pallas.rej_bounded_words(ph, plo, eta=eta).T.reshape(batch + (N,))
+    else:
+        buf = keccak.shake256(seeds, _REJ_BOUNDED_BYTES).astype(jnp.int32)
+        z = jnp.stack([buf & 0xF, buf >> 4], axis=-1).reshape(buf.shape[:-1] + (-1,))
+        z = z[..., :_REJ_BOUNDED_SORT]
+        ok = z < (15 if eta == 2 else 9)
+        idx = jnp.arange(_REJ_BOUNDED_SORT, dtype=jnp.int32)
+        key = jnp.where(ok, 0, 1 << 16) | (idx << 4) | z
+        z = bitonic_sort(key)[..., :N] & 0xF
     if eta == 2:
         return (2 - z % 5) % Q
     return (4 - z) % Q

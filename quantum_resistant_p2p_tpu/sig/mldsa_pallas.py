@@ -1,0 +1,170 @@
+"""Fused Pallas TPU kernel for ML-DSA's RejNTTPoly (FIPS 204 Algorithm 30).
+
+Same recipe as kem/mlkem_pallas.py, which took ML-KEM encaps off the HBM
+roofline: ExpandA draws k*l uniform NTT-domain polynomials per op (30 for
+ML-DSA-65), and the jnp path's pairs-bitonic compaction moves ~11 GB of
+HBM per 1024-batch — measured 22.6k polys-batch/s, ~45% of the whole
+verify budget.  This kernel runs SHAKE-128 absorb, all 7 squeeze
+permutations, 3-byte candidate extraction, and the 512-wide key/value
+bitonic compaction in VMEM; HBM sees only the 21 input lane-words and the
+256 output coefficients per seed.
+
+The 23-bit candidates do not fit an int32 sort key next to the index, so
+the network carries (key = reject<<10 | idx, val = candidate) register
+pairs — :func:`core.sortnet.bitonic_sort_pairs_regs`, bit-identical in
+output order to sig/mldsa.py:rej_ntt_poly's array formulation (asserted by
+tests/test_mldsa_pallas.py; the kernel body is tested eagerly on CPU, the
+full pallas_call natively on the chip).
+
+Replaces (reference): the rejection loop inside liboqs ML-DSA
+(vendor/oqs.py:506-583 via crypto/signatures.py:58-188).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.keccak_pallas import _f1600, block_bytes, sampler_call
+from ..core.sortnet import bitonic_sort_pairs_regs, bitonic_sort_regs
+
+Q = 8380417
+RATE_WORDS = 21  # SHAKE-128 rate: 168 bytes = 21 lanes
+N_SQUEEZE = 7  # 7 * 168 = 1176 bytes -> 392 candidates for 256 slots
+N_CAND = 392
+N_SORT = 512
+N_OUT = 256
+
+
+def _rej_ntt_tiles(in_hi: list, in_lo: list) -> list:
+    """The full RejNTTPoly pipeline over 21 input lane-word tiles.
+
+    Pure function of same-shaped uint32 arrays -> 256 int32 arrays; the
+    Pallas kernel calls it on VMEM tiles, tests call it eagerly on CPU.
+    """
+    zero = jnp.zeros_like(in_hi[0])
+    sh = [zero] * 25
+    sl = [zero] * 25
+    for w in range(RATE_WORDS):
+        sh[w] = sh[w] ^ in_hi[w]
+        sl[w] = sl[w] ^ in_lo[w]
+    sh, sl = _f1600(sh, sl)
+
+    # Squeeze 1176 bytes; each byte triple is one 23-bit candidate
+    # b0 | b1<<8 | (b2 & 0x7F)<<16.
+    cand = []
+    for blk in range(N_SQUEEZE):
+        byts = block_bytes(sh, sl, RATE_WORDS)
+        for t in range(len(byts) // 3):
+            b0, b1, b2 = byts[3 * t], byts[3 * t + 1], byts[3 * t + 2]
+            c = (b0 | (b1 << 8) | ((b2 & 0x7F) << 16)).astype(jnp.int32)
+            cand.append(c)
+        if blk + 1 < N_SQUEEZE:
+            sh, sl = _f1600(sh, sl)
+    assert len(cand) == N_CAND
+
+    # key = reject<<10 | index: accepted candidates first, spec order —
+    # identical packing to sig/mldsa.py:rej_ntt_poly.
+    keys = [jnp.where(c < Q, 0, 1 << 10) | i for i, c in enumerate(cand)]
+    val_sent = jnp.zeros_like(cand[0])
+    # unique sentinel keys, all above every real key (pairs-sort contract)
+    keys += [jnp.full_like(keys[0], (1 << 11) | s) for s in range(N_SORT - N_CAND)]
+    cand += [val_sent] * (N_SORT - N_CAND)
+    _, cand = bitonic_sort_pairs_regs(keys, cand)
+    return cand[:N_OUT]
+
+
+def _rej_ntt_kernel(in_hi_ref, in_lo_ref, out_ref):
+    out = _rej_ntt_tiles(
+        [in_hi_ref[w] for w in range(RATE_WORDS)],
+        [in_lo_ref[w] for w in range(RATE_WORDS)],
+    )
+    for i in range(N_OUT):
+        out_ref[i] = out[i]
+
+
+# --------------------------------------------------------------------------
+# RejBoundedPoly (FIPS 204 Algorithm 31): SHAKE-256 nibble rejection
+# --------------------------------------------------------------------------
+
+RB_RATE_WORDS = 17  # SHAKE-256 rate: 136 bytes = 17 lanes
+RB_N_SQUEEZE = 4  # 544 bytes squeezed; the first 512 feed the compaction
+RB_N_SORT = 1024  # nibble candidates (= mldsa._REJ_BOUNDED_SORT), a power of 2
+
+
+def _rej_bounded_tiles(in_hi: list, in_lo: list, eta: int) -> list:
+    """RejBoundedPoly pipeline over 17 input lane-word tiles -> 256 nibble tiles.
+
+    Returns the RAW accepted nibbles (0..14 / 0..8); the caller applies the
+    eta-map — keeping the kernel's output identical to the jnp path's
+    pre-map compaction.
+    """
+    zero = jnp.zeros_like(in_hi[0])
+    sh = [zero] * 25
+    sl = [zero] * 25
+    for w in range(RB_RATE_WORDS):
+        sh[w] = sh[w] ^ in_hi[w]
+        sl[w] = sl[w] ^ in_lo[w]
+    sh, sl = _f1600(sh, sl)
+
+    bound = 15 if eta == 2 else 9
+    byts = []
+    for blk in range(RB_N_SQUEEZE):
+        byts += block_bytes(sh, sl, RB_RATE_WORDS)
+        if blk + 1 < RB_N_SQUEEZE and 2 * len(byts) < RB_N_SORT:
+            sh, sl = _f1600(sh, sl)
+    byts = byts[: RB_N_SORT // 2]  # first 512 bytes -> 1024 nibble candidates
+    keys = []
+    for byte in byts:
+        for z in (byte & 0xF, byte >> 4):
+            i = len(keys)
+            keys.append(
+                jnp.where(z < bound, 0, 1 << 16) | (i << 4) | z.astype(jnp.int32)
+            )
+    assert len(keys) == RB_N_SORT
+    keys = bitonic_sort_regs(keys)
+    return [k & 0xF for k in keys[:N_OUT]]
+
+
+def _rej_bounded_kernel(in_hi_ref, in_lo_ref, out_ref, *, eta: int):
+    out = _rej_bounded_tiles(
+        [in_hi_ref[w] for w in range(RB_RATE_WORDS)],
+        [in_lo_ref[w] for w in range(RB_RATE_WORDS)],
+        eta,
+    )
+    for i in range(N_OUT):
+        out_ref[i] = out[i]
+
+
+@functools.partial(jax.jit, static_argnames=("eta", "interpret"))
+def rej_bounded_words(in_hi: jax.Array, in_lo: jax.Array, *, eta: int,
+                      interpret: bool = False):
+    """Batched RejBoundedPoly over word-transposed padded seed blocks.
+
+    Args:
+      in_hi/in_lo: (17, B) uint32 — the padded 136-byte XOF seed block
+        (rhop || n || 0x1F pad || 0x80) as hi/lo lane words, batch minor.
+      eta: 2 or 4 (static; sets the nibble acceptance bound).
+
+    Returns:
+      (256, B) int32 raw accepted nibbles (pre eta-map) in [0, bound).
+    """
+    return sampler_call(functools.partial(_rej_bounded_kernel, eta=eta),
+                        RB_RATE_WORDS, N_OUT, in_hi, in_lo, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def rej_ntt_words(in_hi: jax.Array, in_lo: jax.Array, *, interpret: bool = False):
+    """Batched RejNTTPoly over word-transposed padded seed blocks.
+
+    Args:
+      in_hi/in_lo: (21, B) uint32 — the padded 168-byte XOF seed block
+        (rho || s || r || 0x1F pad || 0x80) as hi/lo lane words, batch minor.
+
+    Returns:
+      (256, B) int32 NTT-domain coefficients in [0, q).
+    """
+    return sampler_call(_rej_ntt_kernel, RATE_WORDS, N_OUT, in_hi, in_lo,
+                        interpret=interpret)

@@ -5,13 +5,15 @@ harness).
 Two tools:
 * ``device_trace``: context manager around ``jax.profiler`` producing a
   TensorBoard-loadable trace of the batched crypto dispatches.
-* ``LatencyHistogram``: lock-free-ish percentile tracker used by the batch
-  queue stats and the swarm benchmark.
+* ``LatencyHistogram``: sliding-window percentile tracker backing the
+  batch queue's per-flush dispatch stats (provider/batched.py QueueStats,
+  surfaced via the CLI's /batchstats and the swarm benchmark's hub_queue
+  section).
 """
 
 from __future__ import annotations
 
-import bisect
+import collections
 import contextlib
 import time
 
@@ -29,23 +31,24 @@ def device_trace(log_dir: str = "/tmp/qrp2p_trace"):
 
 
 class LatencyHistogram:
-    """Bounded sorted sample reservoir with percentile queries."""
+    """Sliding-window percentile tracker over the last ``cap`` samples.
 
-    def __init__(self, cap: int = 10000):
-        self.cap = cap
-        self._sorted: list[float] = []
+    A deque of recent samples, sorted on demand: percentiles reflect the
+    CURRENT behavior of the system (a lifetime reservoir would keep
+    reporting stale latencies long after a regression starts).  Queries are
+    rare (metrics dialogs, bench summaries), so the O(cap log cap) sort per
+    query is the right trade against per-record cost.
+    """
+
+    def __init__(self, cap: int = 1024):
+        self._window: collections.deque[float] = collections.deque(maxlen=cap)
         self.count = 0
         self.total = 0.0
 
     def record(self, seconds: float) -> None:
         self.count += 1
         self.total += seconds
-        if len(self._sorted) < self.cap:
-            bisect.insort(self._sorted, seconds)
-        else:  # reservoir: replace a deterministic slot to stay bounded
-            idx = self.count % self.cap
-            del self._sorted[idx]
-            bisect.insort(self._sorted, seconds)
+        self._window.append(seconds)
 
     @contextlib.contextmanager
     def time(self):
@@ -56,10 +59,10 @@ class LatencyHistogram:
             self.record(time.perf_counter() - t0)
 
     def percentile(self, p: float) -> float | None:
-        if not self._sorted:
+        if not self._window:
             return None
-        idx = min(len(self._sorted) - 1, int(p / 100.0 * len(self._sorted)))
-        return self._sorted[idx]
+        s = sorted(self._window)
+        return s[min(len(s) - 1, int(p / 100.0 * len(s)))]
 
     def summary(self) -> dict:
         return {

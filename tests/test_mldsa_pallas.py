@@ -1,0 +1,72 @@
+"""Bit-exactness of the fused Pallas RejNTTPoly pipeline (sig/mldsa_pallas.py).
+
+Same testing strategy as tests/test_mlkem_pallas.py: the kernel body is a
+pure tile-list function run EAGERLY here (interpret mode and XLA-CPU both
+choke on the ~110k-op unrolled body); the native pallas_call is exercised
+on the real chip by tools/full_bench.py config 4.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.core import keccak
+from quantum_resistant_p2p_tpu.core.sortnet import (
+    bitonic_sort_pairs,
+    bitonic_sort_pairs_regs,
+)
+from quantum_resistant_p2p_tpu.sig import mldsa, mldsa_pallas
+
+
+def test_sort_pairs_regs_matches_array_sort_pairs():
+    rng = np.random.default_rng(4)
+    n, lanes = 64, 5
+    keys = rng.permutation(n * 3)[:n].astype(np.int32)  # unique
+    keys = np.stack([rng.permutation(keys) for _ in range(lanes)], axis=1)  # (n, lanes)
+    vals = rng.integers(0, 1 << 23, (n, lanes), dtype=np.int32)
+    ks, vs = bitonic_sort_pairs_regs(
+        [jnp.asarray(keys[i]) for i in range(n)],
+        [jnp.asarray(vals[i]) for i in range(n)],
+    )
+    got_k = np.stack([np.asarray(k) for k in ks])
+    got_v = np.stack([np.asarray(v) for v in vs])
+    ref_k, ref_v = bitonic_sort_pairs(jnp.asarray(keys.T), jnp.asarray(vals.T))
+    assert np.array_equal(got_k, np.asarray(ref_k).T)
+    assert np.array_equal(got_v, np.asarray(ref_v).T)
+
+
+def test_rej_ntt_tiles_bit_exact_vs_jnp_path(monkeypatch):
+    monkeypatch.setenv("QRP2P_PALLAS", "0")  # reference = jnp rej_ntt_poly
+    rng = np.random.default_rng(9)
+    B = 32
+    seeds = jnp.asarray(rng.integers(0, 256, (B, 34), dtype=np.uint8))
+    ref = np.asarray(mldsa.rej_ntt_poly(seeds))
+
+    block = keccak.pad_single_block(seeds, 168, 0x1F)
+    ph, plo = keccak._bytes_to_words(block)
+    out = mldsa_pallas._rej_ntt_tiles(
+        [ph[:, w] for w in range(mldsa_pallas.RATE_WORDS)],
+        [plo[:, w] for w in range(mldsa_pallas.RATE_WORDS)],
+    )
+    got = np.stack([np.asarray(o) for o in out], axis=-1)
+    assert np.array_equal(got, ref)
+    assert got.max() < mldsa.Q
+
+
+@pytest.mark.parametrize("eta", [2, 4])
+def test_rej_bounded_tiles_bit_exact_vs_jnp_path(eta, monkeypatch):
+    monkeypatch.setenv("QRP2P_PALLAS", "0")
+    rng = np.random.default_rng(3 + eta)
+    B = 32
+    seeds = jnp.asarray(rng.integers(0, 256, (B, 66), dtype=np.uint8))
+    ref = np.asarray(mldsa.rej_bounded_poly(eta, seeds))
+    block = keccak.pad_single_block(seeds, 136, 0x1F)
+    ph, plo = keccak._bytes_to_words(block)
+    out = mldsa_pallas._rej_bounded_tiles(
+        [ph[:, w] for w in range(mldsa_pallas.RB_RATE_WORDS)],
+        [plo[:, w] for w in range(mldsa_pallas.RB_RATE_WORDS)],
+        eta,
+    )
+    z = np.stack([np.asarray(o) for o in out], axis=-1)
+    got = (2 - z % 5) % mldsa.Q if eta == 2 else (4 - z) % mldsa.Q
+    assert np.array_equal(got, ref)
