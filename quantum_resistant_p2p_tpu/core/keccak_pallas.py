@@ -86,6 +86,18 @@ def _f1600(sh: list, sl: list) -> tuple[list, list]:
     return sh, sl
 
 
+def absorb_block(in_hi: list, in_lo: list, rate_words: int) -> tuple[list, list]:
+    """Single-block absorb: XOR ``rate_words`` lane words into a zero state
+    and permute.  Shared preamble of the fused sampler kernels."""
+    zero = jnp.zeros_like(in_hi[0])
+    sh = [zero] * 25
+    sl = [zero] * 25
+    for w in range(rate_words):
+        sh[w] = sh[w] ^ in_hi[w]
+        sl[w] = sl[w] ^ in_lo[w]
+    return _f1600(sh, sl)
+
+
 def block_bytes(sh: list, sl: list, rate_words: int) -> list:
     """Extract the ``8 * rate_words`` rate bytes of a sponge block.
 

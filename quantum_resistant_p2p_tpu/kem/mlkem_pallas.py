@@ -35,7 +35,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..core.keccak_pallas import _f1600, block_bytes, sampler_call
+from ..core.keccak_pallas import _f1600, absorb_block, block_bytes, sampler_call
 from ..core.sortnet import bitonic_sort_regs
 
 Q = 3329
@@ -54,14 +54,7 @@ def _sample_ntt_tiles(in_hi: list, in_lo: list) -> list:
     suite calls it directly on plain arrays (interpret mode would execute
     the ~57k-op body orders of magnitude too slowly).
     """
-    # Absorb the single padded 168-byte seed block into a zero state.
-    zero = jnp.zeros_like(in_hi[0])
-    sh = [zero] * 25
-    sl = [zero] * 25
-    for w in range(RATE_WORDS):
-        sh[w] = sh[w] ^ in_hi[w]
-        sl[w] = sl[w] ^ in_lo[w]
-    sh, sl = _f1600(sh, sl)
+    sh, sl = absorb_block(in_hi, in_lo, RATE_WORDS)
 
     # Squeeze 672 bytes; each byte triple (b0, b1, b2) yields two 12-bit
     # candidates d1 = b0 + 256*(b1 mod 16), d2 = (b1 // 16) + 16*b2.

@@ -27,7 +27,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..core.keccak_pallas import _f1600, block_bytes, sampler_call
+from ..core.keccak_pallas import _f1600, absorb_block, block_bytes, sampler_call
 from ..core.sortnet import bitonic_sort_pairs_regs, bitonic_sort_regs
 
 Q = 8380417
@@ -44,13 +44,7 @@ def _rej_ntt_tiles(in_hi: list, in_lo: list) -> list:
     Pure function of same-shaped uint32 arrays -> 256 int32 arrays; the
     Pallas kernel calls it on VMEM tiles, tests call it eagerly on CPU.
     """
-    zero = jnp.zeros_like(in_hi[0])
-    sh = [zero] * 25
-    sl = [zero] * 25
-    for w in range(RATE_WORDS):
-        sh[w] = sh[w] ^ in_hi[w]
-        sl[w] = sl[w] ^ in_lo[w]
-    sh, sl = _f1600(sh, sl)
+    sh, sl = absorb_block(in_hi, in_lo, RATE_WORDS)
 
     # Squeeze 1176 bytes; each byte triple is one 23-bit candidate
     # b0 | b1<<8 | (b2 & 0x7F)<<16.
@@ -101,13 +95,7 @@ def _rej_bounded_tiles(in_hi: list, in_lo: list, eta: int) -> list:
     eta-map — keeping the kernel's output identical to the jnp path's
     pre-map compaction.
     """
-    zero = jnp.zeros_like(in_hi[0])
-    sh = [zero] * 25
-    sl = [zero] * 25
-    for w in range(RB_RATE_WORDS):
-        sh[w] = sh[w] ^ in_hi[w]
-        sl[w] = sl[w] ^ in_lo[w]
-    sh, sl = _f1600(sh, sl)
+    sh, sl = absorb_block(in_hi, in_lo, RB_RATE_WORDS)
 
     bound = 15 if eta == 2 else 9
     byts = []
