@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from . import keccak  # _use_pallas: shared TPU-vs-CPU gate
+
 # Round constants: fractional parts of cube roots of the first 64 primes.
 _K = np.array(
     [
@@ -61,8 +63,23 @@ def _block_words(block: jax.Array) -> jax.Array:
     return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
 
 
+#: below this flat batch the Pallas kernel's 1024-instance tile padding
+#: wastes more than the jnp path costs (scalar HKDF/HMAC calls, tests)
+_PALLAS_MIN_BATCH = 256
+
+
 def compress(state: jax.Array, block: jax.Array) -> jax.Array:
     """One SHA-256 compression: state (..., 8) uint32, block (..., 64) uint8."""
+    batch = state.shape[:-1]
+    flat = int(np.prod(batch)) if batch else 1
+    if flat >= _PALLAS_MIN_BATCH and keccak._use_pallas():
+        from . import sha256_pallas  # deferred: pallas import
+
+        sw = state.reshape(flat, 8).T
+        bw = _block_words(jnp.asarray(block, jnp.uint8)).reshape(flat, 16).T
+        out = sha256_pallas.compress_words(sw, bw)
+        return out.T.reshape(batch + (8,))
+
     w0 = _block_words(block)
     k = jnp.asarray(_K)
 

@@ -1,0 +1,88 @@
+"""Pallas TPU kernel for the SHA-256 compression function.
+
+SPHINCS+-SHA2 is pure SHA-256: a verify is hundreds of compressions and a
+sign is hundreds of thousands, all over wide batches (batch x chains/trees
+instances per call).  The jnp ``core.sha256.compress`` keeps the 8-word
+state and 16-word schedule window as HBM-resident arrays across the 64
+``lax.fori_loop`` rounds — the same materialise-between-rounds pattern that
+made the jnp Keccak sponge ~11x slower than its kernel.  This kernel holds
+state and schedule in 24 vector registers for all 64 (fully unrolled)
+rounds; HBM sees one 64-byte block in and a 32-byte state out per instance.
+
+Layout identical to core/keccak_pallas.py: each of the 24 words is an
+``(8, 128)`` uint32 tile over 1024 instances, launched through the shared
+``sampler_call`` plumbing.  Oracle: the jnp path (itself hashlib-anchored by
+tests/test_sha256.py); bit-exactness asserted by tests/test_sha256_pallas.py
+eagerly and on-chip by the bench entry points.
+
+Replaces (reference): the SHA-256 inside liboqs SPHINCS+-SHA2
+(crypto/signatures.py:191-315).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .keccak_pallas import sampler_call
+from .sha256 import _K, _rotr
+
+_KI = [int(k) for k in np.asarray(_K)]
+
+
+def _compress_tiles(words: list) -> list:
+    """One SHA-256 compression over 24 word tiles: 8 state + 16 block words.
+
+    Pure function of same-shaped uint32 arrays -> 8 uint32 arrays; the
+    Pallas kernel calls it on VMEM tiles, tests call it eagerly.
+    """
+    a, b, c, d, e, f, g, h = words[:8]
+    w = list(words[8:24])
+    h0 = [a, b, c, d, e, f, g, h]
+    for t in range(64):
+        if t >= 16:
+            x15, x2 = w[(t - 15) % 16], w[(t - 2) % 16]
+            s0 = _rotr(x15, 7) ^ _rotr(x15, 18) ^ (x15 >> 3)
+            s1 = _rotr(x2, 17) ^ _rotr(x2, 19) ^ (x2 >> 10)
+            w[t % 16] = w[t % 16] + s0 + w[(t - 7) % 16] + s1
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + jnp.uint32(_KI[t]) + w[t % 16]
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        a, b, c, d, e, f, g, h = t1 + t2, a, b, c, d + t1, e, f, g
+    out = [a, b, c, d, e, f, g, h]
+    return [o + s for o, s in zip(out, h0)]
+
+
+def _compress_kernel(in_hi_ref, in_lo_ref, out_ref):
+    # sampler_call supplies two equal-width input refs; the 24 live words
+    # (8 state + 16 block) are split 12/12 across them: in_hi rows 0..7 are
+    # state, rows 8..11 are block words 0..3, in_lo rows 0..11 are block
+    # words 4..15.  Purely a transport split — SHA-256 has no hi/lo lanes.
+    words = [in_hi_ref[i] for i in range(12)] + [in_lo_ref[i] for i in range(12)]
+    out = _compress_tiles(words)
+    for i in range(8):
+        out_ref[i] = out[i].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def compress_words(state_w: jax.Array, block_w: jax.Array, *, interpret: bool = False):
+    """Batched SHA-256 compression over word-transposed inputs.
+
+    Args:
+      state_w: (8, B) uint32 current state words, batch minor.
+      block_w: (16, B) uint32 message-block words (big-endian packed).
+
+    Returns:
+      (8, B) uint32 updated state words.
+    """
+    words = jnp.concatenate([state_w, block_w], axis=0)  # (24, B)
+    out = sampler_call(
+        _compress_kernel, 12, 8, words[:12], words[12:], interpret=interpret
+    )
+    return out.astype(jnp.uint32)
