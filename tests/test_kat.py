@@ -164,8 +164,9 @@ def test_mldsa_kat_pyref_and_native(fname):
 
 @pytest.mark.parametrize(
     "fname",
-    ["mldsa_65.json",
-     pytest.param("mldsa_44.json", marks=pytest.mark.slow),
+    # 44 runs in the fast tier as the JAX coverage for that parameter set
+    # (its oracle sign test is slow-tier; see tests/test_mldsa.py).
+    ["mldsa_65.json", "mldsa_44.json",
      pytest.param("mldsa_87.json", marks=pytest.mark.slow)],
 )
 def test_mldsa_kat_jax(fname):

@@ -29,7 +29,11 @@ def test_keygen_matches_oracle(name):
 
 @pytest.mark.parametrize(
     "name",
-    ["ML-DSA-44", "ML-DSA-65", pytest.param("ML-DSA-87", marks=pytest.mark.slow)],
+    # 44 and 87 ride the slow tier: the fast tier keeps their JAX coverage
+    # through test_kat.py's mldsa KATs at a third of the wall-clock (the
+    # pure-Python oracle signing dominates this test's 3 minutes).
+    [pytest.param("ML-DSA-44", marks=pytest.mark.slow), "ML-DSA-65",
+     pytest.param("ML-DSA-87", marks=pytest.mark.slow)],
 )
 def test_sign_matches_oracle_and_verifies(name):
     p = mldsa_ref.PARAMS[name]
