@@ -49,11 +49,12 @@ N = 256
 
 #: Throughput-optimal single-dispatch batch on this hardware (scaling curve
 #: in bench_report.md): per-dispatch ops/s peaks at 1024 rows — the fused
-#: Pallas SampleNTT kernel (kem/mlkem_pallas.py) processes exactly 1024
+#: Pallas sampler kernels (kem/mlkem_pallas.py) process exactly 1024
 #: sponges per grid step, so smaller dispatches pad and waste tile lanes,
 #: and larger single dispatches lose cache locality in the remaining jnp
-#: pipeline (~771k encaps/s at 1024 vs ~555k at one 4096 dispatch).
-#: Providers slice larger batches (provider/base.py sliced_dispatch).
+#: pipeline (983k encaps/s slicing 4096 as 4x1024 vs 733k as one
+#: dispatch).  Providers slice larger batches (provider/base.py
+#: sliced_dispatch).
 MAX_DEVICE_BATCH = 1024
 _N_INV = 3303  # 128^-1 mod q
 
@@ -191,17 +192,30 @@ def sample_poly_cbd(b: jax.Array, eta: int) -> jax.Array:
     return (x[..., 0] - x[..., 1]) % Q
 
 
-def _prf(s: jax.Array, n_consts: np.ndarray, eta: int) -> jax.Array:
-    """PRF_eta(s, n) for a vector of counter bytes.
-
-    s: (..., 32) -> (..., len(n_consts), 64*eta) via SHAKE-256(s || n).
-    """
+def _prf_seeds(s: jax.Array, n_consts: np.ndarray) -> jax.Array:
+    """PRF seed blocks for a vector of counter bytes: (..., 32) -> (..., len(n_consts), 33) s || n."""
     reps = len(n_consts)
     s_rep = jnp.broadcast_to(s[..., None, :], s.shape[:-1] + (reps, 32))
     n_col = jnp.broadcast_to(
         jnp.asarray(n_consts, dtype=jnp.uint8)[:, None], s.shape[:-1] + (reps, 1)
     )
-    return keccak.shake256(jnp.concatenate([s_rep, n_col], axis=-1), 64 * eta)
+    return jnp.concatenate([s_rep, n_col], axis=-1)
+
+
+def _prf_cbd(s: jax.Array, n_consts: np.ndarray, eta: int) -> jax.Array:
+    """PRF_eta + SamplePolyCBD: s (..., 32) -> (..., len(n_consts), 256).
+
+    On TPU the SHAKE-256 squeeze and the CBD bit-sums run as one fused
+    Pallas kernel (kem/mlkem_pallas.py:cbd_words); elsewhere the jnp
+    sponge + sample_poly_cbd path.
+    """
+    seeds = _prf_seeds(s, n_consts)
+    if keccak._use_pallas():
+        from . import mlkem_pallas  # deferred: pallas import
+
+        ph, plo, batch = keccak.seed_block_words(seeds, 136, 0x1F)
+        return mlkem_pallas.cbd_words(ph, plo, eta=eta).T.reshape(batch + (N,))
+    return sample_poly_cbd(keccak.shake256(seeds, 64 * eta), eta)
 
 
 def _expand_matrix(rho: jax.Array, k: int) -> jax.Array:
@@ -227,7 +241,7 @@ def _kpke_keygen(p: MLKEMParams, d: jax.Array):
     g = keccak.sha3_512(kin)
     rho, sigma = g[..., :32], g[..., 32:]
     a_hat = _expand_matrix(rho, k)
-    noise = sample_poly_cbd(_prf(sigma, np.arange(2 * k), p.eta1), p.eta1)
+    noise = _prf_cbd(sigma, np.arange(2 * k), p.eta1)
     s_hat = ntt(noise[..., :k, :])
     e_hat = ntt(noise[..., k:, :])
     t_hat = (
@@ -245,9 +259,9 @@ def _kpke_encrypt(p: MLKEMParams, ek: jax.Array, m: jax.Array, r: jax.Array):
     t_hat = byte_decode(ek[..., : 384 * k].reshape(ek.shape[:-1] + (k, 384)), 12)
     rho = ek[..., 384 * k :]
     a_hat = _expand_matrix(rho, k)
-    y = sample_poly_cbd(_prf(r, np.arange(k), p.eta1), p.eta1)
-    e1 = sample_poly_cbd(_prf(r, np.arange(k, 2 * k), p.eta2), p.eta2)
-    e2 = sample_poly_cbd(_prf(r, np.array([2 * k]), p.eta2), p.eta2)[..., 0, :]
+    y = _prf_cbd(r, np.arange(k), p.eta1)
+    e1 = _prf_cbd(r, np.arange(k, 2 * k), p.eta2)
+    e2 = _prf_cbd(r, np.array([2 * k]), p.eta2)[..., 0, :]
     y_hat = ntt(y)
     # u = invNTT(A^T ∘ y_hat) + e1 : contract over row index i of A[i,j]
     u = (

@@ -106,3 +106,67 @@ def sample_ntt_words(in_hi: jax.Array, in_lo: jax.Array, *, interpret: bool = Fa
     """
     return sampler_call(_sample_ntt_kernel, RATE_WORDS, N_OUT, in_hi, in_lo,
                         interpret=interpret)
+
+
+# --------------------------------------------------------------------------
+# PRF + SamplePolyCBD (FIPS 203 Algorithms 7/8): SHAKE-256 -> CBD_eta poly
+# --------------------------------------------------------------------------
+
+CBD_RATE_WORDS = 17  # SHAKE-256 rate: 136 bytes = 17 lanes
+
+
+def _cbd_tiles(in_hi: list, in_lo: list, eta: int) -> list:
+    """PRF_eta + CBD_eta over 17 input lane-word tiles -> 256 coeff tiles.
+
+    Squeezes 64*eta bytes (one block for eta=2, two for eta=3) and forms
+    coefficient i from bit run [2*eta*i, 2*eta*(i+1)): sum of the first
+    eta bits minus the sum of the second eta, mod q — the same byte-major
+    LSB-first bit order as kem/mlkem.py:sample_poly_cbd.
+    """
+    sh, sl = absorb_block(in_hi, in_lo, CBD_RATE_WORDS)
+    byts = block_bytes(sh, sl, CBD_RATE_WORDS)
+    if 64 * eta > 8 * CBD_RATE_WORDS:  # eta=3: 192 bytes needs a second block
+        sh, sl = _f1600(sh, sl)
+        byts += block_bytes(sh, sl, CBD_RATE_WORDS)
+
+    def bit(p: int):
+        # int32 from the start: the x - y below must not wrap in uint32
+        return ((byts[p // 8] >> (p % 8)) & 1).astype(jnp.int32)
+
+    out = []
+    for i in range(N_OUT):
+        base = 2 * eta * i
+        x = bit(base)
+        for j in range(1, eta):
+            x = x + bit(base + j)
+        for j in range(eta):
+            x = x - bit(base + eta + j)
+        out.append(jnp.where(x < 0, x + Q, x))
+    return out
+
+
+def _cbd_kernel(in_hi_ref, in_lo_ref, out_ref, *, eta: int):
+    out = _cbd_tiles(
+        [in_hi_ref[w] for w in range(CBD_RATE_WORDS)],
+        [in_lo_ref[w] for w in range(CBD_RATE_WORDS)],
+        eta,
+    )
+    for i in range(N_OUT):
+        out_ref[i] = out[i]
+
+
+@functools.partial(jax.jit, static_argnames=("eta", "interpret"))
+def cbd_words(in_hi: jax.Array, in_lo: jax.Array, *, eta: int,
+              interpret: bool = False):
+    """Batched PRF+CBD over word-transposed padded seed blocks.
+
+    Args:
+      in_hi/in_lo: (17, B) uint32 — the padded 136-byte PRF seed block
+        (s || n || 0x1F pad || 0x80) as hi/lo lane words, batch minor.
+      eta: 2 or 3 (static).
+
+    Returns:
+      (256, B) int32 CBD_eta coefficients in [0, q).
+    """
+    return sampler_call(functools.partial(_cbd_kernel, eta=eta),
+                        CBD_RATE_WORDS, N_OUT, in_hi, in_lo, interpret=interpret)

@@ -47,6 +47,27 @@ def test_sample_ntt_tiles_bit_exact_vs_jnp_path(monkeypatch):
     assert got.max() < mlkem.Q
 
 
+@pytest.mark.parametrize("eta", [2, 3])
+def test_cbd_tiles_bit_exact_vs_jnp_path(eta, monkeypatch):
+    # eta=3 exercises the two-block squeeze (ML-KEM-512's eta1).
+    monkeypatch.setenv("QRP2P_PALLAS", "0")
+    rng = np.random.default_rng(10 + eta)
+    B = 48
+    s = jnp.asarray(rng.integers(0, 256, (B, 32), dtype=np.uint8))
+    n_consts = np.arange(2, dtype=np.uint8)
+    ref = np.asarray(mlkem._prf_cbd(s, n_consts, eta))
+    seeds = mlkem._prf_seeds(s, n_consts)
+    block = keccak.pad_single_block(seeds.reshape(-1, 33), 136, 0x1F)
+    ph, plo = keccak._bytes_to_words(block)
+    out = mlkem_pallas._cbd_tiles(
+        [ph[:, w] for w in range(mlkem_pallas.CBD_RATE_WORDS)],
+        [plo[:, w] for w in range(mlkem_pallas.CBD_RATE_WORDS)],
+        eta,
+    )
+    got = np.stack([np.asarray(o) for o in out], axis=-1).reshape(B, 2, 256)
+    assert np.array_equal(got, ref)
+
+
 @pytest.mark.parametrize("ds", ["ML-KEM-512", "ML-KEM-768", "ML-KEM-1024"])
 def test_kem_roundtrip_small_batch(ds):
     rng = np.random.default_rng(11)
