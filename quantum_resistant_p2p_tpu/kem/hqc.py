@@ -46,9 +46,11 @@ from ..pyref.hqc_ref import (
     _rs_gen_poly,
 )
 
-#: Single-dispatch batch cap, matching the other KEMs' dispatch policy
-#: (provider/base.py sliced_dispatch; see kem/mlkem.py MAX_DEVICE_BATCH).
-MAX_DEVICE_BATCH = 512
+#: Single-dispatch batch cap (provider/base.py sliced_dispatch).  A 256-row
+#: HQC keygen dispatch crashed this environment's remote TPU worker
+#: ("kernel fault", 2026-07-30) — the same failure class FrodoKEM hits at
+#: >= 1024 (kem/frodo.py); 128 stays below the observed fault threshold.
+MAX_DEVICE_BATCH = 128
 
 _EXP = np.asarray(_GF_EXP, dtype=np.int32)  # length 512
 _LOG = np.asarray(_GF_LOG, dtype=np.int32)
