@@ -32,6 +32,44 @@ from .storage.secure_logger import SecureLogger
 
 logger = logging.getLogger(__name__)
 
+
+def _parse_time_point(text: str) -> float:
+    """One /logs time arg -> epoch seconds.
+
+    Accepts relative durations ago ("30m", "2h", "1d"), "HH:MM" (today,
+    local), or an ISO "YYYY-MM-DD[THH:MM[:SS]]" stamp.
+    """
+    import datetime as _dt
+
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    if len(text) >= 2 and text[-1] in units and text[:-1].isdigit():
+        return time.time() - int(text[:-1]) * units[text[-1]]
+    if ":" in text and "-" not in text:
+        today = _dt.datetime.now().strftime("%Y-%m-%d")
+        return _dt.datetime.fromisoformat(f"{today}T{text}").timestamp()
+    return _dt.datetime.fromisoformat(text).timestamp()
+
+
+def _parse_time_range(args: list[str]):
+    """Split ``--since T`` / ``--until T`` out of a /logs arg list."""
+    start_t = end_t = None
+    rest: list[str] = []
+    i = 0
+    while i < len(args):
+        if args[i] in ("--since", "--until"):
+            if i + 1 >= len(args):
+                raise ValueError(f"{args[i]} needs a time argument (30m, HH:MM, ISO)")
+            t = _parse_time_point(args[i + 1])
+            if args[i] == "--since":
+                start_t = t
+            else:
+                end_t = t
+            i += 2
+        else:
+            rest.append(args[i])
+            i += 1
+    return start_t, end_t, rest
+
 HELP = """\
 commands:
   /peers                     list discovered + connected peers
@@ -43,7 +81,9 @@ commands:
   /set kem|aead|sig <name>   hot-swap an algorithm
   /adopt <peer>              adopt the peer's gossiped settings
   /metrics                   security metrics (events, bytes, algorithms)
-  /logs [type] [n]           decrypted audit log (latest n, default 20)
+  /logs [type] [n] [--since T] [--until T]
+                             decrypted audit log (latest n, default 20;
+                             T: 30m/2h/1d relative, HH:MM, or ISO date)
   /clearlogs                 delete all audit logs
   /keyhistory [peer]         list stored shared-key history entries
   /showkey <entry> [fmt]     decrypt + display a stored key (audited,
@@ -123,8 +163,23 @@ class CLI:
         )
         self.messaging.register_message_listener(self._on_message)
         self.secure_logger.log_event("initialization", node_id=node_id, port=self.node.port)
+        # Explicit native-core availability, the role of the reference's
+        # status-bar OQS chip (ui/oqs_status_widget.py:29-31).  load() may
+        # run a first-launch g++ build, so keep it off the event loop — the
+        # TCP server and discovery are already serving.
+        def _probe_native() -> str:
+            try:
+                from . import native
+
+                if native.load() is not None:
+                    return "native C++ core: ✓"
+            except Exception:
+                pass
+            return "native C++ core: ✗ (pure-Python fallback)"
+
+        core = await asyncio.get_running_loop().run_in_executor(None, _probe_native)
         self.print(f"node {node_id[:12]}… listening on :{self.node.port} "
-                   f"(backend={self.backend}, batching={self.use_batching})")
+                   f"(backend={self.backend}, batching={self.use_batching}, {core})")
 
     async def stop(self) -> None:
         if self.discovery:
@@ -235,13 +290,20 @@ class CLI:
         elif cmd == "/metrics":
             self.print(json.dumps(self.secure_logger.get_security_metrics(), indent=2))
         elif cmd == "/logs":
-            etype = args[0] if args and not args[0].isdigit() else None
-            n = int(args[-1]) if args and args[-1].isdigit() else 20
-            events = self.secure_logger.get_events(event_type=etype)[-n:]
+            # Filter surface of the reference's log viewer (event-type combo +
+            # time-range pickers, ui/log_viewer_dialog.py:137-151) as args:
+            #   /logs [type] [n] [--since T] [--until T]
+            # T = relative (30m/2h/1d), HH:MM (today), or ISO date[Ttime].
+            start_t, end_t, rest = _parse_time_range(args)
+            etype = rest[0] if rest and not rest[0].isdigit() else None
+            n = int(rest[-1]) if rest and rest[-1].isdigit() else 20
+            events = self.secure_logger.get_events(
+                event_type=etype, start_time=start_t, end_time=end_t
+            )[-n:]
             for ev in events:
                 ts = time.strftime("%H:%M:%S", time.localtime(ev.get("timestamp", 0)))
-                rest = {k: v for k, v in ev.items() if k not in ("timestamp", "event_type")}
-                self.print(f"  {ts} {ev.get('event_type')} {rest}")
+                fields = {k: v for k, v in ev.items() if k not in ("timestamp", "event_type")}
+                self.print(f"  {ts} {ev.get('event_type')} {fields}")
             if not events:
                 self.print("  (no events)")
         elif cmd == "/clearlogs":

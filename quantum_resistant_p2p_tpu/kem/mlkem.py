@@ -95,7 +95,9 @@ def byte_encode(vals: jax.Array, d: int) -> jax.Array:
         bits = bits.reshape(vals.shape[:-1] + (32 * d, 8))
         return jnp.sum(bits << jnp.arange(8), axis=-1).astype(jnp.uint8)
     v = vals.reshape(vals.shape[:-1] + (N // 2, 2))
-    lo, hi = v[..., 0], v[..., 1]
+    # The arithmetic split would spill bits >= 12 into adjacent bytes (the
+    # old bit path truncated them); mask so non-canonical inputs can't.
+    lo, hi = v[..., 0] & 0xFFF, v[..., 1] & 0xFFF
     out = jnp.stack([lo & 0xFF, (lo >> 8) | ((hi & 0xF) << 4), hi >> 4], axis=-1)
     return out.reshape(vals.shape[:-1] + (384,)).astype(jnp.uint8)
 

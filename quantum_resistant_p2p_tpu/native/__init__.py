@@ -1,4 +1,4 @@
-"""ctypes loader for the C++ host crypto core (native/qrp_native.cpp).
+"""ctypes loader for the C++ host crypto core (qrp_native.cpp, this package).
 
 Fills the role liboqs plays for the reference app (vendored .so loaded via
 ctypes, reference vendor/__init__.py:12-57 + vendor/oqs.py:122-183): a native
@@ -20,7 +20,9 @@ from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
-_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "qrp_native.cpp"
+# Ships inside the package so non-editable installs carry the source
+# (pyproject.toml package-data) and build-on-demand works from site-packages.
+_SRC = Path(__file__).resolve().parent / "qrp_native.cpp"
 _CACHE_DIR = Path(
     os.environ.get("QRP_NATIVE_CACHE", Path.home() / ".cache" / "qrp2p_tpu")
 )

@@ -56,6 +56,23 @@ _ZETAS = np.asarray(ZETAS, dtype=np.int32)
 
 MAX_SIGN_ITERS = 128  # P[a lane needs >128 attempts] < 1e-12 (avg ~4-6 attempts)
 
+# Test/debug guard: fail loudly if the truncated 1024-candidate sampler
+# buffers would diverge from the oracle's full-buffer convention (advisor
+# round-2 finding; P < 1e-94 per poly, but silent divergence is worse than
+# a crash).  Enabled by tests; off in production (adds a host callback).
+# NOTE: read at TRACE time — jitted entry points (get()) bake the setting
+# into their cached trace, so set it before the first call of a fresh
+# process/jit wrapper (same caveat as QRP2P_PALLAS).
+STRICT_SAMPLERS = False
+
+
+def _check_sampler_fill(ok, name: str) -> None:
+    if not np.all(np.asarray(ok)):
+        raise AssertionError(
+            f"{name}: fewer than {N} accepted candidates in the truncated "
+            "sort buffer — output diverges from the pyref oracle convention"
+        )
+
 # --------------------------------------------------------------------------
 # int32 modular arithmetic without 64-bit lanes
 # --------------------------------------------------------------------------
@@ -248,7 +265,13 @@ def rej_bounded_poly(eta: int, seeds: jax.Array) -> jax.Array:
         ok = z < (15 if eta == 2 else 9)
         idx = jnp.arange(_REJ_BOUNDED_SORT, dtype=jnp.int32)
         key = jnp.where(ok, 0, 1 << 16) | (idx << 4) | z
-        z = bitonic_sort(key)[..., :N] & 0xF
+        skey = bitonic_sort(key)
+        if STRICT_SAMPLERS:
+            # slot N-1 must still be an accepted candidate (reject bit clear)
+            jax.debug.callback(
+                _check_sampler_fill, skey[..., N - 1] < (1 << 16), "rej_bounded_poly"
+            )
+        z = skey[..., :N] & 0xF
     if eta == 2:
         return (2 - z % 5) % Q
     return (4 - z) % Q

@@ -102,6 +102,35 @@ def test_showkey_formats_warning_and_audit(run, tmp_path, monkeypatch):
     run(main())
 
 
+def test_logs_time_and_type_filters(run, tmp_path):
+    async def main():
+        a, out = _mk(tmp_path, "logsf")
+        await a.start()
+        # startup line states native-core availability explicitly
+        assert "native C++ core:" in out.getvalue()
+        a.secure_logger.log_event("connection", peer="x")
+        a.secure_logger.log_event("message_sent", peer="x")
+
+        out.truncate(0), out.seek(0)
+        await a.handle("/logs connection")
+        assert "connection" in out.getvalue() and "message_sent" not in out.getvalue()
+
+        out.truncate(0), out.seek(0)
+        await a.handle("/logs --since 1h")
+        assert "message_sent" in out.getvalue()
+
+        out.truncate(0), out.seek(0)
+        await a.handle("/logs --until 1h")  # everything is newer than 1h ago
+        assert "(no events)" in out.getvalue()
+
+        out.truncate(0), out.seek(0)
+        await a.handle("/logs --since 23:59 --until 23:59")
+        assert "(no events)" in out.getvalue()
+        await a.stop()
+
+    run(main())
+
+
 def test_unknown_command_and_bad_args_keep_repl_alive(run, tmp_path):
     async def main():
         a, out = _mk(tmp_path, "solo")

@@ -98,3 +98,15 @@ def test_batch_sign_verify():
     sigs = alg.sign_batch(sks, msgs)
     assert alg.verify_batch(pks, msgs, sigs).all()
     assert not alg.verify_batch(pks, [m + b"x" for m in msgs], sigs).any()
+
+
+def test_strict_sampler_guard(monkeypatch):
+    # With the guard on, sampling must pass silently for honest seeds (the
+    # truncated 1024-candidate buffer virtually always fills), and the check
+    # itself must trip on an under-filled buffer.
+    monkeypatch.setattr(jmldsa, "STRICT_SAMPLERS", True)
+    seeds = RNG.integers(0, 256, size=(8, 66), dtype=np.uint8)
+    out = np.asarray(jmldsa.rej_bounded_poly(2, seeds))
+    assert out.shape == (8, 256)
+    with pytest.raises(AssertionError, match="rej_bounded_poly"):
+        jmldsa._check_sampler_fill(np.array([True, False]), "rej_bounded_poly")

@@ -1,0 +1,28 @@
+"""Real pallas_call launcher plumbing, gated on a TPU backend.
+
+The CPU suite (conftest pins an 8-device virtual CPU platform) covers the
+kernel *bodies* eagerly; these tests run the actual ``pallas_call`` —
+Mosaic compilation, BlockSpec/grid setup, hi/lo word transport — and so
+only execute when the process sees a TPU.  The driver-facing entry point is
+``python -m tools.check_pallas_device`` (same checks, standalone process,
+respecting the one-TPU-process rule); its latest on-chip result is recorded
+in bench_report.md.
+"""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tools import check_pallas_device  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu",
+    reason="real pallas_call needs Mosaic/TPU (suite is CPU-pinned); "
+    "run tools/check_pallas_device.py on the chip",
+)
+
+
+@pytest.mark.parametrize("name,fn", check_pallas_device.CHECKS,
+                         ids=[n for n, _ in check_pallas_device.CHECKS])
+def test_pallas_launcher_bit_exact(name, fn):
+    fn()

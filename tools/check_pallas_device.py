@@ -1,0 +1,159 @@
+"""On-chip bit-exactness of the real ``pallas_call`` launchers vs the jnp path.
+
+The CPU test suite exercises the Pallas kernel *bodies* eagerly
+(tests/test_mlkem_pallas.py) because XLA-CPU cannot compile the unrolled
+sponge graphs and interpret mode is as slow.  What that leaves untested is
+the launcher plumbing itself — Mosaic compilation, sampler_call's
+BlockSpec/grid setup, and the hi/lo word transport (advisor round-2
+finding).  This tool runs every fused kernel through its real
+``pallas_call`` on the TPU and compares bit-for-bit against the pure-jnp
+formulations.
+
+Run standalone on the chip (single TPU process rule applies):
+
+    python -m tools.check_pallas_device
+
+tests/test_pallas_device.py wraps the same checks, gated on a TPU backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+# The jnp reference paths must be traced WITHOUT the pallas branch; the flag
+# is read at trace time and cached by jit, so it must be set before import.
+os.environ.setdefault("QRP2P_PALLAS", "0")
+
+import numpy as np  # noqa: E402
+
+B = 300  # deliberately not a multiple of the 1024-sponge tile
+
+
+def check_sample_ntt() -> None:
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import keccak
+    from quantum_resistant_p2p_tpu.kem import mlkem, mlkem_pallas
+
+    rng = np.random.default_rng(1)
+    seeds = jnp.asarray(rng.integers(0, 256, (B, 34), dtype=np.uint8))
+    ref = np.asarray(mlkem.sample_ntt(seeds))
+    ph, plo, batch = keccak.seed_block_words(seeds, 168, 0x1F)
+    got = np.asarray(mlkem_pallas.sample_ntt_words(ph, plo).T.reshape(batch + (256,)))
+    assert np.array_equal(got, ref), "sample_ntt_words diverges from jnp path"
+
+
+def check_cbd(eta: int) -> None:
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import keccak
+    from quantum_resistant_p2p_tpu.kem import mlkem, mlkem_pallas
+
+    rng = np.random.default_rng(2 + eta)
+    s = jnp.asarray(rng.integers(0, 256, (B, 32), dtype=np.uint8))
+    n_consts = np.arange(2, dtype=np.uint8)
+    ref = np.asarray(mlkem._prf_cbd(s, n_consts, eta))
+    seeds = mlkem._prf_seeds(s, n_consts)
+    ph, plo, _ = keccak.seed_block_words(seeds.reshape(-1, 33), 136, 0x1F)
+    got = np.asarray(
+        mlkem_pallas.cbd_words(ph, plo, eta=eta).T.reshape(B, 2, 256)
+    )
+    assert np.array_equal(got, ref), f"cbd_words(eta={eta}) diverges from jnp path"
+
+
+def check_rej_ntt() -> None:
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import keccak
+    from quantum_resistant_p2p_tpu.sig import mldsa, mldsa_pallas
+
+    rng = np.random.default_rng(4)
+    seeds = jnp.asarray(rng.integers(0, 256, (B, 34), dtype=np.uint8))
+    ref = np.asarray(mldsa.rej_ntt_poly(seeds))
+    ph, plo, batch = keccak.seed_block_words(seeds, 168, 0x1F)
+    got = np.asarray(mldsa_pallas.rej_ntt_words(ph, plo).T.reshape(batch + (256,)))
+    assert np.array_equal(got, ref), "rej_ntt_words diverges from jnp path"
+
+
+def check_rej_bounded(eta: int) -> None:
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import keccak
+    from quantum_resistant_p2p_tpu.sig import mldsa, mldsa_pallas
+
+    rng = np.random.default_rng(6 + eta)
+    seeds = jnp.asarray(rng.integers(0, 256, (B, 66), dtype=np.uint8))
+    ref = np.asarray(mldsa.rej_bounded_poly(eta, seeds))
+    ph, plo, batch = keccak.seed_block_words(seeds, 136, 0x1F)
+    got = np.asarray(
+        mldsa_pallas.rej_bounded_words(ph, plo, eta=eta).T.reshape(batch + (256,))
+    )
+    assert np.array_equal(got, ref), f"rej_bounded_words(eta={eta}) diverges"
+
+
+def check_sha256_compress() -> None:
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import sha256, sha256_pallas
+
+    rng = np.random.default_rng(9)
+    state = jnp.asarray(rng.integers(0, 1 << 32, (B, 8), dtype=np.uint32))
+    block = jnp.asarray(rng.integers(0, 256, (B, 64), dtype=np.uint8))
+    ref = np.asarray(sha256.compress(state, block))
+    sw = state.reshape(B, 8).T
+    bw = sha256._block_words(block).reshape(B, 16).T
+    got = np.asarray(sha256_pallas.compress_words(sw, bw).T.reshape(B, 8))
+    assert np.array_equal(got, ref), "sha256 compress_words diverges from jnp path"
+
+
+def check_sponge() -> None:
+    """shake256 through sponge_words (multi-block absorb+squeeze) vs jnp."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import keccak, keccak_pallas
+
+    rng = np.random.default_rng(12)
+    msgs = jnp.asarray(rng.integers(0, 256, (B, 64), dtype=np.uint8))
+    ref = np.asarray(keccak.shake256(msgs, 272))  # 2 squeeze blocks
+    block = keccak.pad_single_block(msgs, 136, 0x1F)
+    ph, plo = keccak._bytes_to_words(block)
+    oh, ol = keccak_pallas.sponge_words(
+        ph.T, plo.T, rate_words=17, n_abs=1, n_sq=2
+    )
+    got = np.asarray(keccak._words_to_bytes(oh.T, ol.T))[:, :272]
+    assert np.array_equal(got, ref), "sponge_words diverges from jnp path"
+
+
+CHECKS = [
+    ("sample_ntt_words", check_sample_ntt),
+    ("cbd_words eta=2", lambda: check_cbd(2)),
+    ("cbd_words eta=3", lambda: check_cbd(3)),
+    ("rej_ntt_words", check_rej_ntt),
+    ("rej_bounded_words eta=2", lambda: check_rej_bounded(2)),
+    ("rej_bounded_words eta=4", lambda: check_rej_bounded(4)),
+    ("sha256 compress_words", check_sha256_compress),
+    ("sponge_words shake256", check_sponge),
+]
+
+
+def main() -> int:
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform}")
+    if platform != "tpu":
+        print("WARNING: not a TPU — Mosaic is the point of this check")
+    failed = 0
+    for name, fn in CHECKS:
+        try:
+            fn()
+            print(f"  ok   {name}")
+        except AssertionError as e:
+            failed += 1
+            print(f"  FAIL {name}: {e}")
+    print(f"{len(CHECKS) - failed}/{len(CHECKS)} pallas_call launchers bit-exact")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
