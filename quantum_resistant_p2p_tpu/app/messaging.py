@@ -99,14 +99,19 @@ class SecureMessaging:
         use_batching: bool = False,
         max_batch: int = 4096,
         max_wait_ms: float = 2.0,
+        mesh_devices: int = 0,
     ):
         self.node = node
         self.key_storage = key_storage
         self.secure_logger = secure_logger
         self.backend = backend
-        self.kem = kem or get_kem("ML-KEM-768", backend)
+        # multi-chip: tpu-backend providers shard device batches across a
+        # mesh of this many chips (Config.mesh_devices; 0 = single device)
+        self.mesh_devices = mesh_devices
+        self.kem = kem or get_kem("ML-KEM-768", backend, devices=mesh_devices)
         self.symmetric = symmetric or get_symmetric("AES-256-GCM")
-        self.signature = signature or get_signature("ML-DSA-65", backend)
+        self.signature = signature or get_signature("ML-DSA-65", backend,
+                                                    devices=mesh_devices)
 
         # Optional TPU batching queue (the north-star refactor): when enabled,
         # every handshake/sign/verify op from every concurrent peer coalesces
@@ -695,7 +700,7 @@ class SecureMessaging:
 
     async def set_key_exchange_algorithm(self, name: str) -> None:
         """Drop all shared keys and re-handshake (reference: :1741-1781)."""
-        self.kem = get_kem(name, self.backend)
+        self.kem = get_kem(name, self.backend, devices=self.mesh_devices)
         if self.use_batching:
             from ..provider.batched import BatchedKEM
 
@@ -727,7 +732,8 @@ class SecureMessaging:
 
     async def set_signature_algorithm(self, name: str) -> None:
         """Lazily load-or-generate the new keypair (reference: :1827-1851)."""
-        self.signature = get_signature(name, self.backend)
+        self.signature = get_signature(name, self.backend,
+                                       devices=self.mesh_devices)
         if self.use_batching:
             from ..provider.batched import BatchedSignature
 

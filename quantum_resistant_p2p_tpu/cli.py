@@ -106,6 +106,7 @@ class CLI:
         port: int = 8000,
         backend: str = "cpu",
         use_batching: bool = False,
+        mesh_devices: int = 0,
         enable_discovery: bool = True,
         out=sys.stdout,
     ):
@@ -113,6 +114,7 @@ class CLI:
         self.port = port
         self.backend = backend
         self.use_batching = use_batching
+        self.mesh_devices = mesh_devices
         self.enable_discovery = enable_discovery
         self.storage = KeyStorage(vault_path)
         self.node: P2PNode | None = None
@@ -160,6 +162,7 @@ class CLI:
             secure_logger=self.secure_logger,
             backend=self.backend,
             use_batching=self.use_batching,
+            mesh_devices=self.mesh_devices,
         )
         self.messaging.register_message_listener(self._on_message)
         self.secure_logger.log_event("initialization", node_id=node_id, port=self.node.port)
@@ -443,6 +446,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--vault", default=None, help="vault file path")
     ap.add_argument("--backend", choices=("cpu", "tpu", "auto"), default=None)
     ap.add_argument("--batch", action="store_true", help="enable the TPU batch queue")
+    ap.add_argument("--mesh-devices", type=int, default=None,
+                    help="shard TPU batches across this many chips (0 = one, -1 = all)")
     ap.add_argument("--config", default=None, help="config file path")
     ap.add_argument("--no-discovery", action="store_true")
     ap.add_argument("--log-level", default="INFO")
@@ -453,6 +458,7 @@ def main(argv: list[str] | None = None) -> int:
         port=args.port,
         backend=args.backend,
         use_batching=True if args.batch else None,
+        mesh_devices=args.mesh_devices,
     )
 
     logging.basicConfig(
@@ -466,6 +472,7 @@ def main(argv: list[str] | None = None) -> int:
         port=cfg.port,
         backend=cfg.backend,
         use_batching=cfg.use_batching,
+        mesh_devices=cfg.mesh_devices,
         enable_discovery=not args.no_discovery,
     )
     if not cli.login_interactive():

@@ -36,7 +36,8 @@ class Config:
     port: int = 8000
     discovery_port: int = 8001
     chunk_size: int = 64 * 1024
-    # multi-chip: devices along the batch axis; 0 = all visible devices
+    # multi-chip: tpu-backend batches shard across this many devices
+    # (0 = single device, -1 = all visible)
     mesh_devices: int = 0
 
     @classmethod

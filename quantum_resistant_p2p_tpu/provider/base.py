@@ -45,7 +45,58 @@ def cpu_impl_desc(native_obj) -> str:
     return "native C++ CPU" if native_obj is not None else "pure-Python CPU"
 
 
-def sliced_dispatch(fn, step: int, *arrays):
+def next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+def pad_rows(rows: np.ndarray, target: int) -> np.ndarray:
+    """Pad the batch dim to ``target`` by repeating the last row.
+
+    Device batches are padded to power-of-two buckets so XLA compiles at most
+    log2(max_batch) program variants per op instead of one per batch size —
+    without this, a cold queue spends tens of seconds per novel size.
+    """
+    n = rows.shape[0]
+    if n == target:
+        return rows
+    pad = np.broadcast_to(rows[-1:], (target - n,) + rows.shape[1:])
+    return np.concatenate([np.asarray(rows), pad], axis=0)
+
+
+def mesh_dispatch(fn, mesh, *arrays):
+    """Run a jitted batch fn with the batch axis sharded across ``mesh``.
+
+    TPU-native scale-out for embarrassingly parallel crypto batches
+    (SURVEY.md §2.3): operands are placed with a batch-axis NamedSharding and
+    the computation follows the data — GSPMD partitions the already-jitted
+    program across the mesh with zero cross-chip collectives on the hot path
+    (each chip runs its shard of keygen/encaps/decaps/sign/verify locally).
+
+    The batch is padded (last row repeated) to ``n_devices * pow2`` so every
+    device receives an equal, compile-cached shard; results gather on the
+    host and are trimmed.  Non-divisible batches therefore cost at most the
+    pad rows, never a recompile.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    n = arrays[0].shape[0]
+    if n == 0:  # pad_rows cannot repeat a row of an empty batch
+        out = fn(*arrays)
+        if isinstance(out, tuple):
+            return tuple(np.asarray(o) for o in out)
+        return np.asarray(out)
+    ndev = mesh.size
+    tgt = ndev * next_pow2(-(-n // ndev))
+    sh = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+    parts = [jax.device_put(pad_rows(np.asarray(a), tgt), sh) for a in arrays]
+    out = fn(*parts)
+    if isinstance(out, tuple):
+        return tuple(np.asarray(o)[:n] for o in out)
+    return np.asarray(out)[:n]
+
+
+def sliced_dispatch(fn, step: int, *arrays, mesh=None):
     """Run a jitted batch fn in ``step``-row slices and concatenate.
 
     Two reasons to slice device batches: FrodoKEM dispatches >= 1024 crash
@@ -54,26 +105,53 @@ def sliced_dispatch(fn, step: int, *arrays):
     bench_report.md's scaling curve).  A non-divisible tail is padded to a
     full slice (last row repeated) so every dispatch hits an already-compiled
     shape, then trimmed.
+
+    With a ``mesh``, each slice is sharded across the mesh's devices via
+    ``mesh_dispatch`` and ``step`` is the PER-DEVICE cap, so one dispatch
+    covers ``step * mesh.size`` rows.
     """
     n = arrays[0].shape[0]
-    if n <= step:
-        out = fn(*arrays)
-        return tuple(np.asarray(o) for o in out) if isinstance(out, tuple) else np.asarray(out)
+    if mesh is not None:
+        cap = step * mesh.size
+        if n <= cap:
+            return mesh_dispatch(fn, mesh, *arrays)
+        one = lambda *xs: mesh_dispatch(fn, mesh, *xs)  # noqa: E731
+    else:
+        cap = step
+        if n <= cap:
+            out = fn(*arrays)
+            return (
+                tuple(np.asarray(o) for o in out)
+                if isinstance(out, tuple)
+                else np.asarray(out)
+            )
+        one = fn
 
     def slice_of(a, i):
-        part = a[i : i + step]
-        if part.shape[0] < step:
-            pad = np.broadcast_to(part[-1:], (step - part.shape[0],) + part.shape[1:])
-            part = np.concatenate([np.asarray(part), pad], axis=0)
-        return part
+        return pad_rows(a[i : i + cap], cap)
 
-    parts = [fn(*(slice_of(a, i) for a in arrays)) for i in range(0, n, step)]
+    parts = [one(*(slice_of(a, i) for a in arrays)) for i in range(0, n, cap)]
     if isinstance(parts[0], tuple):
         return tuple(
             np.concatenate([np.asarray(p[j]) for p in parts])[:n]
             for j in range(len(parts[0]))
         )
     return np.concatenate([np.asarray(p) for p in parts])[:n]
+
+
+def make_provider_mesh(devices: int, backend: str):
+    """Build the provider-internal device mesh, or None when disabled.
+
+    ``devices`` comes from Config.mesh_devices / the registry ``devices=``
+    knob: 0 = single-device (default), N = 1-D mesh over the first N visible
+    devices (make_mesh raises when fewer exist), -1 = all visible devices.
+    Only the tpu backend shards; the cpu path never imports jax.
+    """
+    if not devices or backend != "tpu":
+        return None
+    from ..parallel.mesh import make_mesh
+
+    return make_mesh(None if devices < 0 else devices)
 
 
 class CryptoAlgorithm(abc.ABC):

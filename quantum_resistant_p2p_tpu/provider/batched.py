@@ -28,7 +28,8 @@ from typing import Any, Callable
 import numpy as np
 
 from ..utils.profiling import LatencyHistogram
-from .base import KeyExchangeAlgorithm, SignatureAlgorithm
+from .base import (KeyExchangeAlgorithm, SignatureAlgorithm,
+                   next_pow2 as _next_pow2, pad_rows as _pad_rows)
 
 
 @dataclass
@@ -139,10 +140,6 @@ class OpQueue:
                     f.set_exception(exc)
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << (n - 1).bit_length() if n > 1 else 1
-
-
 def _run_valid(items, is_valid, dispatch, invalid_result):
     """Shared filter-pad-dispatch-scatter skeleton for the batch fns.
 
@@ -159,20 +156,6 @@ def _run_valid(items, is_valid, dispatch, invalid_result):
         for j, i in enumerate(valid_idx):
             results[i] = out[j]
     return results
-
-
-def _pad_rows(rows: np.ndarray, target: int) -> np.ndarray:
-    """Pad the batch dim to ``target`` by repeating the last row.
-
-    Device batches are padded to power-of-two buckets so XLA compiles at most
-    log2(max_batch) program variants per op instead of one per batch size —
-    without this, a cold queue spends tens of seconds per novel size.
-    """
-    n = rows.shape[0]
-    if n == target:
-        return rows
-    pad = np.broadcast_to(rows[-1:], (target - n,) + rows.shape[1:])
-    return np.concatenate([rows, pad], axis=0)
 
 
 class BatchedKEM:

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..pyref import frodo_ref, hqc_ref, mlkem_ref
 from .base import (KeyExchangeAlgorithm, cpu_impl_desc, expect_cols, expect_len,
-                   sliced_dispatch, try_native)
+                   make_provider_mesh, sliced_dispatch, try_native)
 
 _LEVEL_TO_MLKEM = {1: mlkem_ref.MLKEM512, 3: mlkem_ref.MLKEM768, 5: mlkem_ref.MLKEM1024}
 
@@ -37,7 +37,8 @@ _LEVEL_TO_FRODO = {
 class MLKEMKeyExchange(KeyExchangeAlgorithm):
     """ML-KEM (FIPS 203) at NIST level 1, 3 or 5."""
 
-    def __init__(self, security_level: int = 3, backend: str = "cpu"):
+    def __init__(self, security_level: int = 3, backend: str = "cpu",
+                 devices: int = 0):
         if security_level not in _LEVEL_TO_MLKEM:
             raise ValueError(f"ML-KEM level must be 1/3/5, got {security_level}")
         self.params = _LEVEL_TO_MLKEM[security_level]
@@ -53,6 +54,7 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
 
             self._kg, self._enc, self._dec = _jax_mlkem.get(self.params.name)
             self._max_dispatch = _jax_mlkem.MAX_DEVICE_BATCH
+        self._mesh = make_provider_mesh(devices, backend)
         self._native = None
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference);
@@ -88,7 +90,8 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         d = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
         z = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
         if self.backend == "tpu":
-            return sliced_dispatch(self._kg, self._max_dispatch, d, z)
+            return sliced_dispatch(self._kg, self._max_dispatch, d, z,
+                                   mesh=self._mesh)
         impl = self._native if self._native is not None else None
         pairs = [
             (impl.keygen(d[i].tobytes(), z[i].tobytes()) if impl
@@ -106,7 +109,7 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         m = np.frombuffer(os.urandom(32 * n), dtype=np.uint8).reshape(n, 32)
         if self.backend == "tpu":
             key, ct = sliced_dispatch(self._enc, self._max_dispatch,
-                                      np.asarray(public_keys), m)
+                                      np.asarray(public_keys), m, mesh=self._mesh)
             return ct, key
         impl = self._native
         outs = [
@@ -124,7 +127,8 @@ class MLKEMKeyExchange(KeyExchangeAlgorithm):
         expect_cols(ciphertexts, self.ciphertext_len, "ciphertexts", self.name)
         if self.backend == "tpu":
             return sliced_dispatch(self._dec, self._max_dispatch,
-                                   np.asarray(secret_keys), np.asarray(ciphertexts))
+                                   np.asarray(secret_keys), np.asarray(ciphertexts),
+                                   mesh=self._mesh)
         impl = self._native
         return np.stack(
             [
@@ -148,7 +152,8 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
     including its use_aes flag; BASELINE.json config 3 targets the AES variant.
     """
 
-    def __init__(self, security_level: int = 1, backend: str = "cpu", use_aes: bool = True):
+    def __init__(self, security_level: int = 1, backend: str = "cpu",
+                 use_aes: bool = True, devices: int = 0):
         key = (security_level, use_aes)
         if key not in _LEVEL_TO_FRODO:
             raise ValueError(f"FrodoKEM level must be 1/3/5, got {security_level}")
@@ -167,6 +172,7 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
 
             self._kg, self._enc, self._dec = _jax_frodo.get(self.params.name)
             self._max_dispatch = _jax_frodo.MAX_DEVICE_BATCH
+        self._mesh = make_provider_mesh(devices, backend)
         self._native = None
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference);
@@ -200,7 +206,8 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         sec = p.len_sec
         seeds = np.frombuffer(os.urandom(3 * sec * n), np.uint8).reshape(3, n, sec)
         if self.backend == "tpu":
-            return sliced_dispatch(self._kg, self._max_dispatch, seeds[0], seeds[1], seeds[2])
+            return sliced_dispatch(self._kg, self._max_dispatch, seeds[0], seeds[1], seeds[2],
+                                   mesh=self._mesh)
         impl = self._native
         pairs = [
             (impl.keygen(seeds[0, i].tobytes(), seeds[1, i].tobytes(),
@@ -221,7 +228,7 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         mu = np.frombuffer(os.urandom(p.len_sec * n), np.uint8).reshape(n, p.len_sec)
         if self.backend == "tpu":
             return sliced_dispatch(self._enc, self._max_dispatch,
-                                   np.asarray(public_keys), mu)
+                                   np.asarray(public_keys), mu, mesh=self._mesh)
         impl = self._native
         outs = [
             (impl.encaps(public_keys[i].tobytes(), mu[i].tobytes()) if impl
@@ -239,7 +246,8 @@ class FrodoKEMKeyExchange(KeyExchangeAlgorithm):
         p = self.params
         if self.backend == "tpu":
             return sliced_dispatch(self._dec, self._max_dispatch,
-                                   np.asarray(secret_keys), np.asarray(ciphertexts))
+                                   np.asarray(secret_keys), np.asarray(ciphertexts),
+                                   mesh=self._mesh)
         impl = self._native
         return np.stack(
             [
@@ -265,7 +273,8 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
     tpu backends are bit-exact against each other.
     """
 
-    def __init__(self, security_level: int = 1, backend: str = "cpu"):
+    def __init__(self, security_level: int = 1, backend: str = "cpu",
+                 devices: int = 0):
         levels = {1: hqc_ref.HQC128, 3: hqc_ref.HQC192, 5: hqc_ref.HQC256}
         if security_level not in levels:
             raise ValueError(f"HQC level must be 1/3/5, got {security_level}")
@@ -283,6 +292,7 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
 
             self._kg, self._enc, self._dec = _jax_hqc.get(self.params.name)
             self._max_dispatch = _jax_hqc.MAX_DEVICE_BATCH
+        self._mesh = make_provider_mesh(devices, backend)
         self._native = None
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference);
@@ -316,7 +326,8 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         sigma = np.frombuffer(os.urandom(p.k * n), np.uint8).reshape(n, p.k)
         pk_seed = np.frombuffer(os.urandom(40 * n), np.uint8).reshape(n, 40)
         if self.backend == "tpu":
-            return sliced_dispatch(self._kg, self._max_dispatch, sk_seed, sigma, pk_seed)
+            return sliced_dispatch(self._kg, self._max_dispatch, sk_seed, sigma, pk_seed,
+                                   mesh=self._mesh)
         impl = self._native
         pairs = [
             (impl.keygen(sk_seed[i].tobytes(), sigma[i].tobytes(), pk_seed[i].tobytes())
@@ -338,7 +349,7 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         salt = np.frombuffer(os.urandom(16 * n), np.uint8).reshape(n, 16)
         if self.backend == "tpu":
             return sliced_dispatch(self._enc, self._max_dispatch,
-                                   np.asarray(public_keys), m, salt)
+                                   np.asarray(public_keys), m, salt, mesh=self._mesh)
         impl = self._native
         outs = [
             (impl.encaps(public_keys[i].tobytes(), m[i].tobytes(), salt[i].tobytes())
@@ -358,7 +369,8 @@ class HQCKeyExchange(KeyExchangeAlgorithm):
         p = self.params
         if self.backend == "tpu":
             return sliced_dispatch(self._dec, self._max_dispatch,
-                                   np.asarray(secret_keys), np.asarray(ciphertexts))
+                                   np.asarray(secret_keys), np.asarray(ciphertexts),
+                                   mesh=self._mesh)
         impl = self._native
         return np.stack(
             [

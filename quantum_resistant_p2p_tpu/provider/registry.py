@@ -25,9 +25,9 @@ from typing import Callable
 from .base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricAlgorithm
 from .symmetric import AES256GCM, ChaCha20Poly1305
 
-# name -> (factory(backend) -> algorithm, supported_backends)
-_KEMS: dict[str, tuple[Callable[[str], KeyExchangeAlgorithm], tuple[str, ...]]] = {}
-_SIGS: dict[str, tuple[Callable[[str], SignatureAlgorithm], tuple[str, ...]]] = {}
+# name -> (factory(backend, devices) -> algorithm, supported_backends)
+_KEMS: dict[str, tuple[Callable[[str, int], KeyExchangeAlgorithm], tuple[str, ...]]] = {}
+_SIGS: dict[str, tuple[Callable[[str, int], SignatureAlgorithm], tuple[str, ...]]] = {}
 _AEADS: dict[str, Callable[[], SymmetricAlgorithm]] = {
     "AES-256-GCM": AES256GCM,
     "ChaCha20-Poly1305": ChaCha20Poly1305,
@@ -50,18 +50,22 @@ def _resolve_backend(requested: str, supported: tuple[str, ...]) -> str:
     return requested
 
 
-def get_kem(name: str, backend: str = "auto") -> KeyExchangeAlgorithm:
+def get_kem(name: str, backend: str = "auto", devices: int = 0) -> KeyExchangeAlgorithm:
+    """``devices`` > 0 shards tpu-backend batches across a device mesh
+    (Config.mesh_devices); ignored by the cpu backend."""
     if name not in _KEMS:
         raise KeyError(f"unknown KEM {name!r}; known: {sorted(_KEMS)}")
     factory, backends = _KEMS[name]
-    return factory(_resolve_backend(backend, backends))
+    return factory(_resolve_backend(backend, backends), devices)
 
 
-def get_signature(name: str, backend: str = "auto") -> SignatureAlgorithm:
+def get_signature(name: str, backend: str = "auto", devices: int = 0) -> SignatureAlgorithm:
+    """``devices`` > 0 shards tpu-backend batches across a device mesh
+    (Config.mesh_devices); ignored by the cpu backend."""
     if name not in _SIGS:
         raise KeyError(f"unknown signature {name!r}; known: {sorted(_SIGS)}")
     factory, backends = _SIGS[name]
-    return factory(_resolve_backend(backend, backends))
+    return factory(_resolve_backend(backend, backends), devices)
 
 
 def get_symmetric(name: str) -> SymmetricAlgorithm:
@@ -91,36 +95,42 @@ def _register_defaults() -> None:
     for level, name in ((1, "ML-KEM-512"), (3, "ML-KEM-768"), (5, "ML-KEM-1024")):
         register_kem(
             name,
-            lambda backend, _level=level: MLKEMKeyExchange(_level, backend),
+            lambda backend, devices=0, _level=level: MLKEMKeyExchange(
+                _level, backend, devices=devices
+            ),
             ("cpu", "tpu"),
         )
     for level, size in ((1, 640), (3, 976), (5, 1344)):
         for aes in (True, False):
             register_kem(
                 f"FrodoKEM-{size}-{'AES' if aes else 'SHAKE'}",
-                lambda backend, _level=level, _aes=aes: FrodoKEMKeyExchange(
-                    _level, backend, use_aes=_aes
+                lambda backend, devices=0, _level=level, _aes=aes: FrodoKEMKeyExchange(
+                    _level, backend, use_aes=_aes, devices=devices
                 ),
                 ("cpu", "tpu"),
             )
     for level, size in ((1, 128), (3, 192), (5, 256)):
         register_kem(
             f"HQC-{size}",
-            lambda backend, _level=level: HQCKeyExchange(_level, backend),
+            lambda backend, devices=0, _level=level: HQCKeyExchange(
+                _level, backend, devices=devices
+            ),
             ("cpu", "tpu"),
         )
     for level, name in ((2, "ML-DSA-44"), (3, "ML-DSA-65"), (5, "ML-DSA-87")):
         register_signature(
             name,
-            lambda backend, _level=level: MLDSASignature(_level, backend),
+            lambda backend, devices=0, _level=level: MLDSASignature(
+                _level, backend, devices=devices
+            ),
             ("cpu", "tpu"),
         )
     for level, size in ((1, 128), (3, 192), (5, 256)):
         for fast in (True, False):
             register_signature(
                 f"SPHINCS+-SHA2-{size}{'f' if fast else 's'}-simple",
-                lambda backend, _level=level, _fast=fast: SPHINCSSignature(
-                    _level, backend, fast=_fast
+                lambda backend, devices=0, _level=level, _fast=fast: SPHINCSSignature(
+                    _level, backend, fast=_fast, devices=devices
                 ),
                 ("cpu", "tpu"),
             )

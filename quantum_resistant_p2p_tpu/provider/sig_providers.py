@@ -17,7 +17,8 @@ import os
 import numpy as np
 
 from ..pyref import mldsa_ref
-from .base import SignatureAlgorithm, cpu_impl_desc, expect_cols, expect_len, try_native
+from .base import (SignatureAlgorithm, cpu_impl_desc, expect_cols, expect_len,
+                   make_provider_mesh, mesh_dispatch, try_native)
 
 _LEVEL_TO_MLDSA = {2: mldsa_ref.MLDSA44, 3: mldsa_ref.MLDSA65, 5: mldsa_ref.MLDSA87}
 
@@ -34,6 +35,20 @@ _LEVEL_TO_SLH = {
 }
 
 
+class _MeshDispatchMixin:
+    """Routes jitted batch fns through the provider mesh when configured."""
+
+    _mesh = None
+
+    def _dispatch(self, fn, *arrays):
+        if self._mesh is not None:
+            return mesh_dispatch(fn, self._mesh, *arrays)
+        out = fn(*arrays)
+        if isinstance(out, tuple):
+            return tuple(np.asarray(o) for o in out)
+        return np.asarray(out)
+
+
 def _m_prime(message: bytes, ctx: bytes = b"") -> bytes:
     """FIPS 204/205 pure-mode framing: M' = 0x00 || len(ctx) || ctx || M."""
     return bytes([0, len(ctx)]) + ctx + message
@@ -44,10 +59,11 @@ def _mu(tr: bytes, message: bytes, ctx: bytes = b"") -> bytes:
     return hashlib.shake_256(tr + _m_prime(message, ctx)).digest(64)
 
 
-class MLDSASignature(SignatureAlgorithm):
+class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
     """ML-DSA (FIPS 204) at NIST level 2, 3 or 5."""
 
-    def __init__(self, security_level: int = 3, backend: str = "cpu"):
+    def __init__(self, security_level: int = 3, backend: str = "cpu",
+                 devices: int = 0):
         if security_level not in _LEVEL_TO_MLDSA:
             raise ValueError(f"ML-DSA level must be 2/3/5, got {security_level}")
         self.params = _LEVEL_TO_MLDSA[security_level]
@@ -62,6 +78,7 @@ class MLDSASignature(SignatureAlgorithm):
             from ..sig import mldsa as _jax_mldsa  # deferred: pulls in jax
 
             self._kg, self._sign_mu, self._verify_mu = _jax_mldsa.get(self.params.name)
+        self._mesh = make_provider_mesh(devices, backend)
         self._native = None
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference:
@@ -121,8 +138,7 @@ class MLDSASignature(SignatureAlgorithm):
             [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, messages)]
         )
         rnds = np.stack([np.frombuffer(r, np.uint8) for r in rnd])
-        sigs, done = self._sign_mu(np.asarray(secret_keys), mus, rnds)
-        sigs, done = np.asarray(sigs), np.asarray(done)
+        sigs, done = self._dispatch(self._sign_mu, np.asarray(secret_keys), mus, rnds)
         if not done.all():
             # P < 1e-12 per lane; an all-zero sigma must never leave the
             # provider as if it were a signature (ADVICE r1).
@@ -141,10 +157,10 @@ class MLDSASignature(SignatureAlgorithm):
             [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, messages)]
         )
         sigs = np.stack([np.frombuffer(bytes(s), np.uint8) for s in signatures])
-        return np.asarray(self._verify_mu(np.asarray(public_keys), mus, sigs))
+        return self._dispatch(self._verify_mu, np.asarray(public_keys), mus, sigs)
 
 
-class SPHINCSSignature(SignatureAlgorithm):
+class SPHINCSSignature(_MeshDispatchMixin, SignatureAlgorithm):
     """SPHINCS+-SHA2 'f' simple (FIPS 205 SLH-DSA) at NIST level 1, 3 or 5.
 
     Host/device split for the tpu backend: PRF_msg and the variable-length
@@ -152,7 +168,8 @@ class SPHINCSSignature(SignatureAlgorithm):
     hypertree hashing — the actual work — runs as batched JAX programs.
     """
 
-    def __init__(self, security_level: int = 1, backend: str = "cpu", fast: bool = True):
+    def __init__(self, security_level: int = 1, backend: str = "cpu",
+                 fast: bool = True, devices: int = 0):
         key = (security_level, fast)
         if key not in _LEVEL_TO_SLH:
             raise ValueError(f"SPHINCS+ level must be 1/3/5, got {security_level}")
@@ -169,6 +186,7 @@ class SPHINCSSignature(SignatureAlgorithm):
             from ..sig import sphincs as _jax_slh  # deferred: pulls in jax
 
             self._kg, self._sign_digest, self._verify_digest = _jax_slh.get(self.params.name)
+        self._mesh = make_provider_mesh(devices, backend)
         self._native = None
         if backend == "cpu":
             # Native C++ fast path (the role liboqs plays for the reference:
@@ -235,8 +253,8 @@ class SPHINCSSignature(SignatureAlgorithm):
             digests.append(
                 np.frombuffer(slhdsa_ref.h_msg(p, r, pk_seed, pk_root, m), np.uint8)
             )
-        sigs = np.asarray(
-            self._sign_digest(np.asarray(secret_keys), np.stack(rs), np.stack(digests))
+        sigs = self._dispatch(
+            self._sign_digest, np.asarray(secret_keys), np.stack(rs), np.stack(digests)
         )
         return [bytes(s) for s in sigs]
 
@@ -255,6 +273,6 @@ class SPHINCSSignature(SignatureAlgorithm):
                     slhdsa_ref.h_msg(p, r, pkb[: p.n], pkb[p.n :], m), np.uint8
                 )
             )
-        return np.asarray(
-            self._verify_digest(np.asarray(public_keys), np.stack(digests), sigs)
+        return self._dispatch(
+            self._verify_digest, np.asarray(public_keys), np.stack(digests), sigs
         )
