@@ -387,3 +387,16 @@ def test_rsp_parser_roundtrip(tmp_path):
     # per-count DRBG reseed, as PQCgenKAT does before each keypair call
     sub = CtrDrbg(seeds[0])
     assert len(sub.random_bytes(64)) == 64
+
+
+def test_verify_vectors_all_families():
+    """tools/verify_vectors.py over the committed vector dir: every family
+    has at least a fixture exercising its official-format parser + DRBG
+    seam, and everything present passes."""
+    from tools.verify_vectors import verify_directory
+
+    report = verify_directory(VECTOR_DIR)
+    for family, fam in report.items():
+        assert fam["files"], f"{family}: no official-format fixture committed"
+        assert fam["status"] != "FAIL", (family, fam["errors"])
+        assert fam["vectors"] == fam["passed"] > 0

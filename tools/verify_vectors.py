@@ -1,0 +1,397 @@
+"""Check official-format KAT files and report per-family interop status.
+
+The reference inherits liboqs's interop by construction (vendor/oqs.py's
+binary passes NIST KATs upstream); this framework has no egress to fetch the
+official files, so the correctness anchor is layered (docs/correctness.md):
+self-generated cross-implementation vectors now, plus THIS tool — drop
+official ACVP JSON or NIST PQCgenKAT ``.rsp`` files into ``tests/vectors/``
+and it checks every family against the pure-Python oracles and reports, per
+family, whether the anchor is an official file or still a generated fixture.
+
+Formats understood (filename selects the checker):
+
+  acvp_mlkem*.json    ACVP ML-KEM keyGen/encap/decap (d/z/ek/dk, ek/m/c/k)
+  acvp_mldsa*.json    ACVP ML-DSA keyGen/sigGen/sigVer (internal interface)
+  acvp_slhdsa*.json   ACVP SLH-DSA keyGen/sigGen/sigVer (internal interface)
+  *mlkem*.rsp         PQCgenKAT stanzas; DRBG stream d||z, encaps m
+                      (round-3 *Kyber* KATs are NOT accepted: Kyber's
+                      encaps/KDF differ from final FIPS 203)
+  *frodo*.rsp         PQCgenKAT stanzas; DRBG stream s||seedSE||z(16), mu
+  *hqc*.rsp           stanzas with THIS framework's documented seam
+                      (sk_seed||sigma||pk_seed, m||salt) — HQC's official
+                      randombytes order is not reproduced (correctness.md)
+
+Usage: python -m tools.verify_vectors [--vectors-dir DIR] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from quantum_resistant_p2p_tpu.pyref import (  # noqa: E402
+    frodo_ref,
+    hqc_ref,
+    mldsa_ref,
+    mlkem_ref,
+    slhdsa_ref,
+)
+from quantum_resistant_p2p_tpu.utils.ctr_drbg import CtrDrbg  # noqa: E402
+
+VECTOR_DIR = Path(__file__).resolve().parent.parent / "tests" / "vectors"
+
+
+def _acvp_tests(data: dict):
+    for group in data.get("testGroups", []):
+        meta = {k: v for k, v in group.items() if k != "tests"}
+        for t in group.get("tests", []):
+            yield {**meta, **t}
+
+
+def _rsp_stanzas(text: str):
+    rec: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            if rec:
+                yield rec
+                rec = {}
+            continue
+        if "=" in line:
+            k, _, v = line.partition("=")
+            rec[k.strip()] = v.strip()
+    if rec:
+        yield rec
+
+
+def _eq(name: str, actual: bytes, expected_hex: str, errors: list[str]) -> int:
+    if actual.hex() != expected_hex.lower():
+        errors.append(f"{name} mismatch")
+        return 0
+    return 1
+
+
+# -- ACVP JSON checkers ------------------------------------------------------
+
+
+def _param_for(t: dict, data: dict, table: dict, default: str, aliases=None):
+    """Resolve the parameter set for one ACVP test: the per-group
+    ``parameterSet`` (official files use a family-level "algorithm" with the
+    concrete set per group) wins over the file-level "algorithm"."""
+    name = t.get("parameterSet") or data.get("algorithm") or default
+    if aliases and name in aliases:
+        name = aliases[name]
+    return table[name if name in table else default]
+
+
+def check_acvp_mlkem(data: dict) -> tuple[int, int, list[str]]:
+    n = ok = 0
+    errors: list[str] = []
+    for t in _acvp_tests(data):
+        p = _param_for(t, data, mlkem_ref.PARAMS, "ML-KEM-768")
+        if "d" in t and "z" in t:
+            n += 1
+            ek, dk = mlkem_ref.keygen(p, bytes.fromhex(t["d"]), bytes.fromhex(t["z"]))
+            ok += _eq("ek", ek, t["ek"], errors) & _eq("dk", dk, t["dk"], errors)
+        if "m" in t and "ek" in t and "c" in t:
+            n += 1
+            k, c = mlkem_ref.encaps(p, bytes.fromhex(t["ek"]), bytes.fromhex(t["m"]))
+            ok += _eq("c", c, t["c"], errors) & _eq("k", k, t["k"], errors)
+        if "dk" in t and "c" in t and "d" not in t:
+            n += 1
+            k = mlkem_ref.decaps(p, bytes.fromhex(t["dk"]), bytes.fromhex(t["c"]))
+            ok += _eq("k", k, t["k"], errors)
+    return n, ok, errors
+
+
+def check_acvp_mldsa(data: dict) -> tuple[int, int, list[str]]:
+    n = ok = 0
+    errors: list[str] = []
+    for t in _acvp_tests(data):
+        p = _param_for(t, data, mldsa_ref.PARAMS, "ML-DSA-65")
+        if "seed" in t and "pk" in t:  # keyGen
+            n += 1
+            pk, sk = mldsa_ref.keygen(p, bytes.fromhex(t["seed"]))
+            ok += _eq("pk", pk, t["pk"], errors) & _eq("sk", sk, t["sk"], errors)
+        elif "sk" in t and "message" in t and "signature" in t:  # sigGen internal
+            n += 1
+            rnd = bytes.fromhex(t.get("rnd", "00" * 32))
+            sig = mldsa_ref.sign_internal(
+                p, bytes.fromhex(t["sk"]), bytes.fromhex(t["message"]), rnd
+            )
+            ok += _eq("signature", sig, t["signature"], errors)
+        elif "pk" in t and "message" in t and "signature" in t:  # sigVer internal
+            n += 1
+            passed = mldsa_ref.verify_internal(
+                p, bytes.fromhex(t["pk"]), bytes.fromhex(t["message"]),
+                bytes.fromhex(t["signature"]),
+            )
+            if passed == t.get("testPassed", True):
+                ok += 1
+            else:
+                errors.append("sigVer testPassed mismatch")
+    return n, ok, errors
+
+
+#: official ACVP SLH-DSA names -> this repo's registry names
+_SLH_ALIASES = {
+    f"SLH-DSA-SHA2-{size}{v}": f"SPHINCS+-SHA2-{size}{v}-simple"
+    for size in (128, 192, 256) for v in ("s", "f")
+}
+
+
+def check_acvp_slhdsa(data: dict) -> tuple[int, int, list[str]]:
+    n = ok = 0
+    errors: list[str] = []
+    for t in _acvp_tests(data):
+        p = _param_for(t, data, slhdsa_ref.PARAMS,
+                       "SPHINCS+-SHA2-128f-simple", _SLH_ALIASES)
+        if "skSeed" in t:  # keyGen
+            n += 1
+            pk, sk = slhdsa_ref.keygen(
+                p, bytes.fromhex(t["skSeed"]), bytes.fromhex(t["skPrf"]),
+                bytes.fromhex(t["pkSeed"]),
+            )
+            ok += _eq("pk", pk, t["pk"], errors) & _eq("sk", sk, t["sk"], errors)
+        elif "sk" in t and "message" in t and "signature" in t:  # sigGen internal
+            n += 1
+            sig = slhdsa_ref.sign_internal(
+                p, bytes.fromhex(t["message"]), bytes.fromhex(t["sk"]), None
+            )
+            ok += _eq("signature", sig, t["signature"], errors)
+        elif "pk" in t and "message" in t and "signature" in t:  # sigVer
+            n += 1
+            passed = slhdsa_ref.verify_internal(
+                p, bytes.fromhex(t["message"]), bytes.fromhex(t["signature"]),
+                bytes.fromhex(t["pk"]),
+            )
+            if passed == t.get("testPassed", True):
+                ok += 1
+            else:
+                errors.append("sigVer testPassed mismatch")
+    return n, ok, errors
+
+
+# -- PQCgenKAT .rsp checkers -------------------------------------------------
+#
+# PQCgenKAT_kem.c seeds an AES-256 CTR-DRBG per stanza and the algorithm's
+# randombytes() calls consume its stream in a fixed order; the split below is
+# each family's documented order (docs/correctness.md "DRBG seam" notes).
+
+
+def _algo_from_rsp(fname: str, table: dict[str, str], default: str) -> str:
+    low = fname.lower()
+    for key, algo in table.items():
+        if key in low:
+            return algo
+    return default
+
+
+def check_rsp_mlkem(text: str, fname: str) -> tuple[int, int, list[str]]:
+    algo = _algo_from_rsp(
+        fname,
+        {"512": "ML-KEM-512", "768": "ML-KEM-768", "1024": "ML-KEM-1024"},
+        "ML-KEM-768",
+    )
+    p = mlkem_ref.PARAMS[algo]
+    n = ok = 0
+    errors: list[str] = []
+    for rec in _rsp_stanzas(text):
+        if "seed" not in rec:
+            continue
+        n += 1
+        drbg = CtrDrbg(bytes.fromhex(rec["seed"]))
+        d, z = drbg.random_bytes(32), drbg.random_bytes(32)
+        ek, dk = mlkem_ref.keygen(p, d, z)
+        m = drbg.random_bytes(32)
+        k, c = mlkem_ref.encaps(p, ek, m)
+        good = 1
+        if "pk" in rec:
+            good &= _eq("pk", ek, rec["pk"], errors)
+        if "sk" in rec:
+            good &= _eq("sk", dk, rec["sk"], errors)
+        if "ct" in rec:
+            good &= _eq("ct", c, rec["ct"], errors)
+        if "ss" in rec:
+            good &= _eq("ss", k, rec["ss"], errors)
+        ok += good
+    return n, ok, errors
+
+
+def check_rsp_frodo(text: str, fname: str) -> tuple[int, int, list[str]]:
+    algo = _algo_from_rsp(
+        fname,
+        {
+            "640-aes": "FrodoKEM-640-AES", "640aes": "FrodoKEM-640-AES",
+            "640-shake": "FrodoKEM-640-SHAKE", "640shake": "FrodoKEM-640-SHAKE",
+            "976-aes": "FrodoKEM-976-AES", "976aes": "FrodoKEM-976-AES",
+            "976-shake": "FrodoKEM-976-SHAKE", "976shake": "FrodoKEM-976-SHAKE",
+            "1344-aes": "FrodoKEM-1344-AES", "1344aes": "FrodoKEM-1344-AES",
+            "1344-shake": "FrodoKEM-1344-SHAKE", "1344shake": "FrodoKEM-1344-SHAKE",
+        },
+        "FrodoKEM-640-SHAKE",
+    )
+    p = frodo_ref.PARAMS[algo]
+    n = ok = 0
+    errors: list[str] = []
+    for rec in _rsp_stanzas(text):
+        if "seed" not in rec:
+            continue
+        n += 1
+        drbg = CtrDrbg(bytes.fromhex(rec["seed"]))
+        # crypto_kem_keypair: one randombytes(2*CRYPTO_BYTES + BYTES_SEED_A)
+        # call, split s || seedSE || z (z is 16 bytes at every level).
+        r = drbg.random_bytes(2 * p.len_sec + 16)
+        s, seed_se, z = r[: p.len_sec], r[p.len_sec : 2 * p.len_sec], r[2 * p.len_sec :]
+        pk, sk = frodo_ref.keygen(p, s, seed_se, z)
+        mu = drbg.random_bytes(p.len_sec)
+        ct, ss = frodo_ref.encaps(p, pk, mu)
+        good = 1
+        if "pk" in rec:
+            good &= _eq("pk", pk, rec["pk"], errors)
+        if "sk" in rec:
+            good &= _eq("sk", sk, rec["sk"], errors)
+        if "ct" in rec:
+            good &= _eq("ct", ct, rec["ct"], errors)
+        if "ss" in rec:
+            good &= _eq("ss", ss, rec["ss"], errors)
+        ok += good
+    return n, ok, errors
+
+
+def check_rsp_hqc(text: str, fname: str) -> tuple[int, int, list[str]]:
+    algo = _algo_from_rsp(
+        fname, {"128": "HQC-128", "192": "HQC-192", "256": "HQC-256"}, "HQC-128"
+    )
+    p = hqc_ref.PARAMS[algo]
+    n = ok = 0
+    errors: list[str] = []
+    for rec in _rsp_stanzas(text):
+        if "seed" not in rec:
+            continue
+        n += 1
+        drbg = CtrDrbg(bytes.fromhex(rec["seed"]))
+        # THIS framework's seam (pyref.hqc_ref docstring): official HQC's
+        # randombytes order is not reproduced, so official .rsp files are
+        # expected to FAIL here — the report marks the family accordingly.
+        sk_seed, sigma, pk_seed = (
+            drbg.random_bytes(40), drbg.random_bytes(p.k), drbg.random_bytes(40)
+        )
+        pk, sk = hqc_ref.keygen(p, sk_seed, sigma, pk_seed)
+        m, salt = drbg.random_bytes(p.k), drbg.random_bytes(16)
+        ct, ss = hqc_ref.encaps(p, pk, m, salt)
+        good = 1
+        if "pk" in rec:
+            good &= _eq("pk", pk, rec["pk"], errors)
+        if "sk" in rec:
+            good &= _eq("sk", sk, rec["sk"], errors)
+        if "ct" in rec:
+            good &= _eq("ct", ct, rec["ct"], errors)
+        if "ss" in rec:
+            good &= _eq("ss", ss, rec["ss"], errors)
+        ok += good
+    return n, ok, errors
+
+
+# -- discovery + report ------------------------------------------------------
+
+FAMILY_PATTERNS = [
+    ("ML-KEM", "acvp_mlkem*.json", "acvp", check_acvp_mlkem),
+    ("ML-DSA", "acvp_mldsa*.json", "acvp", check_acvp_mldsa),
+    ("SLH-DSA", "acvp_slhdsa*.json", "acvp", check_acvp_slhdsa),
+    # NOTE: no "*kyber*.rsp" pattern on purpose — round-3 Kyber KATs cannot
+    # match FIPS 203 ML-KEM (different encaps hashing / KDF); routing them
+    # here would report a spurious FAIL.
+    ("ML-KEM", "*mlkem*.rsp", "rsp", check_rsp_mlkem),
+    ("FrodoKEM", "*frodo*.rsp", "rsp", check_rsp_frodo),
+    ("HQC", "*hqc*.rsp", "rsp", check_rsp_hqc),
+]
+
+FAMILIES = ["ML-KEM", "ML-DSA", "SLH-DSA", "FrodoKEM", "HQC"]
+
+#: families whose official .rsp randomness seam is documented as NOT
+#: reproduced (docs/correctness.md): official-file mismatches are expected
+#: and reported as a distinct status, not a hard FAIL
+EXPECTED_OFFICIAL_FAIL = {"HQC"}
+
+
+def _is_fixture(path: Path) -> bool:
+    if "fixture" in path.name.lower():
+        return True
+    head = path.read_text()[:512]
+    return "qrp2p" in head.lower()
+
+
+def verify_directory(vector_dir: Path) -> dict:
+    per_family: dict[str, dict] = {
+        f: {"files": [], "vectors": 0, "passed": 0, "official_files": 0,
+            "fixture_failures": 0, "official_failures": 0, "errors": []}
+        for f in FAMILIES
+    }
+    seen: set[Path] = set()
+    for family, pattern, kind, checker in FAMILY_PATTERNS:
+        for path in sorted(vector_dir.glob(pattern)):
+            if path in seen:
+                continue
+            seen.add(path)
+            if kind == "acvp":
+                n, ok, errors = checker(json.loads(path.read_text()))
+            else:
+                n, ok, errors = checker(path.read_text(), path.name)
+            fixture = _is_fixture(path)
+            fam = per_family[family]
+            fam["files"].append(path.name)
+            fam["vectors"] += n
+            fam["passed"] += ok
+            fam["errors"] += [f"{path.name}: {e}" for e in errors[:5]]
+            if fixture:
+                fam["fixture_failures"] += n - ok
+            else:
+                fam["official_files"] += 1
+                fam["official_failures"] += n - ok
+    for family, fam in per_family.items():
+        if not fam["files"]:
+            fam["status"] = "no files"
+        elif fam["fixture_failures"]:
+            fam["status"] = "FAIL"
+        elif fam["official_failures"]:
+            # A failing official file is a hard FAIL unless the family's
+            # seam is documented as unverified (expected until confirmed).
+            fam["status"] = (
+                "official vectors DO NOT match — seam unverified "
+                "(expected for this family; docs/correctness.md)"
+                if family in EXPECTED_OFFICIAL_FAIL
+                else "FAIL"
+            )
+        elif fam["official_files"]:
+            fam["status"] = "official vectors pass"
+        else:
+            fam["status"] = "fixtures pass (official files not yet dropped in)"
+    return per_family
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vectors-dir", default=str(VECTOR_DIR))
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+    report = verify_directory(Path(args.vectors_dir))
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for family, fam in report.items():
+            print(f"{family:10s} {fam['status']:45s} "
+                  f"{fam['passed']}/{fam['vectors']} vectors, "
+                  f"files: {', '.join(fam['files']) or '-'}")
+            for e in fam["errors"]:
+                print(f"           ! {e}")
+    bad = any(f["status"] == "FAIL" for f in report.values())
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
