@@ -120,11 +120,19 @@ class SecureMessaging:
         self._batch_cfg = (max_batch, max_wait_ms)
         self._bkem = self._bsig = None
         self._warmup_thread = None
+        self._queue_breaker = None
         if use_batching:
-            from ..provider.batched import BatchedKEM, BatchedSignature
+            from ..provider.batched import BatchedKEM, BatchedSignature, Breaker
 
-            self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms)
-            self._bsig = BatchedSignature(self.signature, max_batch, max_wait_ms)
+            # one breaker across KEM and signature queues: they share the
+            # device, so either discovering slowness shields both
+            self._queue_breaker = Breaker()
+            self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms,
+                                    fallback=self._cpu_fallback_kem(),
+                                    breaker=self._queue_breaker)
+            self._bsig = BatchedSignature(self.signature, max_batch, max_wait_ms,
+                                          fallback=self._cpu_fallback_sig(),
+                                          breaker=self._queue_breaker)
             self._spawn_warmup()
 
         # per-peer protocol state
@@ -340,6 +348,29 @@ class SecureMessaging:
             logger.warning("key exchange with %s failed: %s", peer_id[:8], e)
             self._cleanup_exchange(message_id, peer_id)
             return False
+
+    def _cpu_fallback_kem(self):
+        """cpu-backend twin of the active KEM, arming the batch queue's
+        degrade-don't-fail path (device slow/hung -> ops run on cpu instead
+        of failing their protocol timeouts).  None when the active provider
+        IS the cpu one — no point falling back to itself."""
+        if getattr(self.kem, "backend", "") != "tpu":
+            return None
+        try:
+            return get_kem(self.kem.name, "cpu")
+        except Exception:
+            logger.exception("no cpu fallback for %s", self.kem.name)
+            return None
+
+    def _cpu_fallback_sig(self):
+        """cpu-backend twin of the active signature (see _cpu_fallback_kem)."""
+        if getattr(self.signature, "backend", "") != "tpu":
+            return None
+        try:
+            return get_signature(self.signature.name, "cpu")
+        except Exception:
+            logger.exception("no cpu fallback for %s", self.signature.name)
+            return None
 
     def _spawn_warmup(self, kem: bool = True, sig: bool = True) -> None:
         """Precompile batched providers' size-1 buckets in the background so
@@ -704,7 +735,9 @@ class SecureMessaging:
         if self.use_batching:
             from ..provider.batched import BatchedKEM
 
-            self._bkem = BatchedKEM(self.kem, *self._batch_cfg)
+            self._bkem = BatchedKEM(self.kem, *self._batch_cfg,
+                                    fallback=self._cpu_fallback_kem(),
+                                    breaker=self._queue_breaker)
             self._spawn_warmup(kem=True, sig=False)
         peers = list(self.shared_keys)
         self.shared_keys.clear()
@@ -737,7 +770,9 @@ class SecureMessaging:
         if self.use_batching:
             from ..provider.batched import BatchedSignature
 
-            self._bsig = BatchedSignature(self.signature, *self._batch_cfg)
+            self._bsig = BatchedSignature(self.signature, *self._batch_cfg,
+                                           fallback=self._cpu_fallback_sig(),
+                                           breaker=self._queue_breaker)
             self._spawn_warmup(kem=False, sig=True)
         self._sig_keypair = self._load_or_generate_sig_keypair()
         self._log("crypto_settings_changed", component="signature", algorithm=name)

@@ -6,7 +6,8 @@ import numpy as np
 import pytest
 
 from quantum_resistant_p2p_tpu.provider import get_kem, get_signature
-from quantum_resistant_p2p_tpu.provider.batched import BatchedKEM, BatchedSignature, OpQueue
+from quantum_resistant_p2p_tpu.provider.batched import (BatchedKEM,
+    BatchedSignature, Breaker, OpQueue)
 
 
 def test_opqueue_coalesces_and_resolves():
@@ -86,3 +87,115 @@ def test_batched_signature_end_to_end():
 
     oks, bad = asyncio.run(run())
     assert all(oks) and not bad
+
+
+def test_opqueue_slow_dispatch_trips_breaker_to_fallback():
+    """A slow device dispatch opens the breaker; later flushes run on the
+    fallback, and after the cool-off the device path is probed again."""
+    import time as _time
+
+    device_calls, fb_calls = [], []
+
+    def slow_device(items):
+        device_calls.append(len(items))
+        _time.sleep(0.05)  # > degrade_after_ms
+        return [("dev", x) for x in items]
+
+    def fallback(items):
+        fb_calls.append(len(items))
+        return [("cpu", x) for x in items]
+
+    async def run():
+        q = OpQueue(slow_device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=fallback, degrade_after_ms=10.0,
+                    dispatch_timeout_ms=5000.0, breaker=Breaker(cooloff_s=0.2))
+        q._warm_buckets.add(1)  # steady state: bucket already compiled
+        a = await q.submit(1)            # slow -> served by device, trips breaker
+        b = await q.submit(2)            # breaker open -> fallback
+        c = await q.submit(3)            # still open -> fallback
+        await asyncio.sleep(0.25)        # cool-off expires
+        d = await q.submit(4)            # probe: device again (still slow)
+        e = await q.submit(5)            # re-opened -> fallback
+        return a, b, c, d, e, q.stats
+
+    a, b, c, d, e, st = asyncio.run(run())
+    assert a == ("dev", 1) and d == ("dev", 4)
+    assert b == ("cpu", 2) and c == ("cpu", 3) and e == ("cpu", 5)
+    assert st.fallback_ops == 3 and st.breaker_trips == 2
+    assert device_calls == [1, 1] and fb_calls == [1, 1, 1]
+
+
+def test_opqueue_hung_dispatch_times_out_to_fallback():
+    """A hung device call is abandoned (finishes in background) and its ops
+    are served by the fallback — no waiter ever fails."""
+    import threading
+
+    hang = threading.Event()
+
+    def hung_device(items):
+        hang.wait(5.0)
+        return [("dev", x) for x in items]
+
+    def fallback(items):
+        return [("cpu", x) for x in items]
+
+    async def run():
+        q = OpQueue(hung_device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=fallback, degrade_after_ms=1000.0,
+                    dispatch_timeout_ms=50.0, compile_timeout_ms=50.0,
+                    breaker=Breaker(cooloff_s=10.0))
+        out = await asyncio.wait_for(q.submit(7), timeout=2.0)
+        st = q.stats
+        return out, st
+
+    out, st = asyncio.run(run())
+    hang.set()  # release the background thread
+    assert out == ("cpu", 7)
+    assert st.fallback_ops == 1 and st.breaker_trips == 1
+
+
+def test_batched_kem_fallback_results_interoperate():
+    """cpu-fallback results are protocol-compatible with the device path:
+    a keypair produced through the fallback decapsulates a device-encaps."""
+    tpu = get_kem("ML-KEM-512", backend="tpu")
+    cpu = get_kem("ML-KEM-512", backend="cpu")
+
+    # Force every flush onto the fallback via an always-open breaker.
+    kem = BatchedKEM(tpu, max_batch=4, max_wait_ms=1.0, fallback=cpu,
+                     degrade_after_ms=0.0, cooloff_s=60.0)
+    for q in (kem._kg, kem._enc, kem._dec):
+        q._warm_buckets.add(1)  # cold-compile exemption off: trip on any slow
+
+    async def run():
+        pk, sk = await kem.generate_keypair()   # device (trips breaker after)
+        ct, ss = await kem.encapsulate(pk)      # fallback (cpu)
+        ss2 = await kem.decapsulate(sk, ct)     # fallback (cpu)
+        return ss, ss2, kem.stats()
+
+    ss, ss2, st = asyncio.run(run())
+    assert ss == ss2
+    assert st["encaps"]["fallback_ops"] + st["decaps"]["fallback_ops"] >= 1
+
+
+def test_opqueue_cold_bucket_exempt_from_breaker():
+    """A bucket's FIRST dispatch (jit compile) never trips the breaker and
+    gets the generous compile timeout; the second slow dispatch trips."""
+    import time as _time
+
+    def slow_device(items):
+        _time.sleep(0.03)
+        return items
+
+    async def run():
+        q = OpQueue(slow_device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: items, degrade_after_ms=5.0,
+                    dispatch_timeout_ms=10000.0, compile_timeout_ms=5000.0,
+                    breaker=Breaker(cooloff_s=60.0))
+        await q.submit(1)                      # cold: slow but exempt
+        assert q.breaker.trips == 0 and 1 in q._warm_buckets
+        await q.submit(2)                      # warm: slow -> trips
+        assert q.breaker.trips == 1
+        return q.stats
+
+    st = asyncio.run(run())
+    assert st.fallback_ops == 0  # both ran on the device
