@@ -1,0 +1,211 @@
+"""Bitsliced AES-128-ECB — table-free boolean circuits on the TPU VPU.
+
+The gather S-box (core/aes.py) is the canonical TPU anti-pattern: per-lane
+dynamic ``jnp.take`` serialises, and FrodoKEM-AES runs 2.6M of them per
+640x640 A-matrix (bench_report config 3: 15 encaps/s).  Bitslicing is the
+canonical counter: the state is held as 128 bit-planes packed 32 blocks per
+uint32 lane, SubBytes becomes a boolean circuit evaluated on whole planes
+(pure AND/XOR — ideal VPU material), ShiftRows a static plane permutation,
+MixColumns a handful of plane XORs.
+
+The S-box circuit is DERIVED, not transcribed: squaring and the affine map
+are GF(2^8)-linear (8x8 bit matrices computed from the field at import),
+multiplication is schoolbook partial products + a computed reduction
+matrix, and inversion is the 4-multiply/7-square addition chain for
+b^254 = b^-1.  ~700 plane-ops per SubBytes vs 113 for the hand-optimised
+Boyar-Peralta circuit — 6x off optimal gate count but orders of magnitude
+off the gather path, and verifiable against the classic table construction
+(tests/test_frodo.py drives both against the OpenSSL oracle).
+
+Layout: state planes (8 bits, 16 bytes, *lead, W) uint32, W = ceil(B/32)
+blocks packed along the minor axis; round keys broadcast over W.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .aes import _SBOX, key_schedule  # noqa: F401 (key_schedule re-exported)
+
+_POLY = 0x11B
+
+
+def _gf_mul_int(a: int, b: int) -> int:
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a & 0x100:
+            a ^= _POLY
+    return r
+
+
+def _linear_matrix(fn) -> np.ndarray:
+    """8x8 bit matrix M of a GF(2)-linear byte map: out_bit[i] spans M[i]."""
+    m = np.zeros((8, 8), dtype=np.uint8)
+    for j in range(8):
+        out = fn(1 << j)
+        for i in range(8):
+            m[i, j] = (out >> i) & 1
+    return m
+
+
+_SQ = _linear_matrix(lambda x: _gf_mul_int(x, x))
+# affine part of the S-box: y = A(x) ^ 0x63 with A(x) = x ^ rotl1..rotl4
+_AFF = _linear_matrix(
+    lambda x: x ^ (((x << 1) | (x >> 7)) & 0xFF) ^ (((x << 2) | (x >> 6)) & 0xFF)
+    ^ (((x << 3) | (x >> 5)) & 0xFF) ^ (((x << 4) | (x >> 4)) & 0xFF)
+)
+# x^(8+k) mod poly, k = 0..6 — reduction rows for schoolbook products
+_RED = np.zeros((7, 8), dtype=np.uint8)
+for _k in range(7):
+    _v = 1 << (8 + _k)
+    # reduce by repeated xor of shifted modulus
+    for _sh in range(6, -1, -1):
+        if _v & (0x100 << _sh):
+            _v ^= _POLY << _sh
+    for _i in range(8):
+        _RED[_k, _i] = (_v >> _i) & 1
+
+# ShiftRows on column-major state bytes (same table as core/aes.py)
+_SHIFT = np.array([0, 5, 10, 15, 4, 9, 14, 3, 8, 13, 2, 7, 12, 1, 6, 11])
+
+_POW2 = (1 << np.arange(32, dtype=np.uint32)).astype(np.uint32)
+
+
+def _apply_linear(m: np.ndarray, x: list) -> list:
+    """Bit-matrix times bit-plane vector: out[i] = XOR_j m[i,j] & x[j]."""
+    out = []
+    for i in range(8):
+        acc = None
+        for j in range(8):
+            if m[i, j]:
+                acc = x[j] if acc is None else acc ^ x[j]
+        out.append(acc if acc is not None else jnp.zeros_like(x[0]))
+    return out
+
+
+def _mul_planes(a: list, b: list) -> list:
+    """GF(2^8) product of two bit-plane bytes (schoolbook + reduction)."""
+    c = [None] * 15
+    for i in range(8):
+        for j in range(8):
+            t = a[i] & b[j]
+            k = i + j
+            c[k] = t if c[k] is None else c[k] ^ t
+    out = list(c[:8])
+    for k in range(7):  # fold x^(8+k) back via the reduction matrix
+        for i in range(8):
+            if _RED[k, i]:
+                out[i] = out[i] ^ c[8 + k]
+    return out
+
+
+def _sq_planes(x: list) -> list:
+    return _apply_linear(_SQ, x)
+
+
+def _sbox_planes(x: list) -> list:
+    """S(x) = Affine(x^254) ^ 0x63, all on bit planes."""
+    b2 = _sq_planes(x)                     # x^2
+    b3 = _mul_planes(b2, x)                # x^3
+    b12 = _sq_planes(_sq_planes(b3))       # x^12
+    b15 = _mul_planes(b12, b3)             # x^15
+    b240 = b15
+    for _ in range(4):                     # x^240
+        b240 = _sq_planes(b240)
+    b252 = _mul_planes(b240, b12)          # x^252
+    b254 = _mul_planes(b252, b2)           # x^254 = x^-1
+    y = _apply_linear(_AFF, b254)
+    # ^ 0x63: flip bits 0, 1, 5, 6
+    for i in (0, 1, 5, 6):
+        y[i] = ~y[i]
+    return y
+
+
+def _xtime_planes(a: list) -> list:
+    """xtime on bit planes: shift up, fold 0x1B on the old high bit."""
+    hi = a[7]
+    out = [hi, a[0] ^ hi, a[1], a[2] ^ hi, a[3] ^ hi, a[4], a[5], a[6]]
+    return out
+
+
+def _mix_columns(s: jax.Array) -> jax.Array:
+    """s (8, 16, ...) -> mixed; bytes are column-major (byte = row + 4*col)."""
+    c = s.reshape((8, 4, 4) + s.shape[2:])  # (bit, col, row, ...)
+    a = [[c[i, :, r] for i in range(8)] for r in range(4)]  # [row][bit]
+    x = [_xtime_planes(a[r]) for r in range(4)]
+    rows = []
+    for r in range(4):
+        r1, r2, r3 = (r + 1) % 4, (r + 2) % 4, (r + 3) % 4
+        rows.append([
+            x[r][i] ^ x[r1][i] ^ a[r1][i] ^ a[r2][i] ^ a[r3][i]
+            for i in range(8)
+        ])
+    out = jnp.stack(
+        [jnp.stack(rows[r], axis=0) for r in range(4)], axis=2
+    )  # (bit, col, row, ...)
+    return out.reshape(s.shape)
+
+
+def pack_blocks(blocks: jax.Array) -> tuple[jax.Array, int]:
+    """(*lead, B, 16) uint8 -> planes (8, 16, *lead, W) uint32, original B.
+
+    Blocks pack 32-per-uint32 along the minor axis (padded with zeros).
+    """
+    lead = blocks.shape[:-2]
+    b = blocks.shape[-2]
+    w = -(-b // 32)
+    if w * 32 != b:
+        pad = [(0, 0)] * len(lead) + [(0, w * 32 - b), (0, 0)]
+        blocks = jnp.pad(blocks, pad)
+    x = blocks.astype(jnp.uint32)  # (*lead, W*32, 16)
+    bits = (x[..., None] >> jnp.arange(8, dtype=jnp.uint32)) & 1  # (*l, B, 16, 8)
+    bits = jnp.moveaxis(bits, (-1, -2), (0, 1))  # (8, 16, *lead, W*32)
+    bits = bits.reshape(bits.shape[:-1] + (w, 32))
+    planes = jnp.sum(bits * jnp.asarray(_POW2), axis=-1, dtype=jnp.uint32)
+    return planes, b
+
+
+def unpack_blocks(planes: jax.Array, b: int) -> jax.Array:
+    """planes (8, 16, *lead, W) uint32 -> (*lead, B, 16) uint8."""
+    w = planes.shape[-1]
+    bits = (planes[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+    bits = bits.reshape(planes.shape[:-1] + (w * 32,))  # (8, 16, *lead, B)
+    bits = jnp.moveaxis(bits, (0, 1), (-1, -2))  # (*lead, B, 16, 8)
+    vals = jnp.sum(bits << jnp.arange(8, dtype=jnp.uint32), axis=-1)
+    return vals[..., :b, :].astype(jnp.uint8)
+
+
+def _key_planes(round_keys: jax.Array) -> jax.Array:
+    """(*lead, 11, 16) uint8 -> (11, 8, 16, *lead, 1) uint32 (0/~0 masks)."""
+    x = round_keys.astype(jnp.uint32)
+    bits = (x[..., None] >> jnp.arange(8, dtype=jnp.uint32)) & 1
+    bits = jnp.moveaxis(bits, (-3, -1, -2), (0, 1, 2))  # (11, 8, 16, *lead)
+    # 0 -> 0x00000000, 1 -> 0xFFFFFFFF so XOR applies the bit to all 32 lanes
+    return (bits * jnp.uint32(0xFFFFFFFF))[..., None]
+
+
+def encrypt_blocks(round_keys: jax.Array, blocks: jax.Array) -> jax.Array:
+    """Drop-in for core.aes.encrypt_blocks, bitsliced.
+
+    round_keys (*lead, 11, 16), blocks (*lead, B, 16) uint8 -> (*lead, B, 16).
+    """
+    rk = _key_planes(round_keys)
+    s, b = pack_blocks(blocks)
+    s = s ^ rk[0]
+    for r in range(1, 10):
+        bit_list = _sbox_planes([s[i] for i in range(8)])
+        s = jnp.stack(bit_list, axis=0)
+        s = s[:, _SHIFT]
+        s = _mix_columns(s)
+        s = s ^ rk[r]
+    bit_list = _sbox_planes([s[i] for i in range(8)])
+    s = jnp.stack(bit_list, axis=0)
+    s = s[:, _SHIFT]
+    s = s ^ rk[10]
+    return unpack_blocks(s, b)

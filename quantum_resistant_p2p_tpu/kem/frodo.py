@@ -30,8 +30,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import aes as jaes
+from ..core import aes_bitsliced as jaes_bs
 from ..core import keccak
 from ..pyref.frodo_ref import NBAR, PARAMS, FrodoParams
+
+
+def _use_bitsliced_aes() -> bool:
+    """Bitsliced (table-free) AES by default; QRP2P_AES_GATHER=1 restores the
+    gather S-box for A/B runs.  Read at TRACE time (jit caches the choice) —
+    flip only in a fresh process, same caveat as QRP2P_PALLAS."""
+    import os
+
+    return os.environ.get("QRP2P_AES_GATHER", "0") != "1"
 
 N_CHUNKS = 16  # A-matrix row chunks (n is divisible by 16 in all sets)
 
@@ -123,7 +133,8 @@ def _gen_a_chunk(p: FrodoParams, ctx, row_start: int, nrows: int) -> jax.Array:
             pt[r, :, 3] = cols >> 8
         blocks = jnp.asarray(pt.reshape(-1, 16))
         blocks = jnp.broadcast_to(blocks, rk.shape[:-2] + blocks.shape)
-        ct = jaes.encrypt_blocks(rk, blocks)
+        aes_impl = jaes_bs if _use_bitsliced_aes() else jaes
+        ct = aes_impl.encrypt_blocks(rk, blocks)
         vals = _le16(ct.reshape(ct.shape[:-2] + (-1,)))
         return vals.reshape(vals.shape[:-1] + (nrows, p.n)) & mask
     seed_a = ctx

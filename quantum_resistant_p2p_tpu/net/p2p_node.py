@@ -28,6 +28,10 @@ from typing import Any, Awaitable, Callable
 
 logger = logging.getLogger(__name__)
 
+#: hello-response window; generous because a peer's loop can stall for a
+#: few seconds behind a background jit compile (provider/batched.py)
+HELLO_TIMEOUT = 15.0
+
 _MAGIC = b"QP"
 _VERSION = 1
 _FLAG_CHUNK = 0x01
@@ -128,8 +132,23 @@ class P2PNode:
 
     # -- connecting ----------------------------------------------------------
 
-    async def connect_to_peer(self, host: str, port: int, timeout: float = 10.0) -> str | None:
-        """Dial a peer, run the hello handshake, return its node id."""
+    async def connect_to_peer(self, host: str, port: int, timeout: float = 10.0,
+                              retries: int = 2) -> str | None:
+        """Dial a peer, run the hello handshake, return its node id.
+
+        A busy peer (e.g. its loop briefly stalled by a background jit
+        compile, provider/batched.py) may miss the hello window; transient
+        failures are retried with backoff before giving up — one-shot
+        connects under load were the reference harness's flakiest edge.
+        """
+        for attempt in range(retries + 1):
+            peer_id = await self._connect_once(host, port, timeout)
+            if peer_id is not None or attempt == retries:
+                return peer_id
+            await asyncio.sleep(0.5 * (attempt + 1))
+        return None
+
+    async def _connect_once(self, host: str, port: int, timeout: float) -> str | None:
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, port), timeout
@@ -143,7 +162,7 @@ class P2PNode:
                 asyncio.Lock(),
                 {"type": "__hello__", "node_id": self.node_id, "listen_port": self.port},
             )
-            hello = await asyncio.wait_for(self._read_plain_frame(reader), 5.0)
+            hello = await asyncio.wait_for(self._read_plain_frame(reader), HELLO_TIMEOUT)
             if hello.get("type") != "__hello__":
                 raise ValueError("bad hello")
         except Exception as e:
@@ -157,7 +176,7 @@ class P2PNode:
     async def _on_inbound(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         addr = writer.get_extra_info("peername") or ("?", 0)
         try:
-            hello = await asyncio.wait_for(self._read_plain_frame(reader), 5.0)
+            hello = await asyncio.wait_for(self._read_plain_frame(reader), HELLO_TIMEOUT)
             if hello.get("type") != "__hello__":
                 raise ValueError("bad hello")
             await self._send_frame(

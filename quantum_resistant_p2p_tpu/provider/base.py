@@ -45,8 +45,7 @@ def cpu_impl_desc(native_obj) -> str:
     return "native C++ CPU" if native_obj is not None else "pure-Python CPU"
 
 
-def next_pow2(n: int) -> int:
-    return 1 << (n - 1).bit_length() if n > 1 else 1
+from ..utils import next_pow2  # noqa: E402  (canonical shared helper)
 
 
 def pad_rows(rows: np.ndarray, target: int) -> np.ndarray:

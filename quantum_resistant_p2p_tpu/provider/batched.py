@@ -79,10 +79,14 @@ class Breaker:
     signature facades together) share one breaker: the device/tunnel is the
     common resource, so one op type discovering slowness shields the rest.
 
-    The breaker also owns the DEVICE executor: a dedicated 2-thread pool so
-    that hung, abandoned device dispatches can never starve the default
-    executor the cpu fallback runs on (at most 2 threads can ever be stuck;
-    further probes queue behind them, time out, and fall back).
+    The breaker also owns TWO executors: a 2-thread DEVICE pool for live
+    dispatches (normal priority — steady-state dispatches must not be
+    starved by the cpu fallback's own load, or the post-cooloff probe
+    measures starvation instead of the device) and a 1-thread WARMUP pool
+    at nice 19 for cold-bucket jit compiles, whose host-side CPU burn would
+    otherwise starve the event loop and the fallback.  Hung, abandoned
+    dispatches occupy at most the 2 device threads; they can never starve
+    the default executor the fallback runs on.
     """
 
     def __init__(self, cooloff_s: float = 30.0):
@@ -90,6 +94,7 @@ class Breaker:
         self.trips = 0
         self._open_until = 0.0
         self._executor = None
+        self._warmup_executor = None
 
     def is_open(self) -> bool:
         return time.monotonic() < self._open_until
@@ -107,6 +112,26 @@ class Breaker:
                 max_workers=2, thread_name_prefix="qrp2p-device"
             )
         return self._executor
+
+    @property
+    def warmup_executor(self):
+        if self._warmup_executor is None:
+            import os
+            from concurrent.futures import ThreadPoolExecutor
+
+            def _background_priority():
+                # Linux nice() is per-thread: demote the compile worker so
+                # cold-bucket jit never preempts the loop or the fallback.
+                try:
+                    os.nice(19)
+                except OSError:  # pragma: no cover
+                    pass
+
+            self._warmup_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="qrp2p-warmup",
+                initializer=_background_priority,
+            )
+        return self._warmup_executor
 
 
 class OpQueue:
@@ -134,7 +159,7 @@ class OpQueue:
         fallback_fn: Callable[[list[Any]], list[Any]] | None = None,
         degrade_after_ms: float = 2000.0,
         dispatch_timeout_ms: float = 15000.0,
-        compile_timeout_ms: float = 180000.0,
+        degrade_ref_batch: int = 256,
         breaker: Breaker | None = None,
     ):
         self.batch_fn = batch_fn
@@ -143,11 +168,17 @@ class OpQueue:
         self.fallback_fn = fallback_fn
         self.degrade_after_s = degrade_after_ms / 1e3
         self.dispatch_timeout_s = dispatch_timeout_ms / 1e3
-        self.compile_timeout_s = compile_timeout_ms / 1e3
+        #: thresholds are for a <= degrade_ref_batch flush and scale
+        #: linearly above it — a 4096-row dispatch is ALLOWED to take 16x
+        #: longer than a 256-row one before it counts as "slow"; without
+        #: this, peak load (big healthy batches) trips the breaker forever
+        self.degrade_ref_batch = degrade_ref_batch
         self.breaker = breaker if breaker is not None else Breaker()
-        #: pow2 sizes whose device program has completed at least once
-        #: (first dispatch of a bucket = jit compile, exempt from the breaker)
+        #: pow2 sizes whose device program has completed at least once; a
+        #: cold bucket's ops are served by the fallback while the compile
+        #: runs in the background (never hostage to a compile)
         self._warm_buckets: set[int] = set()
+        self._warming: set[int] = set()
         self.stats = QueueStats()
         self._items: list[Any] = []
         self._futures: list[asyncio.Future] = []
@@ -202,28 +233,47 @@ class OpQueue:
             return await loop.run_in_executor(None, self.batch_fn, items)
         if self.breaker.is_open():
             return await self._run_fallback(items)
-        # A bucket's first device dispatch pays jit compile (tens of seconds
-        # cold); that is the device warming up, not the device being slow —
-        # give it a generous one-off timeout and exempt it from the breaker.
         bucket = _next_pow2(len(items))
-        first_time = bucket not in self._warm_buckets
-        timeout = self.compile_timeout_s if first_time else self.dispatch_timeout_s
+        scale = max(1.0, bucket / self.degrade_ref_batch)
+        if bucket not in self._warm_buckets:
+            # A bucket's first device dispatch is a jit compile — tens of
+            # seconds cold, easily past the protocol timeout.  Never hold
+            # live ops hostage to a compile: serve them from the cpu NOW and
+            # warm the bucket in the background (the 2-thread device pool
+            # serialises warm-ups; the device takes over once compiled).
+            if bucket not in self._warming:
+                self._warming.add(bucket)
+                warm = loop.run_in_executor(self.breaker.warmup_executor,
+                                            self.batch_fn, items)
+
+                def _mark(f, b=bucket):
+                    self._warming.discard(b)
+                    if f.exception() is None:
+                        self._warm_buckets.add(b)
+                    else:
+                        logging.getLogger(__name__).warning(
+                            "bucket %d warm-up failed: %s", b, f.exception()
+                        )
+
+                warm.add_done_callback(_mark)
+            return await self._run_fallback(items)
         t0 = time.perf_counter()
         # Dedicated 2-thread device pool: an abandoned hung dispatch can never
         # starve the default executor that the cpu fallback runs on.
         device = loop.run_in_executor(self.breaker.device_executor,
                                       self.batch_fn, items)
         try:
-            results = await asyncio.wait_for(asyncio.shield(device), timeout)
+            results = await asyncio.wait_for(
+                asyncio.shield(device), self.dispatch_timeout_s * scale
+            )
         except asyncio.TimeoutError:
             # The device call cannot be cancelled (it is a thread); abandon it
             # to finish in the background and serve these ops from the cpu.
             self._trip_breaker("timed out", time.perf_counter() - t0)
             device.add_done_callback(lambda f: f.exception())  # reap quietly
             return await self._run_fallback(items)
-        self._warm_buckets.add(bucket)
         dt = time.perf_counter() - t0
-        if dt > self.degrade_after_s and not first_time:
+        if dt > self.degrade_after_s * scale:
             self._trip_breaker("slow", dt)
         return results
 
@@ -266,7 +316,11 @@ def _run_valid(items, is_valid, dispatch, invalid_result):
     valid_idx = [i for i, it in enumerate(items) if is_valid(it)]
     results = [invalid_result() for _ in items]
     if valid_idx:
-        tgt = _next_pow2(len(valid_idx))
+        # pad to the pow2 of the FLUSH size, not the valid count: OpQueue
+        # keys its warm-bucket tracking on the flush size, so the compiled
+        # program shape must match it even when attacker-supplied invalid
+        # items were filtered out of the batch
+        tgt = _next_pow2(len(items))
         out = dispatch([items[i] for i in valid_idx], tgt)
         for j, i in enumerate(valid_idx):
             results[i] = out[j]
@@ -366,6 +420,8 @@ class BatchedKEM:
             pks, sks = self.algo.generate_keypair_batch(n)
             cts, _ = self.algo.encapsulate_batch(pks)
             self.algo.decapsulate_batch(sks, cts)
+            for q in (self._kg, self._enc, self._dec):
+                q._warm_buckets.add(_next_pow2(n))
 
     async def generate_keypair(self) -> tuple[bytes, bytes]:
         return await self._kg.submit(None)
@@ -452,6 +508,8 @@ class BatchedSignature:
             pks = np.stack([np.frombuffer(pk, np.uint8)] * n)
             sigs = self.algo.sign_batch(sks, [b"warmup"] * n)
             self.algo.verify_batch(pks, [b"warmup"] * n, sigs)
+            for q in (self._sign, self._verify):
+                q._warm_buckets.add(_next_pow2(n))
 
     async def sign(self, secret_key: bytes, message: bytes) -> bytes:
         return await self._sign.submit((secret_key, message))

@@ -1,1 +1,6 @@
-"""Host-side utilities: profiling/tracing hooks."""
+"""Host-side utilities: profiling/tracing hooks, shared helpers."""
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 0); 1 for n <= 1."""
+    return 1 << (n - 1).bit_length() if n > 1 else 1

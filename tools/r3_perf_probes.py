@@ -1,0 +1,207 @@
+"""Round-3 targeted TPU perf probes (run alone on the chip).
+
+Measures, honestly synced (utils.benchmarking), the four perf questions
+VERDICT r2 left open:
+
+  mldsa_sign_compact   ML-DSA-65 sign at batch 8192: the all-lanes loop vs
+                       the compact-and-refill driver (next-round item #5)
+  frodo_aes            FrodoKEM-640-AES encaps: bitsliced AES vs the gather
+                       S-box (A/B needs fresh processes — this probe runs
+                       whichever QRP2P_AES_GATHER selects; item #6)
+  hqc_tpu              HQC-128 keygen/encaps/decaps at the safe batch cap
+                       (the family's first TPU numbers; item #3)
+  sphincs_s_sign       SPHINCS+-SHA2 s-set sign at increasing batches until
+                       compile/run fails — locates the 128-lane ceiling
+                       (item #8)
+
+Usage:
+    python -m tools.r3_perf_probes [--only NAME ...] [--out PATH]
+    QRP2P_AES_GATHER=1 python -m tools.r3_perf_probes --only frodo_aes
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from quantum_resistant_p2p_tpu.utils.benchmarking import (  # noqa: E402
+    enable_compile_cache,
+    sync,
+    timeit,
+)
+
+
+def _u8(shape) -> np.ndarray:
+    rng = np.random.default_rng(20260730)
+    return rng.integers(0, 256, shape, dtype=np.uint8)
+
+
+def probe_mldsa_sign_compact(out: dict) -> None:
+    import jax
+
+    from quantum_resistant_p2p_tpu.sig import mldsa
+
+    batch = 8192
+    kg, sign_mu, _ = mldsa.get("ML-DSA-65")
+    xi = _u8((batch, 32))
+    _, sk = kg(xi)
+    sync(sk)
+    sk = jax.device_put(np.asarray(sk))
+    mus = jax.device_put(_u8((batch, 64)))
+    rnds = jax.device_put(_u8((batch, 32)))
+
+    def compact():
+        sig, done = mldsa.sign_mu_compact("ML-DSA-65", sk, mus, rnds)
+        assert done.all()
+        return sig
+
+    # compact driver includes its own host orchestration; time wall-clock
+    import time as _t
+
+    compact()  # compile all bucket variants
+    t0 = _t.perf_counter()
+    compact()
+    dt_c = _t.perf_counter() - t0
+    t0 = _t.perf_counter()
+    compact()
+    dt_c = min(dt_c, _t.perf_counter() - t0)
+
+    dt_full = timeit(sign_mu, sk, mus, rnds)
+    out["mldsa_sign_compact"] = {
+        "batch": batch,
+        "full_loop_sign_per_s": round(batch / dt_full, 1),
+        "compact_sign_per_s": round(batch / dt_c, 1),
+        "speedup": round(dt_full / dt_c, 2),
+    }
+
+
+def probe_frodo_aes(out: dict) -> None:
+    import os
+
+    import jax
+
+    from quantum_resistant_p2p_tpu.kem import frodo
+
+    batch = 256  # MAX_DEVICE_BATCH
+    kg, enc, _ = frodo.get("FrodoKEM-640-AES")
+    sec = 16
+    s1, s2, s3 = (_u8((batch, sec)) for _ in range(3))
+    pk, sk = kg(s1, s2, s3)
+    sync((pk, sk))
+    pk = jax.device_put(np.asarray(pk))
+    mu = jax.device_put(_u8((batch, sec)))
+    dt = timeit(enc, pk, mu)
+    out["frodo_aes"] = {
+        "batch": batch,
+        "aes_impl": "gather" if os.environ.get("QRP2P_AES_GATHER") == "1"
+        else "bitsliced",
+        "encaps_per_s": round(batch / dt, 1),
+    }
+
+
+def probe_hqc_tpu(out: dict) -> None:
+    import jax
+
+    from quantum_resistant_p2p_tpu.kem import hqc
+
+    batch = hqc.MAX_DEVICE_BATCH
+    kg, enc, dec = hqc.get("HQC-128")
+    from quantum_resistant_p2p_tpu.pyref.hqc_ref import PARAMS
+
+    p = PARAMS["HQC-128"]
+    sk_seed, sigma, pk_seed = (
+        _u8((batch, 40)), _u8((batch, p.k)), _u8((batch, 40))
+    )
+    pk, sk = kg(sk_seed, sigma, pk_seed)
+    sync((pk, sk))
+    pk_d, sk_d = jax.device_put(np.asarray(pk)), jax.device_put(np.asarray(sk))
+    m, salt = jax.device_put(_u8((batch, p.k))), jax.device_put(_u8((batch, 16)))
+    ct, ss = enc(pk_d, m, salt)
+    sync((ct, ss))
+    ct_d = jax.device_put(np.asarray(ct))
+    ss2 = dec(sk_d, ct_d)
+    assert np.array_equal(np.asarray(ss2), np.asarray(ss)), "roundtrip"
+    out["hqc_tpu"] = {
+        "batch": batch,
+        "keygen_per_s": round(batch / timeit(kg, sk_seed, sigma, pk_seed), 1),
+        "encaps_per_s": round(batch / timeit(enc, pk_d, m, salt), 1),
+        "decaps_per_s": round(batch / timeit(dec, sk_d, ct_d), 1),
+    }
+
+
+def probe_sphincs_s_sign(out: dict) -> None:
+    import jax
+
+    from quantum_resistant_p2p_tpu.pyref import slhdsa_ref
+    from quantum_resistant_p2p_tpu.sig import sphincs
+
+    res = {}
+    for name, batches in (
+        ("SPHINCS+-SHA2-128s-simple", (128, 256, 512)),
+        # 192s/256s sign graphs kill the compiler at batch 128 (measured);
+        # walk up from below to find their envelope
+        ("SPHINCS+-SHA2-192s-simple", (32, 64, 128)),
+        ("SPHINCS+-SHA2-256s-simple", (32, 64, 128)),
+    ):
+        p = slhdsa_ref.PARAMS[name]
+        kg, ssign, _ = sphincs.get(name)
+        per_batch = {}
+        for b in batches:
+            try:
+                sk_seed, sk_prf, pk_seed = (
+                    _u8((b, p.n)), _u8((b, p.n)), _u8((b, p.n))
+                )
+                _, sk = kg(sk_seed, sk_prf, pk_seed)
+                sync(sk)
+                sk_d = jax.device_put(np.asarray(sk))
+                r, digest = (
+                    jax.device_put(_u8((b, p.n))),
+                    jax.device_put(_u8((b, p.m))),
+                )
+                dt = timeit(ssign, sk_d, r, digest)
+                per_batch[str(b)] = round(b / dt, 2)
+            except Exception as e:  # OOM / compile failure locates the ceiling
+                per_batch[str(b)] = f"FAILED: {type(e).__name__}: {str(e)[:160]}"
+                break
+        res[name] = per_batch
+    out["sphincs_s_sign"] = res
+
+
+PROBES = {
+    "mldsa_sign_compact": probe_mldsa_sign_compact,
+    "frodo_aes": probe_frodo_aes,
+    "hqc_tpu": probe_hqc_tpu,
+    "sphincs_s_sign": probe_sphincs_s_sign,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", nargs="*")
+    ap.add_argument("--out", default="bench_results/r3_perf_probes.json")
+    args = ap.parse_args(argv)
+    enable_compile_cache()
+    import jax
+
+    out: dict = {"platform": jax.devices()[0].platform}
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    for name in (args.only or list(PROBES)):
+        print(f"== {name}", flush=True)
+        try:
+            PROBES[name](out)
+        except Exception as e:
+            out[name] = f"ERROR: {type(e).__name__}: {str(e)[:300]}"
+        print(json.dumps(out.get(name), indent=1), flush=True)
+        path.write_text(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,336 @@
+"""Minimized repro / bisection harness for the remote-TPU worker kernel fault.
+
+Round-2 observations (commit f28a9b0, memory notes): FrodoKEM single
+dispatches >= 1024 rows and HQC >= 256 rows reproducibly crash this
+environment's remote TPU worker (it restarts after ~1 min); the fix was
+MAX_DEVICE_BATCH caps chosen by observation.  This tool turns that
+observation into a bisection: it runs each candidate sub-kernel at
+increasing batch sizes, EACH IN ITS OWN SUBPROCESS (a worker crash kills
+the child, not the harness), verifies chip health with a tiny program
+between runs, and emits a JSON map  probe -> largest-ok / smallest-fault
+batch, so the fault can be attributed to a specific kernel (HQC's cyclic
+gather chain vs its RS/RM decoders vs the seedexpander; Frodo's SHAKE
+matrix-gen vs the MXU matmul) rather than to "the op".
+
+Respect the one-TPU-process rule: run this alone.
+
+Usage:
+    python -m tools.repro_worker_fault                    # full bisection
+    python -m tools.repro_worker_fault --probe hqc_keygen --batch 256
+                                                          # one child probe
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+PROBE_TIMEOUT_S = 600  # first compile of a big batch is slow on the tunnel
+HEALTH_TIMEOUT_S = 120
+RESTART_WAIT_S = 75  # worker restart takes ~1 min
+
+
+# --------------------------------------------------------------------------
+# Child-side probes: each builds ONE kernel at the given batch and runs it.
+# Data is random; decode probes run on garbage inputs (fault-probing only).
+# --------------------------------------------------------------------------
+
+
+def _rng_u8(rng, *shape):
+    import numpy as np
+
+    return rng.integers(0, 256, shape, dtype=np.uint8)
+
+
+def probe_tiny(batch: int) -> None:
+    import jax.numpy as jnp
+
+    assert int((jnp.ones((8,)) * 2).sum()) == 16
+
+
+def _hqc_parts(batch):
+    import numpy as np
+
+    from quantum_resistant_p2p_tpu.pyref.hqc_ref import PARAMS
+
+    p = PARAMS["HQC-128"]
+    rng = np.random.default_rng(0)
+    return p, rng
+
+
+def probe_hqc_seedexpand(batch: int) -> None:
+    import jax
+
+    from quantum_resistant_p2p_tpu.kem import hqc
+
+    p, rng = _hqc_parts(batch)
+    out = jax.jit(lambda s: hqc._seedexpand(s, 8 * p.w))(_rng_u8(rng, batch, 40))
+    jax.block_until_ready(out)
+    _ = bytes(jax.numpy.asarray(out[0, :4]))  # host readback
+
+
+def probe_hqc_fixed_weight(batch: int) -> None:
+    import jax
+
+    from quantum_resistant_p2p_tpu.kem import hqc
+
+    p, rng = _hqc_parts(batch)
+
+    def f(seed):
+        stream = hqc._u32s(hqc._seedexpand(seed, 8 * p.w))
+        return hqc._fixed_weight_support(p, stream[..., : p.w], p.w)
+
+    out = jax.jit(f)(_rng_u8(rng, batch, 40))
+    _ = int(jax.numpy.asarray(out)[0, 0])
+
+
+def probe_hqc_cyclic_mul(batch: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quantum_resistant_p2p_tpu.kem import hqc
+
+    p, rng = _hqc_parts(batch)
+    dense = jnp.asarray(rng.integers(0, 2, (batch, p.n), dtype=np.int32))
+    sup = jnp.asarray(rng.integers(0, p.n, (batch, p.w), dtype=np.int32))
+    out = jax.jit(lambda d, s: hqc._cyclic_mul_sparse(p, d, s))(dense, sup)
+    _ = int(jax.numpy.asarray(out)[0, 0])
+
+
+def probe_hqc_rm_rs_decode(batch: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quantum_resistant_p2p_tpu.kem import hqc
+
+    p, rng = _hqc_parts(batch)
+    bits = jnp.asarray(rng.integers(0, 2, (batch, p.n1 * p.n2), dtype=np.int32))
+    out = jax.jit(lambda b: hqc._rs_decode(p, hqc._rm_decode(p, b)))(bits)
+    _ = int(jax.numpy.asarray(out)[0, 0])
+
+
+def _hqc_full(op: str, batch: int) -> None:
+    import jax
+    import numpy as np
+
+    from quantum_resistant_p2p_tpu.kem import hqc
+
+    p, rng = _hqc_parts(batch)
+    kg, enc, dec = hqc.get("HQC-128")
+    sk_seed, sigma, pk_seed = (
+        _rng_u8(rng, batch, 40), _rng_u8(rng, batch, p.k), _rng_u8(rng, batch, 40)
+    )
+    if op == "keygen":
+        pk, sk = kg(sk_seed, sigma, pk_seed)
+        _ = bytes(np.asarray(pk)[0, :4])
+        return
+    # encaps/decaps need keys: make them at a SAFE batch then broadcast
+    pk1, sk1 = kg(sk_seed[:1], sigma[:1], pk_seed[:1])
+    pk = np.broadcast_to(np.asarray(pk1), (batch, pk1.shape[-1]))
+    if op == "encaps":
+        ct, ss = enc(pk, _rng_u8(rng, batch, p.k), _rng_u8(rng, batch, 16))
+        _ = bytes(np.asarray(ss)[0, :4])
+        return
+    ct1, _ = enc(np.asarray(pk1), _rng_u8(rng, 1, p.k), _rng_u8(rng, 1, 16))
+    sk = np.broadcast_to(np.asarray(sk1), (batch, sk1.shape[-1]))
+    ct = np.broadcast_to(np.asarray(ct1), (batch, ct1.shape[-1]))
+    ss = dec(sk, ct)
+    _ = bytes(np.asarray(ss)[0, :4])
+
+
+def probe_hqc_keygen(batch: int) -> None:
+    _hqc_full("keygen", batch)
+
+
+def probe_hqc_encaps(batch: int) -> None:
+    _hqc_full("encaps", batch)
+
+
+def probe_hqc_decaps(batch: int) -> None:
+    _hqc_full("decaps", batch)
+
+
+def _frodo_parts():
+    import numpy as np
+
+    from quantum_resistant_p2p_tpu.pyref.frodo_ref import PARAMS
+
+    return PARAMS["FrodoKEM-640-SHAKE"], np.random.default_rng(1)
+
+
+def probe_frodo_gen_a(batch: int) -> None:
+    """The SHAKE row-expansion of A alone (no matmul)."""
+    import jax
+
+    from quantum_resistant_p2p_tpu.kem import frodo
+
+    p, rng = _frodo_parts()
+
+    def f(seed_a):
+        ctx = frodo._a_ctx(p, seed_a)
+        return frodo._gen_a_chunk(p, ctx, 0, 64)
+
+    out = jax.jit(f)(_rng_u8(rng, batch, 16))
+    _ = int(jax.numpy.asarray(out)[0, 0, 0])
+
+
+def probe_frodo_matmul(batch: int) -> None:
+    """A x S einsum chain alone (MXU path) at full n=640."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quantum_resistant_p2p_tpu.kem import frodo
+
+    p, rng = _frodo_parts()
+    seed_a = _rng_u8(rng, batch, 16)
+    s = jnp.asarray(
+        rng.integers(0, p.q, (batch, p.n, 8), dtype=np.int32)
+    )
+
+    def f(seed_a, s):
+        ctx = frodo._a_ctx(p, seed_a)
+        return frodo._a_times_s(p, ctx, s)
+
+    out = jax.jit(f)(seed_a, s)
+    _ = int(jax.numpy.asarray(out)[0, 0, 0])
+
+
+def _frodo_full(op: str, batch: int) -> None:
+    import numpy as np
+
+    from quantum_resistant_p2p_tpu.kem import frodo
+
+    p, rng = _frodo_parts()
+    kg, enc, dec = frodo.get("FrodoKEM-640-SHAKE")
+    sec = p.len_sec
+    if op == "keygen":
+        pk, sk = kg(_rng_u8(rng, batch, sec), _rng_u8(rng, batch, sec),
+                    _rng_u8(rng, batch, sec))
+        _ = bytes(np.asarray(pk)[0, :4])
+        return
+    pk1, sk1 = kg(_rng_u8(rng, 1, sec), _rng_u8(rng, 1, sec), _rng_u8(rng, 1, sec))
+    pk = np.broadcast_to(np.asarray(pk1), (batch, pk1.shape[-1]))
+    if op == "encaps":
+        ct, ss = enc(pk, _rng_u8(rng, batch, sec))
+        _ = bytes(np.asarray(ss)[0, :4])
+        return
+    ct1, _ = enc(np.asarray(pk1), _rng_u8(rng, 1, sec))
+    sk = np.broadcast_to(np.asarray(sk1), (batch, sk1.shape[-1]))
+    ct = np.broadcast_to(np.asarray(ct1), (batch, ct1.shape[-1]))
+    ss = dec(sk, ct)
+    _ = bytes(np.asarray(ss)[0, :4])
+
+
+def probe_frodo_keygen(batch: int) -> None:
+    _frodo_full("keygen", batch)
+
+
+def probe_frodo_encaps(batch: int) -> None:
+    _frodo_full("encaps", batch)
+
+
+def probe_frodo_decaps(batch: int) -> None:
+    _frodo_full("decaps", batch)
+
+
+PROBES = {
+    "tiny": (probe_tiny, [1]),
+    # HQC sub-kernels, bracketing the observed >=256 fault threshold
+    "hqc_seedexpand": (probe_hqc_seedexpand, [128, 256, 512, 1024]),
+    "hqc_fixed_weight": (probe_hqc_fixed_weight, [128, 256, 512, 1024]),
+    "hqc_cyclic_mul": (probe_hqc_cyclic_mul, [128, 256, 512, 1024]),
+    "hqc_rm_rs_decode": (probe_hqc_rm_rs_decode, [128, 256, 512, 1024]),
+    "hqc_keygen": (probe_hqc_keygen, [128, 192, 256, 512]),
+    "hqc_encaps": (probe_hqc_encaps, [128, 192, 256, 512]),
+    "hqc_decaps": (probe_hqc_decaps, [128, 192, 256]),
+    # Frodo sub-kernels, bracketing the observed >=1024 fault threshold
+    "frodo_gen_a": (probe_frodo_gen_a, [256, 512, 1024, 2048]),
+    "frodo_matmul": (probe_frodo_matmul, [256, 512, 1024, 2048]),
+    "frodo_keygen": (probe_frodo_keygen, [256, 512, 768, 1024]),
+    "frodo_encaps": (probe_frodo_encaps, [256, 512, 768, 1024]),
+    "frodo_decaps": (probe_frodo_decaps, [256, 512, 1024]),
+}
+
+
+def _run_child(probe: str, batch: int, timeout: float) -> dict:
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.repro_worker_fault",
+             "--probe", probe, "--batch", str(batch)],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=Path(__file__).resolve().parent.parent,
+        )
+        status = "ok" if r.returncode == 0 else "fault"
+        detail = (r.stderr or "")[-400:] if r.returncode else ""
+    except subprocess.TimeoutExpired:
+        status, detail = "timeout", ""
+    return {"status": status, "elapsed_s": round(time.time() - t0, 1),
+            "detail": detail}
+
+
+def _wait_healthy() -> bool:
+    for attempt in range(6):
+        if _run_child("tiny", 1, HEALTH_TIMEOUT_S)["status"] == "ok":
+            return True
+        print(f"  chip unhealthy; waiting {RESTART_WAIT_S}s for worker restart "
+              f"(attempt {attempt + 1})", flush=True)
+        time.sleep(RESTART_WAIT_S)
+    return False
+
+
+def bisect(probes: list[str], out_path: Path) -> dict:
+    results: dict[str, dict] = {}
+    for name in probes:
+        _, batches = PROBES[name]
+        results[name] = {}
+        for batch in batches:
+            print(f"{name} @ {batch} ...", end=" ", flush=True)
+            res = _run_child(name, batch, PROBE_TIMEOUT_S)
+            print(res["status"], f"({res['elapsed_s']}s)", flush=True)
+            results[name][str(batch)] = res
+            out_path.write_text(json.dumps(results, indent=1))
+            if res["status"] != "ok":
+                if not _wait_healthy():
+                    print("chip did not recover; aborting", flush=True)
+                    return results
+                break  # larger batches of a faulting kernel: no new info
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", help="child mode: run one probe and exit")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--only", nargs="*", help="subset of probes to bisect")
+    ap.add_argument("--out", default="bench_results/worker_fault_bisect.json")
+    args = ap.parse_args(argv)
+
+    if args.probe:
+        fn, _ = PROBES[args.probe]
+        fn(args.batch)
+        print("ok")
+        return 0
+
+    out_path = Path(args.out)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    probes = args.only or [p for p in PROBES if p != "tiny"]
+    if not _wait_healthy():
+        print("chip not healthy at start", flush=True)
+        return 1
+    results = bisect(probes, out_path)
+    print(json.dumps(results, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
