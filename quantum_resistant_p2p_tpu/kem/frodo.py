@@ -45,11 +45,15 @@ def _use_bitsliced_aes() -> bool:
 
 N_CHUNKS = 16  # A-matrix row chunks (n is divisible by 16 in all sets)
 
-#: Largest single-dispatch batch on real TPU hardware.  Batches >= 1024
-#: reproducibly crash this environment's remote TPU worker ("kernel fault";
-#: batch 256 is solid, N_CHUNKS does not change it) — callers slice larger
-#: batches into MAX_DEVICE_BATCH dispatches (provider does this
-#: automatically).
+#: Largest single-dispatch batch on real TPU hardware.  Round 2 observed
+#: batches >= 1024 crashing this environment's remote TPU worker; the
+#: round-3 bisection (tools/repro_worker_fault.py,
+#: bench_results/worker_fault_bisect.json) could NOT reproduce any
+#: deterministic (kernel, batch) fault — fresh-process keygen/encaps ran
+#: clean at 1024 and the sub-kernels at 2048, so the failure class is a
+#: transient worker-state one.  The cap stays as a conservative guard
+#: (dispatches are seconds-long, so slicing costs ~nothing) and the batch
+#: queue's cpu fallback absorbs any recurrence.
 MAX_DEVICE_BATCH = 256
 
 

@@ -46,10 +46,13 @@ from ..pyref.hqc_ref import (
     _rs_gen_poly,
 )
 
-#: Single-dispatch batch cap (provider/base.py sliced_dispatch).  A 256-row
-#: HQC keygen dispatch crashed this environment's remote TPU worker
-#: ("kernel fault", 2026-07-30) — the same failure class FrodoKEM hits at
-#: >= 1024 (kem/frodo.py); 128 stays below the observed fault threshold.
+#: Single-dispatch batch cap (provider/base.py sliced_dispatch).  Round 2
+#: observed a 256-row keygen dispatch crashing the remote TPU worker; the
+#: round-3 bisection (tools/repro_worker_fault.py) ran every HQC op and
+#: sub-kernel clean at 256-1024 in fresh processes — no deterministic
+#: fault exists; the failure class is transient worker state.  The cap
+#: stays as a conservative guard (HQC dispatches are seconds-long, so
+#: slicing costs ~nothing).
 MAX_DEVICE_BATCH = 128
 
 _EXP = np.asarray(_GF_EXP, dtype=np.int32)  # length 512

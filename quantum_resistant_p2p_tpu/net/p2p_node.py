@@ -137,25 +137,27 @@ class P2PNode:
         """Dial a peer, run the hello handshake, return its node id.
 
         A busy peer (e.g. its loop briefly stalled by a background jit
-        compile, provider/batched.py) may miss the hello window; transient
-        failures are retried with backoff before giving up — one-shot
-        connects under load were the reference harness's flakiest edge.
+        compile, provider/batched.py) may miss the hello window; only
+        TRANSIENT failures (timeouts, dropped connections) are retried with
+        backoff — a wrong-protocol endpoint ("bad hello") fails once, fast.
         """
         for attempt in range(retries + 1):
-            peer_id = await self._connect_once(host, port, timeout)
-            if peer_id is not None or attempt == retries:
+            peer_id, retryable = await self._connect_once(host, port, timeout)
+            if peer_id is not None or not retryable or attempt == retries:
                 return peer_id
             await asyncio.sleep(0.5 * (attempt + 1))
         return None
 
-    async def _connect_once(self, host: str, port: int, timeout: float) -> str | None:
+    async def _connect_once(self, host: str, port: int,
+                            timeout: float) -> tuple[str | None, bool]:
+        """-> (peer_id | None, retryable)."""
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, port), timeout
             )
         except (OSError, asyncio.TimeoutError) as e:
             logger.warning("connect to %s:%s failed: %s", host, port, e)
-            return None
+            return None, True
         try:
             await self._send_frame(
                 writer,
@@ -168,10 +170,11 @@ class P2PNode:
         except Exception as e:
             logger.warning("hello with %s:%s failed: %s", host, port, e)
             writer.close()
-            return None
+            # a peer that SPOKE but spoke wrong is not transient
+            return None, not isinstance(e, ValueError)
         peer_id = hello["node_id"]
         self._register_peer(peer_id, reader, writer, host, int(hello.get("listen_port", port)))
-        return peer_id
+        return peer_id, False
 
     async def _on_inbound(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         addr = writer.get_extra_info("peername") or ("?", 0)

@@ -173,6 +173,9 @@ class OpQueue:
         #: longer than a 256-row one before it counts as "slow"; without
         #: this, peak load (big healthy batches) trips the breaker forever
         self.degrade_ref_batch = degrade_ref_batch
+        #: clears a stuck _warming flag so warm-ups are retried (see
+        #: _run_batch); generous — first compiles take minutes on a tunnel
+        self.warmup_watchdog_s = 600.0
         self.breaker = breaker if breaker is not None else Breaker()
         #: pow2 sizes whose device program has completed at least once; a
         #: cold bucket's ops are served by the fallback while the compile
@@ -239,8 +242,8 @@ class OpQueue:
             # A bucket's first device dispatch is a jit compile — tens of
             # seconds cold, easily past the protocol timeout.  Never hold
             # live ops hostage to a compile: serve them from the cpu NOW and
-            # warm the bucket in the background (the 2-thread device pool
-            # serialises warm-ups; the device takes over once compiled).
+            # warm the bucket in the background (the nice-19 1-thread warmup
+            # pool serialises compiles; the device takes over once warm).
             if bucket not in self._warming:
                 self._warming.add(bucket)
                 warm = loop.run_in_executor(self.breaker.warmup_executor,
@@ -248,6 +251,8 @@ class OpQueue:
 
                 def _mark(f, b=bucket):
                     self._warming.discard(b)
+                    if f.cancelled():
+                        return
                     if f.exception() is None:
                         self._warm_buckets.add(b)
                     else:
@@ -256,6 +261,22 @@ class OpQueue:
                         )
 
                 warm.add_done_callback(_mark)
+
+                # Watchdog: a hung warm-up must not pin the bucket in
+                # _warming forever (that would silently disable the device
+                # path with no retry).  After the timeout, clear the flag so
+                # a later flush retries; the stuck thread, if any, still
+                # occupies only the 1-thread warmup pool.
+                def _unstick(b=bucket, w=warm):
+                    if not w.done() and b in self._warming:
+                        self._warming.discard(b)
+                        logging.getLogger(__name__).warning(
+                            "bucket %d warm-up still running after %.0fs; "
+                            "will retry on a later flush", b,
+                            self.warmup_watchdog_s,
+                        )
+
+                loop.call_later(self.warmup_watchdog_s, _unstick)
             return await self._run_fallback(items)
         t0 = time.perf_counter()
         # Dedicated 2-thread device pool: an abandoned hung dispatch can never
@@ -417,11 +438,12 @@ class BatchedKEM:
         background thread).  Cold jit of the first handshake's size-1 bucket
         otherwise races the protocol timeout (SURVEY.md §7.4 item 6)."""
         for n in sizes:
-            pks, sks = self.algo.generate_keypair_batch(n)
+            n2 = _next_pow2(n)  # compile the shape the live bucket will use
+            pks, sks = self.algo.generate_keypair_batch(n2)
             cts, _ = self.algo.encapsulate_batch(pks)
             self.algo.decapsulate_batch(sks, cts)
             for q in (self._kg, self._enc, self._dec):
-                q._warm_buckets.add(_next_pow2(n))
+                q._warm_buckets.add(n2)
 
     async def generate_keypair(self) -> tuple[bytes, bytes]:
         return await self._kg.submit(None)
@@ -504,12 +526,13 @@ class BatchedSignature:
         """Compile keygen/sign/verify for the pow2 buckets (blocking)."""
         pk, sk = self.algo.generate_keypair()
         for n in sizes:
-            sks = np.stack([np.frombuffer(sk, np.uint8)] * n)
-            pks = np.stack([np.frombuffer(pk, np.uint8)] * n)
-            sigs = self.algo.sign_batch(sks, [b"warmup"] * n)
-            self.algo.verify_batch(pks, [b"warmup"] * n, sigs)
+            n2 = _next_pow2(n)  # compile the shape the live bucket will use
+            sks = np.stack([np.frombuffer(sk, np.uint8)] * n2)
+            pks = np.stack([np.frombuffer(pk, np.uint8)] * n2)
+            sigs = self.algo.sign_batch(sks, [b"warmup"] * n2)
+            self.algo.verify_batch(pks, [b"warmup"] * n2, sigs)
             for q in (self._sign, self._verify):
-                q._warm_buckets.add(_next_pow2(n))
+                q._warm_buckets.add(n2)
 
     async def sign(self, secret_key: bytes, message: bytes) -> bytes:
         return await self._sign.submit((secret_key, message))

@@ -598,36 +598,6 @@ def _rounds_jit(name: str, n_iters: int):
     return jax.jit(functools.partial(sign_mu_rounds, p, n_iters=n_iters))
 
 
-@functools.cache
-def _warm_completion_program(name: str) -> None:
-    """Background-compile the completion-round program (batch 1).
-
-    The completion program only runs for lanes unfinished after the
-    schedule (a few % of ops), so a warmup() pass usually never compiles
-    it — and a cold compile inside a live dispatch is the round-1 flake.
-    Kick the compile off a daemon thread at first driver use."""
-    import threading
-
-    def _compile():
-        try:
-            rng = np.random.default_rng(0)
-            p = PARAMS[name]
-            _, sk = jax.jit(functools.partial(keygen, p))(
-                rng.integers(0, 256, (1, 32), dtype=np.uint8)
-            )
-            _rounds_jit(name, MAX_SIGN_ITERS)(
-                np.asarray(sk),
-                rng.integers(0, 256, (1, 64), dtype=np.uint8),
-                rng.integers(0, 256, (1, 32), dtype=np.uint8),
-                jnp.zeros(1, jnp.int32),
-            )
-        except Exception:  # pragma: no cover - warm-up is best effort
-            pass
-
-    threading.Thread(target=_compile, name=f"mldsa-warm-{name}",
-                     daemon=True).start()
-
-
 def sign_mu_compact(name: str, sk, mu, rnd, *,
                     schedule: tuple[int, ...] = COMPACT_SCHEDULE,
                     min_bucket: int = 64):
@@ -647,7 +617,6 @@ def sign_mu_compact(name: str, sk, mu, rnd, *,
     Returns (sigma, done) as numpy arrays.
     """
     p = PARAMS[name]
-    _warm_completion_program(name)
     sk_d = jnp.asarray(sk, jnp.uint8)
     mu_d = jnp.asarray(mu, jnp.uint8)
     rnd_d = jnp.asarray(rnd, jnp.uint8)

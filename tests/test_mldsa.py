@@ -136,9 +136,9 @@ def test_sign_compact_bit_exact_vs_full_loop():
 
 
 def test_provider_sign_batch_uses_compact_driver():
-    from quantum_resistant_p2p_tpu.provider import get_signature
+    from quantum_resistant_p2p_tpu.provider.sig_providers import MLDSASignature
 
-    alg = get_signature("ML-DSA-44", backend="tpu")
+    alg = MLDSASignature(2, backend="tpu", compact_sign=True)
     pk, sk = alg.generate_keypair()
     n = 5
     sks = np.broadcast_to(np.frombuffer(sk, np.uint8), (n, len(sk)))

@@ -70,9 +70,10 @@ def probe_hqc_seedexpand(batch: int) -> None:
     from quantum_resistant_p2p_tpu.kem import hqc
 
     p, rng = _hqc_parts(batch)
+    import numpy as np
+
     out = jax.jit(lambda s: hqc._seedexpand(s, 8 * p.w))(_rng_u8(rng, batch, 40))
-    jax.block_until_ready(out)
-    _ = bytes(jax.numpy.asarray(out[0, :4]))  # host readback
+    _ = bytes(np.asarray(out)[0, :4])  # host readback
 
 
 def probe_hqc_fixed_weight(batch: int) -> None:
