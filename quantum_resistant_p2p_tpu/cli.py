@@ -450,6 +450,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="shard TPU batches across this many chips (0 = one, -1 = all)")
     ap.add_argument("--config", default=None, help="config file path")
     ap.add_argument("--no-discovery", action="store_true")
+    ap.add_argument("--tui", action="store_true",
+                    help="two-pane curses UI (live peer list + chat)")
     ap.add_argument("--log-level", default="INFO")
     args = ap.parse_args(argv)
 
@@ -477,6 +479,15 @@ def main(argv: list[str] | None = None) -> int:
     )
     if not cli.login_interactive():
         return 1
+
+    if args.tui:
+        from .tui import run_tui
+
+        try:
+            run_tui(cli)
+        except KeyboardInterrupt:
+            pass
+        return 0
 
     async def run():
         await cli.start()
