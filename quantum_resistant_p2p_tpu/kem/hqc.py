@@ -160,12 +160,85 @@ def _sample_random_bits(p: HQCParams, seed: jax.Array) -> jax.Array:
 # -- cyclic arithmetic --------------------------------------------------------
 
 
+def _use_matmul_cyclic() -> bool:
+    """Blocked-circulant MXU formulation by default; QRP2P_HQC_GATHER=1
+    restores the rotated-gather loop for A/B runs.  Read at TRACE time
+    (fresh process per setting, same caveat as QRP2P_PALLAS)."""
+    import os
+
+    return os.environ.get("QRP2P_HQC_GATHER", "0") != "1"
+
+
+def _cyclic_block(n: int) -> int:
+    """Shift-block size: bounds the (batch, K, n) Toeplitz transient."""
+    return 256 if n <= 20000 else (128 if n <= 40000 else 64)
+
+
+def _cyclic_mul_matmul(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:
+    """Gather-free cyclic product: out = dense ⊛ onehot(sup) via blocked
+    Toeplitz contractions under a ``lax.scan``.
+
+    Per-lane dynamic gathers (the rotated-index loop below) serialise on
+    TPU — the same hazard that cost ML-DSA 25-100x before its samplers went
+    gather-free.  Here the support densifies to a one-hot row (a tiny
+    w-element scatter), the dense vector is TRIPLED so every rotation is a
+    contiguous window, and each block of K shift amounts takes ONE
+    scalar-start dynamic window + K static slices (a Toeplitz expansion —
+    no per-lane indices anywhere) contracted on the MXU against the one-hot
+    slice.  O(n^2) int8 arithmetic instead of O(w*n) serialised gathers;
+    arithmetic is what the chip has.
+    """
+    n = p.n
+    k_blk = _cyclic_block(n)
+    nblocks = -(-n // k_blk)
+    batch = dense.shape[:-1]
+    y = _onehot_rows(jnp.zeros(batch + (n,), jnp.int8), sup)
+    pad = nblocks * k_blk - n
+    if pad:
+        y = jnp.pad(y, [(0, 0)] * len(batch) + [(0, pad)])
+    d3 = jnp.concatenate([dense, dense, dense], axis=-1).astype(jnp.int8)
+
+    def body(acc, blk):
+        p0 = blk * k_blk
+        # W[j] = d3[2n - p0 - (K-1) + j]; chunk[dp, i] = W[K-1-dp + i]
+        #      = dense[(i - p0 - dp) mod n]  (start always > 0: tripled array)
+        w_seg = lax.dynamic_slice_in_dim(d3, 2 * n - p0 - (k_blk - 1),
+                                         n + k_blk - 1, axis=-1)
+        chunk = jnp.stack(
+            [w_seg[..., k_blk - 1 - dp : k_blk - 1 - dp + n]
+             for dp in range(k_blk)],
+            axis=-2,
+        )  # (..., K, n)
+        y_blk = lax.dynamic_slice_in_dim(y, p0, k_blk, axis=-1)
+        acc = acc + jnp.einsum(
+            "...kn,...k->...n", chunk, y_blk,
+            preferred_element_type=jnp.int32,
+        )
+        return acc, None
+
+    acc0 = jnp.zeros(batch + (n,), jnp.int32)
+    acc, _ = lax.scan(body, acc0, jnp.arange(nblocks))
+    return (acc & 1).astype(jnp.uint8)
+
+
+def _onehot_rows(zeros: jax.Array, sup: jax.Array) -> jax.Array:
+    """Batched one-hot scatter: zeros (..., n), sup (..., w) -> 0/1 rows."""
+    n = zeros.shape[-1]
+    w = sup.shape[-1]
+    return jax.vmap(lambda z, s: z.at[s].set(1))(
+        zeros.reshape((-1, n)), sup.reshape((-1, w))
+    ).reshape(zeros.shape)
+
+
 def _cyclic_mul_sparse(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:
     """dense (batch, n) bits x support (batch, w) -> (batch, n) bits.
 
-    out[i] = XOR_k dense[(i - p_k) mod n]: one rotated gather per support
-    element, accumulated in int32, parity at the end.
+    out[i] = XOR_k dense[(i - p_k) mod n].  Dispatches to the blocked
+    circulant MXU formulation by default; the per-support rotated-gather
+    loop remains for A/B (QRP2P_HQC_GATHER=1).
     """
+    if _use_matmul_cyclic():
+        return _cyclic_mul_matmul(p, dense, sup)
     n = p.n
     w = sup.shape[-1]
     base = jnp.arange(n)
