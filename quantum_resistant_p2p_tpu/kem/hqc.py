@@ -192,7 +192,7 @@ def _cyclic_mul_matmul(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Ar
     k_blk = _cyclic_block(n)
     nblocks = -(-n // k_blk)
     batch = dense.shape[:-1]
-    y = _onehot_rows(jnp.zeros(batch + (n,), jnp.int8), sup)
+    y = _support_to_bits(p, sup).astype(jnp.int8)
     pad = nblocks * k_blk - n
     if pad:
         y = jnp.pad(y, [(0, 0)] * len(batch) + [(0, pad)])
@@ -219,15 +219,6 @@ def _cyclic_mul_matmul(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Ar
     acc0 = jnp.zeros(batch + (n,), jnp.int32)
     acc, _ = lax.scan(body, acc0, jnp.arange(nblocks))
     return (acc & 1).astype(jnp.uint8)
-
-
-def _onehot_rows(zeros: jax.Array, sup: jax.Array) -> jax.Array:
-    """Batched one-hot scatter: zeros (..., n), sup (..., w) -> 0/1 rows."""
-    n = zeros.shape[-1]
-    w = sup.shape[-1]
-    return jax.vmap(lambda z, s: z.at[s].set(1))(
-        zeros.reshape((-1, n)), sup.reshape((-1, w))
-    ).reshape(zeros.shape)
 
 
 def _cyclic_mul_sparse(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:

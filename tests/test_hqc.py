@@ -56,3 +56,56 @@ def test_hqc256_roundtrip_jax():
     pk, sk = kg(sk_seed, sigma, pk_seed)
     ct, ss = enc(np.asarray(pk), m, salt)
     assert (np.asarray(dec(np.asarray(sk), np.asarray(ct))) == np.asarray(ss)).all()
+
+
+def test_cyclic_mul_matmul_matches_gather_loop():
+    """The blocked-Toeplitz MXU formulation is bit-exact vs the retained
+    rotated-gather loop (the QRP2P_HQC_GATHER=1 A/B path) on real params."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import hqc as H
+    from quantum_resistant_p2p_tpu.pyref.hqc_ref import PARAMS
+
+    p = PARAMS["HQC-128"]
+    rng = np.random.default_rng(11)
+    dense = jnp.asarray(rng.integers(0, 2, (2, p.n), dtype=np.int32))
+    sup = jnp.asarray(rng.integers(0, p.n, (2, p.w), dtype=np.int32))
+    got = np.asarray(H._cyclic_mul_matmul(p, dense, sup))
+
+    # gather loop, bypassing the env switch
+    import jax
+    from jax import lax
+
+    n, w = p.n, p.w
+    base = jnp.arange(n)
+
+    def step(k, acc):
+        pk = jnp.take_along_axis(sup, jnp.full(sup.shape[:-1] + (1,), k), axis=-1)
+        idx = (base - pk) % n
+        return acc + jnp.take_along_axis(dense, idx, axis=-1)
+
+    ref = np.asarray(
+        (lax.fori_loop(0, w, step, jnp.zeros(dense.shape, jnp.int32)) & 1)
+    ).astype(np.uint8)
+    assert np.array_equal(got, ref)
+
+
+def test_cyclic_mul_matmul_large_n_block_branch():
+    """The K=64 branch (n > 40000, HQC-256's regime) against an np.roll
+    oracle on a synthetic parameter size — keeps _cyclic_block's largest-n
+    branch from rotting without paying a full HQC-256 CPU run."""
+    import types
+
+    from quantum_resistant_p2p_tpu.kem import hqc as H
+
+    n = 40961  # odd, > 40000 -> K=64, non-divisible block count
+    assert H._cyclic_block(n) == 64
+    fake = types.SimpleNamespace(n=n)
+    rng = np.random.default_rng(12)
+    dense = rng.integers(0, 2, (1, n), dtype=np.int32)
+    sup = rng.integers(0, n, (1, 9), dtype=np.int32)
+    got = np.asarray(H._cyclic_mul_matmul(fake, dense, sup))
+    ref = np.zeros(n, dtype=np.int64)
+    for pos in sup[0]:
+        ref ^= np.roll(dense[0], pos)
+    assert np.array_equal(got[0], ref.astype(np.uint8))
