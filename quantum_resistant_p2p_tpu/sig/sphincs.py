@@ -33,6 +33,7 @@ Replaces (reference): SPHINCSSignature's per-call liboqs objects
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -67,9 +68,14 @@ def _be4(x, lead: tuple[int, ...]) -> jax.Array:
     ).astype(jnp.uint8)
 
 
-def _adrs(lead: tuple[int, ...], layer: int, tree8, typ: int, w1, w2, w3) -> jax.Array:
-    """Build (..., 22) uint8 compressed ADRS broadcast over lead dims."""
-    lb = jnp.broadcast_to(jnp.uint8(layer), lead + (1,))
+def _adrs(lead: tuple[int, ...], layer, tree8, typ: int, w1, w2, w3) -> jax.Array:
+    """Build (..., 22) uint8 compressed ADRS broadcast over lead dims.
+
+    ``layer`` may be a static int OR a traced int32 scalar (the layered
+    sign path compiles one XMSS-layer program and feeds the layer index
+    as an operand).
+    """
+    lb = jnp.broadcast_to(jnp.asarray(layer, jnp.uint8), lead + (1,))
     if tree8 is None:
         tb = jnp.zeros(lead + (8,), jnp.uint8)
     else:
@@ -412,6 +418,58 @@ def sign_digest(p: SLHDSAParams, sk: jax.Array, r: jax.Array, digest: jax.Array)
     return jnp.concatenate(parts, axis=-1)
 
 
+@functools.cache
+def _layered_fns(p: SLHDSAParams):
+    """Jitted (fors_part, xmss_layer) pair for the layered sign path."""
+
+    @jax.jit
+    def fors_part(sk_seed, pk_seed, digest):
+        ctx = _Ctx(p, pk_seed)
+        md, tree_bits, idx_leaf = _digest_split(p, digest)
+        t8_0 = _tree8_at(p, tree_bits, 0)
+        sig_fors, _, _ = _fors_sign(ctx, md, sk_seed, t8_0, idx_leaf)
+        pk_fors = _fors_pk_from_sig(ctx, sig_fors, md, t8_0, idx_leaf)
+        t8s = jnp.stack([t8_0] + [_tree8_at(p, tree_bits, j) for j in range(1, p.d)])
+        leaves = jnp.stack(
+            [idx_leaf] + [_leaf_at(p, tree_bits, j) for j in range(1, p.d)]
+        )
+        return sig_fors, pk_fors, t8s, leaves
+
+    @jax.jit
+    def xmss_layer(sk_seed, pk_seed, msg, leaf, layer, t8):
+        ctx = _Ctx(p, pk_seed)
+        return _xmss_sign(ctx, msg, sk_seed, leaf, layer, t8)
+
+    return fors_part, xmss_layer
+
+
+def sign_digest_layered(p: SLHDSAParams, sk: jax.Array, r: jax.Array,
+                        digest: jax.Array):
+    """``sign_digest`` as 1 FORS dispatch + d per-layer XMSS dispatches.
+
+    Bit-identical output.  The XMSS-layer program takes the hypertree layer
+    index, ADRS tree field, and leaf index as traced operands, so it is
+    traced and compiled ONCE and reused for all d layers — the XLA graph is
+    ~d× smaller than the monolithic sign.  Measured effect (bench_report.md
+    config 4): 256s sign, whose monolithic graph never compiled at ANY
+    batch in this environment, runs at batch 32; 128s compiles at 512 vs
+    the monolithic 128.  Remote-compile-helper 500s at larger batches are
+    often transient (retry once before trusting a ceiling).
+    """
+    sk = jnp.asarray(sk, jnp.uint8)
+    r = jnp.asarray(r, jnp.uint8)
+    digest = jnp.asarray(digest, jnp.uint8)
+    fors_part, xmss_layer = _layered_fns(p)
+    sk_seed, pk_seed = sk[..., : p.n], sk[..., 2 * p.n : 3 * p.n]
+    sig_fors, msg, t8s, leaves = fors_part(sk_seed, pk_seed, digest)
+    parts = [r, sig_fors]
+    for j in range(p.d):
+        sig_x, msg = xmss_layer(sk_seed, pk_seed, msg, leaves[j],
+                                jnp.int32(j), t8s[j])
+        parts.append(sig_x)
+    return jnp.concatenate(parts, axis=-1)
+
+
 def verify_digest(p: SLHDSAParams, pk: jax.Array, digest: jax.Array, sig: jax.Array):
     """pk (B, 2n), digest (B, m), sig (B, sig_len) -> bool (B,)."""
     pk = jnp.asarray(pk, jnp.uint8)
@@ -436,12 +494,33 @@ def verify_digest(p: SLHDSAParams, pk: jax.Array, digest: jax.Array, sig: jax.Ar
     return jnp.all(node == pk_root, axis=-1)
 
 
+def _use_layered_sign(p: SLHDSAParams) -> bool:
+    """Layered sign for the s-sets by default (256s's monolithic graph never
+    compiled at any batch in this environment; 128s capped at 128);
+    QRP2P_SPHINCS_LAYERED=1/0 forces either path (trace-time flag: fresh
+    process per setting, same caveat as QRP2P_PALLAS)."""
+    flag = os.environ.get("QRP2P_SPHINCS_LAYERED", "auto")
+    if flag in ("0", "1"):
+        return flag == "1"
+    return p.hp >= 8
+
+
 @functools.cache
 def get(name: str):
-    """Jitted (keygen, sign_digest, verify_digest) for a parameter-set name."""
+    """(keygen, sign_digest, verify_digest) callables for a parameter set.
+
+    keygen/verify are jitted; sign is jitted for the f-sets but is the
+    layered multi-dispatch driver (``sign_digest_layered``, not a jit
+    object) for the s-sets — see ``_use_layered_sign``.
+    """
     p = PARAMS[name]
+    sign = (
+        functools.partial(sign_digest_layered, p)
+        if _use_layered_sign(p)
+        else jax.jit(functools.partial(sign_digest, p))
+    )
     return (
         jax.jit(functools.partial(keygen, p)),
-        jax.jit(functools.partial(sign_digest, p)),
+        sign,
         jax.jit(functools.partial(verify_digest, p)),
     )

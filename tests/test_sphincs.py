@@ -32,6 +32,27 @@ def _batch_seeds(p, batch):
     return [RNG.integers(0, 256, size=(batch, p.n), dtype=np.uint8) for _ in range(3)]
 
 
+def _oracle_keys_rs_digests(p, batch, msgs):
+    """Oracle keygen + FIPS message path: -> (sks list, sk_arr, r_arr, digest_arr)."""
+    sk_seed, sk_prf, pk_seed = _batch_seeds(p, batch)
+    sks = [
+        slh.keygen(p, sk_seed[i].tobytes(), sk_prf[i].tobytes(), pk_seed[i].tobytes())[1]
+        for i in range(batch)
+    ]
+    rs, digests = [], []
+    for i in range(batch):
+        skb = sks[i]
+        r = slh.prf_msg(p, skb[p.n : 2 * p.n], skb[2 * p.n : 3 * p.n], msgs[i])
+        rs.append(np.frombuffer(r, np.uint8))
+        digests.append(
+            np.frombuffer(
+                slh.h_msg(p, r, skb[2 * p.n : 3 * p.n], skb[3 * p.n :], msgs[i]), np.uint8
+            )
+        )
+    sk_arr = np.stack([np.frombuffer(s, np.uint8) for s in sks])
+    return sks, sk_arr, np.stack(rs), np.stack(digests)
+
+
 @pytest.mark.parametrize("name", FAST_SETS)
 def test_keygen_matches_oracle(name):
     p = slh.PARAMS[name]
@@ -51,36 +72,19 @@ def test_keygen_matches_oracle(name):
 def test_sign_verify_match_oracle(name):
     p = slh.PARAMS[name]
     batch = 2
-    sk_seed, sk_prf, pk_seed = _batch_seeds(p, batch)
-    kg, sign_digest, verify_digest = jslh.get(name)
-    pk, sk = np.asarray(kg(sk_seed, sk_prf, pk_seed)[0]), None
-    pks, sks = [], []
-    for i in range(batch):
-        rpk, rsk = slh.keygen(p, sk_seed[i].tobytes(), sk_prf[i].tobytes(), pk_seed[i].tobytes())
-        pks.append(rpk)
-        sks.append(rsk)
+    _, sign_digest, verify_digest = jslh.get(name)
     msgs = [b"msg-%d" % i * (i + 1) for i in range(batch)]
-    rs, digests = [], []
-    for i in range(batch):
-        skb = sks[i]
-        r = slh.prf_msg(p, skb[p.n : 2 * p.n], skb[2 * p.n : 3 * p.n], msgs[i])
-        rs.append(np.frombuffer(r, np.uint8))
-        digests.append(
-            np.frombuffer(
-                slh.h_msg(p, r, skb[2 * p.n : 3 * p.n], skb[3 * p.n :], msgs[i]), np.uint8
-            )
-        )
-    sk_arr = np.stack([np.frombuffer(s, np.uint8) for s in sks])
-    sigs = np.asarray(sign_digest(sk_arr, np.stack(rs), np.stack(digests)))
+    sks, sk_arr, r_arr, digest_arr = _oracle_keys_rs_digests(p, batch, msgs)
+    sigs = np.asarray(sign_digest(sk_arr, r_arr, digest_arr))
     for i in range(batch):
         ref_sig = slh.sign(p, sks[i], msgs[i])
         assert bytes(sigs[i]) == ref_sig, f"lane {i} diverges from oracle"
-    pk_arr = np.stack([np.frombuffer(k, np.uint8) for k in pks])
-    ok = np.asarray(verify_digest(pk_arr, np.stack(digests), sigs))
+    pk_arr = sk_arr[:, 2 * p.n :]
+    ok = np.asarray(verify_digest(pk_arr, digest_arr, sigs))
     assert ok.all()
     bad = sigs.copy()
     bad[:, p.n + 3] ^= 0xFF
-    assert not np.asarray(verify_digest(pk_arr, np.stack(digests), bad)).any()
+    assert not np.asarray(verify_digest(pk_arr, digest_arr, bad)).any()
 
 
 def test_provider_roundtrip_and_cross_backend():
@@ -97,3 +101,42 @@ def test_provider_roundtrip_and_cross_backend():
     assert not tpu.verify(pk, msg + b"x", sig)
     cpu_sig = cpu.sign(sk, msg)
     assert cpu_sig == sig  # both deterministic
+
+
+def test_layered_sign_matches_oracle_128f():
+    """sign_digest_layered is bit-exact vs the oracle across all 22 layers.
+
+    Cheaper than the monolithic oracle test: the layered path compiles one
+    FORS program plus ONE XMSS-layer program (layer index traced), so its
+    trace is ~d x smaller than sign_digest's.
+    """
+    name = "SPHINCS+-SHA2-128f-simple"
+    p = slh.PARAMS[name]
+    batch = 2
+    msgs = [b"layered-%d" % i for i in range(batch)]
+    sks, sk_arr, r_arr, digest_arr = _oracle_keys_rs_digests(p, batch, msgs)
+    sigs = np.asarray(jslh.sign_digest_layered(p, sk_arr, r_arr, digest_arr))
+    for i in range(batch):
+        assert bytes(sigs[i]) == slh.sign(p, sks[i], msgs[i]), f"lane {i} diverges"
+
+
+def test_layered_sign_128s_matches_oracle_and_verifies():
+    """The s-set default path: bit-exact vs the oracle + verify/tamper.
+
+    Keys come from the ORACLE keygen (sk is just seeds || pk), which skips
+    the expensive JAX-keygen trace; the layered sign itself compiles only
+    the FORS + one-XMSS-layer programs.
+    """
+    name = "SPHINCS+-SHA2-128s-simple"
+    p = slh.PARAMS[name]
+    batch = 2
+    msgs = [b"layered-s-%d" % i for i in range(batch)]
+    sks, sk_arr, r_arr, digest_arr = _oracle_keys_rs_digests(p, batch, msgs)
+    sigs = np.asarray(jslh.sign_digest_layered(p, sk_arr, r_arr, digest_arr))
+    for i in range(batch):
+        assert bytes(sigs[i]) == slh.sign(p, sks[i], msgs[i]), f"lane {i} diverges"
+    pk_arr = sk_arr[:, 2 * p.n :]
+    assert np.asarray(jslh.verify_digest(p, pk_arr, digest_arr, sigs)).all()
+    bad = sigs.copy()
+    bad[:, p.n + 3] ^= 0xFF
+    assert not np.asarray(jslh.verify_digest(p, pk_arr, digest_arr, bad)).any()
