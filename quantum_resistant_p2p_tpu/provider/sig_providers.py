@@ -283,7 +283,10 @@ class SPHINCSSignature(_MeshDispatchMixin, SignatureAlgorithm):
         p = self.params
         sigs = np.stack([np.frombuffer(bytes(s), np.uint8) for s in signatures])
         digests = []
-        for pk, m, sig in zip(public_keys, messages, signatures):
+        # iterate the NORMALIZED (L,) rows: a caller-supplied element may be
+        # (1, L)-shaped (the scalar verify path), where sig[: p.n] would row-
+        # slice and hand h_msg the whole signature as the randomizer
+        for pk, m, sig in zip(public_keys, messages, sigs):
             pkb = bytes(pk)
             r = bytes(sig[: p.n])
             digests.append(

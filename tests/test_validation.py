@@ -60,3 +60,27 @@ def test_signature_sign_rejects_bad_sk_and_verify_returns_false():
     assert sig.verify(pk[:-1], b"msg", s) is False
     assert sig.verify(pk, b"msg", s[:-1]) is False
     assert sig.verify(pk, b"msg", s) is True
+
+
+def test_sphincs_tpu_verify_batch_normalizes_2d_signature_elements():
+    """Scalar verify wraps operands as (1, L) arrays; verify_batch's digest
+    derivation must byte-slice the NORMALIZED rows, not the raw elements
+    (a (1, L) element row-slices to the whole signature and poisons h_msg's
+    randomizer — the scalar tpu-verify path returned False for every valid
+    signature until round 3)."""
+    sig_alg = get_signature("SPHINCS+-SHA2-128s-simple", backend="tpu")
+    p = sig_alg.params
+    rng = np.random.default_rng(3)
+    pk = rng.integers(0, 256, (1, p.pk_len), dtype=np.uint8)
+    sig_flat = rng.integers(0, 256, (p.sig_len,), dtype=np.uint8)
+    seen = []
+
+    def fake_verify(pks, digests, sigs):
+        seen.append(np.asarray(digests).copy())
+        return np.ones(len(np.asarray(pks)), dtype=bool)
+
+    sig_alg._verify_digest = fake_verify
+    sig_alg._mesh = None
+    sig_alg.verify_batch(pk, [b"m"], [sig_flat])          # 1-D element
+    sig_alg.verify_batch(pk, [b"m"], [sig_flat[None]])    # (1, L) element
+    assert (seen[0] == seen[1]).all(), "2-D element changed the derived digest"
