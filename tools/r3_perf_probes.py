@@ -153,7 +153,13 @@ def probe_sphincs_s_sign(out: dict) -> None:
         ("SPHINCS+-SHA2-256s-simple", (32, 64, 128, 256)),
     ):
         p = slhdsa_ref.PARAMS[name]
-        kg, ssign, _ = sphincs.get(name)
+        _, ssign, _ = sphincs.get(name)
+        # keys via ONE native-CPU keygen, repeated across the batch: keeps
+        # the device keygen compile (a monolithic 2^hp-leaf tree build)
+        # out of the probe so a failed rung locates the SIGN ceiling
+        from quantum_resistant_p2p_tpu.provider import get_signature
+
+        _, sk_one = get_signature(name, backend="cpu").generate_keypair()
         per_batch = {}
         for b in batches:
             # remote-compile-helper 500s are often TRANSIENT (same class as
@@ -161,12 +167,8 @@ def probe_sphincs_s_sign(out: dict) -> None:
             # twice-failed rungs count as the ceiling
             for attempt in (1, 2):
                 try:
-                    sk_seed, sk_prf, pk_seed = (
-                        _u8((b, p.n)), _u8((b, p.n)), _u8((b, p.n))
-                    )
-                    _, sk = kg(sk_seed, sk_prf, pk_seed)
-                    sync(sk)
-                    sk_d = jax.device_put(np.asarray(sk))
+                    sk = np.tile(np.frombuffer(sk_one, np.uint8), (b, 1))
+                    sk_d = jax.device_put(sk)
                     r, digest = (
                         jax.device_put(_u8((b, p.n))),
                         jax.device_put(_u8((b, p.m))),
