@@ -84,3 +84,58 @@ def test_sphincs_tpu_verify_batch_normalizes_2d_signature_elements():
     sig_alg.verify_batch(pk, [b"m"], [sig_flat])          # 1-D element
     sig_alg.verify_batch(pk, [b"m"], [sig_flat[None]])    # (1, L) element
     assert (seen[0] == seen[1]).all(), "2-D element changed the derived digest"
+
+
+def test_sphincs_tpu_sign_batch_sliced_at_compile_ceiling():
+    """s-set sign dispatches are capped at the measured compile ceiling
+    (_SLH_MAX_SIGN_BATCH): a queue-sized batch must arrive as fixed-size
+    slices, never as one giant program the compile helper cannot build."""
+    from quantum_resistant_p2p_tpu.provider import sig_providers
+
+    sig_alg = get_signature("SPHINCS+-SHA2-256s-simple", backend="tpu")
+    p = sig_alg.params
+    cap = sig_providers._SLH_MAX_SIGN_BATCH[p.name]
+    assert cap == 32
+    batches = []
+
+    def fake_sign(sks, rs, digests):
+        batches.append(len(np.asarray(sks)))
+        return np.zeros((len(np.asarray(sks)), p.sig_len), np.uint8)
+
+    sig_alg._sign_digest = fake_sign
+    sig_alg._mesh = None
+    n = 70
+    rng = np.random.default_rng(5)
+    sks = rng.integers(0, 256, (n, p.sk_len), dtype=np.uint8)
+    out = sig_alg.sign_batch(sks, [b"m%d" % i for i in range(n)])
+    assert len(out) == n
+    assert batches == [cap, cap, cap]  # 70 rows -> 3 padded slices of 32
+
+
+def test_sphincs_tpu_sign_batch_mesh_keeps_global_cap():
+    """With a provider mesh, the sign cap stays a GLOBAL bound: the compile
+    ceiling limits the whole traced program, so the per-device step must be
+    cap // mesh.size, never cap per device."""
+    from quantum_resistant_p2p_tpu.provider import sig_providers
+
+    sig_alg = get_signature("SPHINCS+-SHA2-256s-simple", backend="tpu", devices=8)
+    assert sig_alg._mesh is not None and sig_alg._mesh.size == 8
+    p = sig_alg.params
+    cap = sig_providers._SLH_MAX_SIGN_BATCH[p.name]  # 32
+    batches = []
+
+    def fake_sign(sks, rs, digests):
+        b = len(sks)
+        batches.append(b)
+        import jax.numpy as jnp
+
+        return jnp.zeros((b, p.sig_len), jnp.uint8)
+
+    sig_alg._sign_digest = fake_sign
+    n = 70
+    rng = np.random.default_rng(6)
+    sks = rng.integers(0, 256, (n, p.sk_len), dtype=np.uint8)
+    out = sig_alg.sign_batch(sks, [b"m%d" % i for i in range(n)])
+    assert len(out) == n
+    assert max(batches) <= cap  # global dispatch never exceeds the ceiling
+    assert sum(batches) >= n
