@@ -18,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from . import keccak  # _use_pallas: shared TPU-vs-CPU gate
+
 _K64 = [
     0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
     0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
@@ -84,9 +86,28 @@ def _block_words(block: jax.Array):
     return hi, lo
 
 
+#: below this flat batch the Pallas kernel's 1024-instance tile padding
+#: wastes more than the jnp path costs (same policy as core/sha256.py)
+_PALLAS_MIN_BATCH = 256
+
+
 def compress(state, block: jax.Array):
     """state ((..., 8), (..., 8)) uint32 pair, block (..., 128) uint8."""
     sh, sl = state
+    batch = sh.shape[:-1]
+    flat = int(np.prod(batch)) if batch else 1
+    if flat >= _PALLAS_MIN_BATCH and keccak._use_pallas():
+        from . import sha512_pallas  # deferred: pallas import
+
+        bh, bl = _block_words(jnp.asarray(block, jnp.uint8))
+        oh, ol = sha512_pallas.compress_words(
+            sh.reshape(flat, 8).T,
+            sl.reshape(flat, 8).T,
+            bh.reshape(flat, 16).T,
+            bl.reshape(flat, 16).T,
+        )
+        return oh.T.reshape(batch + (8,)), ol.T.reshape(batch + (8,))
+
     wh, wl = _block_words(block)
     kh, kl = jnp.asarray(_KH), jnp.asarray(_KL)
 

@@ -1,0 +1,123 @@
+"""Pallas TPU kernel for the SHA-512 compression function.
+
+SPHINCS+-SHA2 at the 192/256-bit security levels computes H / T_l / PRF_msg
+with SHA-512 (FIPS 205 §11.2), so an s-set sign at those levels is hundreds
+of thousands of SHA-512 compressions over wide batches.  The jnp
+``core.sha512.compress`` keeps the 8 emulated-64-bit state words and the
+16-word schedule window as HBM-resident (hi, lo) uint32 arrays across the 80
+``lax.fori_loop`` rounds — the materialise-between-rounds pattern whose
+elimination doubled the SHA-256 rows (core/sha256_pallas.py).  This kernel
+holds all 48 uint32 words (8+16 words x hi/lo pairs) in vector registers for
+the fully-unrolled 80 rounds; HBM sees one 128-byte block in and a 64-byte
+state out per instance.
+
+Layout identical to core/keccak_pallas.py (which holds 50 registers, so 48
+is proven ground): each word is an ``(8, 128)`` uint32 tile over 1024
+instances, launched through the shared ``sampler_call`` plumbing with the
+48 input rows split 24/24 across its two operand refs (purely a transport
+split).  Oracle: the jnp path (itself hashlib-anchored by
+tests/test_sha512.py); bit-exactness asserted by tests/test_sha512_pallas.py.
+
+Replaces (reference): the SHA-512 inside liboqs SPHINCS+-SHA2
+(crypto/signatures.py:191-315).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .keccak_pallas import sampler_call
+from .sha512 import _K64
+from .sha512 import _add64 as _add_pair
+from .sha512 import _rotr64 as _rotr_pair
+from .sha512 import _shr64 as _shr_pair
+
+
+def _compress_tiles(words: list) -> list:
+    """One SHA-512 compression over 48 uint32 word tiles.
+
+    ``words``: 8 state (hi, lo) pairs then 16 block (hi, lo) pairs, each a
+    same-shaped uint32 array.  Returns the 8 updated state pairs.  Pure
+    function — the Pallas kernel calls it on VMEM tiles, tests eagerly.
+    """
+    v = list(words[:8])            # [(hi, lo)] * 8
+    w = list(words[8:24])          # [(hi, lo)] * 16
+    h0 = list(v)
+    for t in range(80):
+        if t >= 16:
+            x15, x2 = w[(t - 15) % 16], w[(t - 2) % 16]
+            s0 = _rotr_pair(*x15, 1)
+            s0b = _rotr_pair(*x15, 8)
+            s0c = _shr_pair(*x15, 7)
+            sig0 = (s0[0] ^ s0b[0] ^ s0c[0], s0[1] ^ s0b[1] ^ s0c[1])
+            s1 = _rotr_pair(*x2, 19)
+            s1b = _rotr_pair(*x2, 61)
+            s1c = _shr_pair(*x2, 6)
+            sig1 = (s1[0] ^ s1b[0] ^ s1c[0], s1[1] ^ s1b[1] ^ s1c[1])
+            acc = _add_pair(*w[t % 16], *sig0)
+            acc = _add_pair(*acc, *w[(t - 7) % 16])
+            w[t % 16] = _add_pair(*acc, *sig1)
+        a, b, c, d, e, f, g, h = v
+        e1 = _rotr_pair(*e, 14)
+        e2 = _rotr_pair(*e, 18)
+        e3 = _rotr_pair(*e, 41)
+        s1 = (e1[0] ^ e2[0] ^ e3[0], e1[1] ^ e2[1] ^ e3[1])
+        ch = ((e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1]))
+        kt = _K64[t]
+        t1 = _add_pair(*h, *s1)
+        t1 = _add_pair(*t1, *ch)
+        t1 = _add_pair(*t1, jnp.uint32(kt >> 32), jnp.uint32(kt & 0xFFFFFFFF))
+        t1 = _add_pair(*t1, *w[t % 16])
+        a1 = _rotr_pair(*a, 28)
+        a2 = _rotr_pair(*a, 34)
+        a3 = _rotr_pair(*a, 39)
+        s0 = (a1[0] ^ a2[0] ^ a3[0], a1[1] ^ a2[1] ^ a3[1])
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t2 = _add_pair(*s0, *maj)
+        v = [_add_pair(*t1, *t2), a, b, c, _add_pair(*d, *t1), e, f, g]
+    return [_add_pair(*o, *s) for o, s in zip(v, h0)]
+
+
+def _compress_kernel(in_hi_ref, in_lo_ref, out_ref):
+    # 48 input rows split 24/24: in_hi rows = state hi(8) + state lo(8) +
+    # block hi words 0..7; in_lo rows = block hi words 8..15 + block lo(16).
+    sh = [in_hi_ref[i] for i in range(8)]
+    sl = [in_hi_ref[8 + i] for i in range(8)]
+    bh = [in_hi_ref[16 + i] for i in range(8)] + [in_lo_ref[i] for i in range(8)]
+    bl = [in_lo_ref[8 + i] for i in range(16)]
+    words = [(sh[i], sl[i]) for i in range(8)] + [(bh[i], bl[i]) for i in range(16)]
+    out = _compress_tiles(words)
+    for i in range(8):
+        out_ref[i] = out[i][0].astype(jnp.int32)
+        out_ref[8 + i] = out[i][1].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def compress_words(
+    state_hi: jax.Array,
+    state_lo: jax.Array,
+    block_hi: jax.Array,
+    block_lo: jax.Array,
+    *,
+    interpret: bool = False,
+):
+    """Batched SHA-512 compression over word-transposed inputs.
+
+    Args:
+      state_hi/state_lo: (8, B) uint32 state word halves, batch minor.
+      block_hi/block_lo: (16, B) uint32 message-block word halves.
+
+    Returns:
+      ((8, B), (8, B)) uint32 updated state halves.
+    """
+    in_hi = jnp.concatenate([state_hi, state_lo, block_hi[:8]], axis=0)
+    in_lo = jnp.concatenate([block_hi[8:], block_lo], axis=0)
+    out = sampler_call(_compress_kernel, 24, 16, in_hi, in_lo, interpret=interpret)
+    out = out.astype(jnp.uint32)
+    return out[:8], out[8:]

@@ -13,10 +13,17 @@ it hardest to map); the decomposition here:
 * The inner RM(1,7) decoder is a batched fast Hadamard transform (7 static
   butterfly stages) over soft-combined duplicates — exactly the
   structure TPUs like.
-* The outer Reed-Solomon decoder runs entirely in-graph: syndrome evaluation
-  and Chien search are GF(256) table lookups (log/exp gathers) contracted
-  over static index grids; Berlekamp-Massey is a 2*delta-step scan with
-  masked (branch-free) L/b/m updates.
+* The outer Reed-Solomon decoder runs entirely in-graph and GATHER-FREE:
+  GF(256) products against static constants (syndrome grids, Chien/Forney
+  evaluation points, generator polynomials) are 8 masked XORs against
+  precomputed ``x^k * c`` tables; variable-by-variable products
+  (Berlekamp-Massey) are branch-free carry-less-multiply + polynomial
+  reduction circuits; inversion is the ``b^254`` addition chain.  BM's
+  ``x^m * B(x)`` term — a per-lane dynamic shift in the textbook
+  formulation — is maintained incrementally as a shift-by-one of a
+  select, so no per-lane indices exist anywhere in the decode path.
+  (Round 3 first measurement had log/exp-gather GF ops; this rewrite
+  removed the family's last table gathers.)
 * Fisher-Yates fixed-weight sampling follows the same downward-scan dedup as
   the oracle (sequential fori_loop over w slots, vectorised compares).
 
@@ -38,7 +45,6 @@ from jax import lax
 from ..core import keccak
 from ..pyref.hqc_ref import (
     _GF_EXP,
-    _GF_LOG,
     _RM_ENC_TABLE,
     RM_N,
     HQCParams,
@@ -55,8 +61,7 @@ from ..pyref.hqc_ref import (
 #: slicing costs ~nothing).
 MAX_DEVICE_BATCH = 128
 
-_EXP = np.asarray(_GF_EXP, dtype=np.int32)  # length 512
-_LOG = np.asarray(_GF_LOG, dtype=np.int32)
+_EXP = np.asarray(_GF_EXP, dtype=np.int32)  # length 512 (host-side table builds)
 
 # RM(1,7) encode table as a (256, 128) bit matrix
 _RM_BITS = np.array(
@@ -64,11 +69,58 @@ _RM_BITS = np.array(
 )
 
 
+# field modulus recovered from the pyref tables: x^8 ≡ exp[8] (mod poly)
+# for a degree-8 monic modulus means poly = 0x100 | exp[8]  (= 0x11D for HQC)
+_GF_POLY = int(_GF_EXP[8] | 0x100)
+
+
 def _gf_mul(a: jax.Array, b: jax.Array) -> jax.Array:
-    exp = jnp.asarray(_EXP)
-    log = jnp.asarray(_LOG)
-    prod = jnp.take(exp, jnp.take(log, a) + jnp.take(log, b))
-    return jnp.where((a == 0) | (b == 0), 0, prod)
+    """GF(256) product, gather-free: 8-step carry-less multiply + 7-step
+    polynomial reduction, pure AND/XOR/shift on int32 lanes.  Replaces the
+    log/exp table lookups (3 per-lane gathers per product — the TPU
+    anti-pattern this module eliminated everywhere else)."""
+    a = a.astype(jnp.int32) if isinstance(a, jax.Array) else jnp.asarray(a, jnp.int32)
+    b = b.astype(jnp.int32) if isinstance(b, jax.Array) else jnp.asarray(b, jnp.int32)
+    p = jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape), jnp.int32)
+    for k in range(8):
+        p = p ^ ((-((b >> k) & 1)) & (a << k))
+    for k in range(14, 7, -1):
+        p = p ^ ((-((p >> k) & 1)) & (_GF_POLY << (k - 8)))
+    return p
+
+
+def _gf_inv(x: jax.Array) -> jax.Array:
+    """x^254 = x^-1 in GF(256) (0 -> 0), 4-multiply/7-square chain."""
+    x2 = _gf_mul(x, x)
+    x3 = _gf_mul(x2, x)
+    x12 = _gf_mul(_gf_mul(x3, x3), _gf_mul(x3, x3))
+    x15 = _gf_mul(x12, x3)
+    x240 = x15
+    for _ in range(4):
+        x240 = _gf_mul(x240, x240)
+    return _gf_mul(_gf_mul(x240, x12), x2)
+
+
+def _gf_const_tables(c: np.ndarray) -> np.ndarray:
+    """(8,) + c.shape int32 tables t[k] = x^k * c, for masked-XOR products."""
+    c = np.asarray(c, np.int64)
+    out = np.zeros((8,) + c.shape, np.int32)
+    for k in range(8):
+        v = c << k
+        for j in range(14, 7, -1):
+            v = np.where((v >> j) & 1, v ^ (_GF_POLY << (j - 8)), v)
+        out[k] = v
+    return out
+
+
+def _gf_mul_const(x: jax.Array, tables: jax.Array) -> jax.Array:
+    """GF(256) product of variable x against precomputed constant tables
+    (from :func:`_gf_const_tables`): 8 masked XORs, no reduction step."""
+    x = x.astype(jnp.int32)
+    acc = jnp.zeros(jnp.broadcast_shapes(x.shape, tables.shape[1:]), jnp.int32)
+    for k in range(8):
+        acc = acc ^ ((-((x >> k) & 1)) & tables[k])
+    return acc
 
 
 def _xor_reduce(x: jax.Array, axis: int) -> jax.Array:
@@ -136,12 +188,11 @@ def _fixed_weight_support(p: HQCParams, rand: jax.Array, weight: int) -> jax.Arr
 
     def fix(k, s):
         i = weight - 1 - k
-        si = jnp.take_along_axis(s, jnp.full(s.shape[:-1] + (1,), i), axis=-1)
+        # contiguous dynamic slice + masked write — no per-lane gather/scatter
+        si = lax.dynamic_slice_in_dim(s, i, 1, axis=-1)
         clash = jnp.any((s == si) & (idx > i), axis=-1, keepdims=True)
         si_new = jnp.where(clash, i, si)
-        return jnp.put_along_axis(
-            s, jnp.full(s.shape[:-1] + (1,), i), si_new, axis=-1, inplace=False
-        )
+        return jnp.where(idx == i, si_new, s)
 
     return lax.fori_loop(0, weight, fix, sup)
 
@@ -246,110 +297,119 @@ def _cyclic_mul_sparse(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Ar
 # -- Reed-Solomon over GF(2^8), in-graph --------------------------------------
 
 
+@functools.cache
+def _rs_gen_tables(p: HQCParams) -> np.ndarray:
+    return _gf_const_tables(np.asarray(_rs_gen_poly(p)[: 2 * p.delta], np.int64))
+
+
 def _rs_encode(p: HQCParams, msg: jax.Array) -> jax.Array:
-    """(batch, k) int32 bytes -> (batch, n1) codeword."""
-    g = jnp.asarray(np.asarray(_rs_gen_poly(p)[: 2 * p.delta], np.int32))
+    """(batch, k) int32 bytes -> (batch, n1) codeword.
+
+    Unrolled LFSR division (k <= 32 static steps) with the generator
+    product as masked XORs against constant tables — no gathers."""
+    g_tab = jnp.asarray(_rs_gen_tables(p))
     red = 2 * p.delta
-    rem0 = jnp.zeros(msg.shape[:-1] + (red,), jnp.int32)
-
-    def step(j, rem):
-        byte = jnp.take_along_axis(
-            msg, jnp.full(msg.shape[:-1] + (1,), p.k - 1 - j), axis=-1
-        )[..., 0]
-        coef = byte ^ rem[..., -1]
+    rem = jnp.zeros(msg.shape[:-1] + (red,), jnp.int32)
+    for j in range(p.k):
+        coef = msg[..., p.k - 1 - j] ^ rem[..., -1]
         rem = jnp.concatenate([jnp.zeros_like(rem[..., :1]), rem[..., :-1]], axis=-1)
-        return rem ^ _gf_mul(g, coef[..., None])
-
-    rem = lax.fori_loop(0, p.k, step, rem0)
+        rem = rem ^ _gf_mul_const(coef[..., None], g_tab)
     return jnp.concatenate([rem, msg], axis=-1)
 
 
-def _rs_syndromes(p: HQCParams, cw: jax.Array) -> jax.Array:
+@functools.cache
+def _syndrome_tables(p: HQCParams) -> np.ndarray:
     red = 2 * p.delta
     ij = np.outer(np.arange(1, red + 1), np.arange(p.n1)) % 255
-    alpha_ij = jnp.asarray(_EXP[ij])  # (red, n1)
-    terms = _gf_mul(cw[..., None, :], jnp.broadcast_to(alpha_ij, cw.shape[:-1] + (red, p.n1)))
+    return _gf_const_tables(_EXP[ij].astype(np.int64))  # (8, red, n1)
+
+
+def _rs_syndromes(p: HQCParams, cw: jax.Array) -> jax.Array:
+    terms = _gf_mul_const(cw[..., None, :], jnp.asarray(_syndrome_tables(p)))
     return _xor_reduce(terms, -1)  # (batch, red)
 
 
 def _rs_bm(p: HQCParams, synd: jax.Array) -> jax.Array:
-    """Branch-free Berlekamp-Massey -> sigma (batch, red+1) int32."""
+    """Branch-free, gather-free Berlekamp-Massey -> sigma (batch, red+1).
+
+    Two reformulations keep per-lane indices out of the scan body: the
+    syndrome window S[n_it], .., S[n_it-deg+1] is one contiguous
+    ``dynamic_slice`` of the zero-padded syndrome array (reversed — a
+    static op), and the textbook ``x^m * B(x)`` update term — a per-lane
+    dynamic shift, since m is data-dependent — is carried incrementally:
+    ``D_next = x * (sigma_old if grow else D)``, a shift-by-one of a
+    select, which reproduces x^m * B exactly (m resets to 1 on growth).
+    """
     red = 2 * p.delta
     batch = synd.shape[:-1]
     deg = red + 1
     sigma0 = jnp.zeros(batch + (deg,), jnp.int32).at[..., 0].set(1)
-    b0 = sigma0
-    state = (sigma0, b0, jnp.zeros(batch, jnp.int32), jnp.ones(batch, jnp.int32),
-             jnp.ones(batch, jnp.int32))  # sigma, b, L, bb, m
+    # D = x^m * B(x); initially m=1, B=1 => D = x
+    d0 = jnp.zeros(batch + (deg,), jnp.int32).at[..., 1].set(1)
+    state = (sigma0, d0, jnp.zeros(batch, jnp.int32), jnp.ones(batch, jnp.int32))
 
     spad = jnp.concatenate([jnp.zeros(batch + (deg,), jnp.int32), synd], axis=-1)
 
+    def shift1(v):
+        return jnp.concatenate([jnp.zeros_like(v[..., :1]), v[..., :-1]], axis=-1)
+
     def step(n_it, st):
-        sigma, b, L, bb, m = st
-        # d = XOR_i sigma[i] * S[n_it - i]  (S index via padded gather)
-        sidx = (deg + n_it) - jnp.arange(deg)  # positions in spad
-        s_slice = jnp.take(spad, sidx, axis=-1) if spad.ndim == 1 else jnp.take_along_axis(
-            spad, jnp.broadcast_to(sidx, batch + (deg,)), axis=-1
-        )
+        sigma, D, L, bb = st
+        # d = XOR_i sigma[i] * S[n_it - i]: spad[n_it+1 .. n_it+deg] reversed
+        window = lax.dynamic_slice_in_dim(spad, n_it + 1, deg, axis=-1)
+        s_slice = jnp.flip(window, axis=-1)
         d = _xor_reduce(_gf_mul(sigma, s_slice), -1)
         dz = d == 0
-        inv_bb = jnp.take(jnp.asarray(_EXP), (255 - jnp.take(jnp.asarray(_LOG), bb)) % 255)
-        coef = _gf_mul(d, inv_bb)
-        # shifted = x^m * b  (gather with negative-index mask)
-        tgt = jnp.arange(deg) - m[..., None]
-        shifted = jnp.where(
-            tgt >= 0,
-            jnp.take_along_axis(b, jnp.maximum(tgt, 0), axis=-1),
-            0,
-        )
-        sigma_new = sigma ^ _gf_mul(coef[..., None], shifted)
+        coef = _gf_mul(d, _gf_inv(bb))
+        sigma_new = sigma ^ _gf_mul(coef[..., None], D)
         grow = (~dz) & (2 * L <= n_it)
         sigma_out = jnp.where(dz[..., None], sigma, sigma_new)
-        b_out = jnp.where(grow[..., None], sigma, b)
+        D_out = shift1(jnp.where(grow[..., None], sigma, D))
         L_out = jnp.where(grow, n_it + 1 - L, L)
         bb_out = jnp.where(grow, d, bb)
-        m_out = jnp.where(grow, 1, m + 1)
-        return sigma_out, b_out, L_out, bb_out, m_out
+        return sigma_out, D_out, L_out, bb_out
 
     sigma, *_ = lax.fori_loop(0, red, step, state)
     return sigma
 
 
+@functools.cache
+def _chien_forney_tables(p: HQCParams) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    red = 2 * p.delta
+    deg = red + 1
+    inv_j = (255 - np.arange(p.n1)) % 255
+    ij = np.outer(np.arange(deg), inv_j) % 255
+    ijo = np.outer(np.arange(red), inv_j) % 255
+    odd = np.arange(1, deg, 2)
+    ijd = np.outer(odd - 1, inv_j) % 255
+    return (
+        _gf_const_tables(_EXP[ij].astype(np.int64)),   # (8, deg, n1)
+        _gf_const_tables(_EXP[ijo].astype(np.int64)),  # (8, red, n1)
+        _gf_const_tables(_EXP[ijd].astype(np.int64)),  # (8, len(odd), n1)
+    )
+
+
 def _rs_decode(p: HQCParams, cw: jax.Array) -> jax.Array:
     """(batch, n1) int32 -> (batch, k) message bytes (corrects <= delta errors)."""
     red = 2 * p.delta
-    deg = red + 1
+    t_chien, t_omega, t_deriv = (jnp.asarray(t) for t in _chien_forney_tables(p))
     synd = _rs_syndromes(p, cw)
     sigma = _rs_bm(p, synd)
     # Chien over all positions: sigma(alpha^{-j})
-    ij = np.outer(np.arange(deg), (255 - np.arange(p.n1)) % 255) % 255
-    xpow = jnp.asarray(_EXP[ij])  # (deg, n1): (alpha^{-j})^i
-    ev = _xor_reduce(_gf_mul(sigma[..., :, None], xpow), -2)  # (batch, n1)
+    ev = _xor_reduce(_gf_mul_const(sigma[..., :, None], t_chien), -2)  # (batch, n1)
     is_err = ev == 0
-    # omega = S(x) * sigma(x) mod x^red, one static contraction per degree
+    # omega = S(x) * sigma(x) mod x^red: one static-slice contraction per
+    # degree (sigma[..., i::-1] is a strided slice, not a gather)
     omega = []
     for i in range(red):
-        terms = []
-        for j in range(min(i + 1, deg)):
-            terms.append((j, i - j))
-        idx_sig = np.array([t[0] for t in terms])
-        idx_s = np.array([t[1] for t in terms])
-        prod = _gf_mul(sigma[..., idx_sig], synd[..., idx_s])
+        prod = _gf_mul(sigma[..., : i + 1], jnp.flip(synd[..., : i + 1], -1))
         omega.append(_xor_reduce(prod, -1))
     omega = jnp.stack(omega, axis=-1)  # (batch, red)
     # Forney at every position (masked by is_err): num = omega(alpha^{-j})
-    ijo = np.outer(np.arange(red), (255 - np.arange(p.n1)) % 255) % 255
-    xpo = jnp.asarray(_EXP[ijo])  # (red, n1)
-    num = _xor_reduce(_gf_mul(omega[..., :, None], xpo), -2)
+    num = _xor_reduce(_gf_mul_const(omega[..., :, None], t_omega), -2)
     # den = sigma'(alpha^{-j}) = sum over odd i of sigma[i] (alpha^{-j})^{i-1}
-    odd = np.arange(1, deg, 2)
-    ijd = np.outer(odd - 1, (255 - np.arange(p.n1)) % 255) % 255
-    xpd = jnp.asarray(_EXP[ijd])  # (len(odd), n1)
-    den = _xor_reduce(_gf_mul(sigma[..., odd, None], xpd), -2)
-    log = jnp.asarray(_LOG)
-    exp = jnp.asarray(_EXP)
-    inv_den = jnp.where(den == 0, 0, jnp.take(exp, (255 - jnp.take(log, den)) % 255))
-    mag = _gf_mul(num, inv_den)
+    den = _xor_reduce(_gf_mul_const(sigma[..., 1::2, None], t_deriv), -2)
+    mag = _gf_mul(num, _gf_inv(den))
     corrected = cw ^ jnp.where(is_err & (den != 0), mag, 0)
     return corrected[..., red:]
 
@@ -357,10 +417,29 @@ def _rs_decode(p: HQCParams, cw: jax.Array) -> jax.Array:
 # -- duplicated RM(1,7) -------------------------------------------------------
 
 
+# RM(1,7) is linear: encode(m) = XOR of generator rows selected by m's bits.
+# Verified against the pyref table at import; kills the (256, 128) per-lane
+# table gather in _rm_encode.
+_RM_ROWS = np.stack([_RM_BITS[1 << k] for k in range(8)])  # (8, 128)
+assert all(
+    np.array_equal(
+        np.bitwise_xor.reduce(
+            [_RM_ROWS[k] for k in range(8) if (v >> k) & 1] or [np.zeros(RM_N, np.int32)]
+        ),
+        _RM_BITS[v],
+    )
+    for v in range(256)
+), "RM(1,7) table is not linear — generator-row encode would be wrong"
+
+
 def _rm_encode(p: HQCParams, rs_cw: jax.Array) -> jax.Array:
-    """(batch, n1) bytes -> (batch, n1*n2) bits."""
-    table = jnp.asarray(_RM_BITS, jnp.uint8)
-    cw = jnp.take(table, rs_cw, axis=0)  # (batch, n1, 128)
+    """(batch, n1) bytes -> (batch, n1*n2) bits (linear masked-XOR encode)."""
+    rows = jnp.asarray(_RM_ROWS, jnp.int32)  # (8, 128)
+    x = rs_cw[..., None].astype(jnp.int32)  # (batch, n1, 1)
+    acc = jnp.zeros(rs_cw.shape + (RM_N,), jnp.int32)
+    for k in range(8):
+        acc = acc ^ ((-((x >> k) & 1)) & rows[k])
+    cw = acc.astype(jnp.uint8)  # (batch, n1, 128)
     dup = jnp.repeat(cw[..., None, :], p.dup, axis=-2)  # (batch, n1, dup, 128)
     return dup.reshape(rs_cw.shape[:-1] + (p.n1 * p.n2,))
 
@@ -376,7 +455,9 @@ def _rm_decode(p: HQCParams, bits: jax.Array) -> jax.Array:
         f = jnp.stack([a + b, a - b], axis=-2).reshape(f.shape)
         h *= 2
     best = jnp.argmax(jnp.abs(f), axis=-1)  # (batch, n1)
-    fbest = jnp.take_along_axis(f, best[..., None], axis=-1)[..., 0]
+    # select f[best] without a per-lane gather: one-hot contraction
+    onehot = (jnp.arange(RM_N) == best[..., None]).astype(jnp.int32)
+    fbest = jnp.sum(f * onehot, axis=-1)
     b0 = (fbest < 0).astype(jnp.int32)
     return (best << 1) | b0
 

@@ -99,3 +99,28 @@ def test_bitsliced_aes_matches_gather_and_openssl():
     assert np.array_equal(got, ref)
     enc = Cipher(algorithms.AES(bytes(keys[1])), modes.ECB()).encryptor()
     assert enc.update(bytes(blocks[1].reshape(-1))) == bytes(got[1].reshape(-1))
+
+
+def test_sbox_circuits_exhaustive():
+    """Both bitsliced S-box circuits (Boyar-Peralta default + the derived
+    field circuit) equal the table S-box on all 256 byte values."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import aes_bitsliced as bs
+
+    vals = np.arange(256, dtype=np.uint64)
+    # pack 256 values as bit planes, 4 uint32 words x 2 lanes shape (8,)
+    planes = []
+    for i in range(8):
+        bits = ((vals >> i) & 1).astype(np.uint32)
+        words = (bits.reshape(8, 32) << np.arange(32, dtype=np.uint32)).sum(
+            axis=1, dtype=np.uint32
+        )
+        planes.append(jnp.asarray(words))
+    for circuit in (bs._sbox_planes_bp, bs._sbox_planes_derived):
+        out = [np.asarray(p) for p in circuit(planes)]
+        res = np.zeros(256, dtype=np.uint8)
+        for i in range(8):
+            bits = (out[i][:, None] >> np.arange(32, dtype=np.uint32)) & 1
+            res |= (bits.reshape(-1).astype(np.uint8) << i)
+        assert np.array_equal(res, bs._SBOX), circuit.__name__

@@ -186,11 +186,72 @@ def probe_sphincs_s_sign(out: dict) -> None:
     out["sphincs_s_sign"] = res
 
 
+def probe_mlkem_breakdown(out: dict) -> None:
+    """Per-stage timing of ML-KEM-768 encaps at the provider's slice size
+    (1024): locates where the next headline point lives.  Parts are timed
+    as standalone jitted programs (device-resident operands), so their sum
+    exceeds the fused whole — the ranking, not the absolute split, is the
+    signal."""
+    import jax
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import mlkem
+
+    batch = 1024
+    p = mlkem.PARAMS["ML-KEM-768"]
+    k = p.k
+    rng = np.random.default_rng(20260731)
+    rho = jax.device_put(rng.integers(0, 256, (batch, 32), dtype=np.uint8))
+    r32 = jax.device_put(rng.integers(0, 256, (batch, 32), dtype=np.uint8))
+    polys = jax.device_put(
+        rng.integers(0, mlkem.Q, (batch, k, mlkem.N), dtype=np.int32)
+    )
+    mat = jax.device_put(
+        rng.integers(0, mlkem.Q, (batch, k, k, mlkem.N), dtype=np.int32)
+    )
+    ek, _ = mlkem.get("ML-KEM-768")[0](
+        jax.device_put(rng.integers(0, 256, (batch, 32), dtype=np.uint8)),
+        jax.device_put(rng.integers(0, 256, (batch, 32), dtype=np.uint8)),
+    )
+    sync(ek)
+    ek = jax.device_put(np.asarray(ek))
+    m = jax.device_put(rng.integers(0, 256, (batch, 32), dtype=np.uint8))
+
+    jj = jax.jit
+    parts = {
+        "expand_matrix": (jj(lambda r: mlkem._expand_matrix(r, k)), (rho,)),
+        "prf_cbd_eta2_x3": (
+            jj(lambda s: mlkem._prf_cbd(s, np.arange(k), 2)), (r32,)),
+        "ntt_3polys": (jj(mlkem.ntt), (polys,)),
+        "ntt_inv_3polys": (jj(mlkem.ntt_inv), (polys,)),
+        "matvec_basemul": (
+            jj(lambda a, y: jnp.sum(
+                mlkem.multiply_ntts(a, y[..., :, None, :]), axis=-3) % mlkem.Q),
+            (mat, polys)),
+        "byte_encode_d12": (jj(lambda x: mlkem.byte_encode(x, 12)), (polys,)),
+        "byte_decode_d12": (
+            jj(lambda b: mlkem.byte_decode(
+                b.reshape(b.shape[:-1] + (k, 384)), 12)),
+            (jax.device_put(
+                rng.integers(0, 256, (batch, 384 * k), dtype=np.uint8)),)),
+        "compress_encode_du10": (
+            jj(lambda x: mlkem.byte_encode(mlkem.compress(x, 10), 10)), (polys,)),
+        "full_encaps": (mlkem.get("ML-KEM-768")[1], (ek, m)),
+    }
+    res = {}
+    for name, (fn, args) in parts.items():
+        dt = timeit(fn, *args)
+        res[name] = {"ms_per_1024": round(dt * 1e3, 3),
+                     "ops_per_s": round(batch / dt, 1)}
+    out["mlkem_breakdown"] = res
+
+
 PROBES = {
     "mldsa_sign_compact": probe_mldsa_sign_compact,
     "frodo_aes": probe_frodo_aes,
     "hqc_tpu": probe_hqc_tpu,
     "sphincs_s_sign": probe_sphincs_s_sign,
+    "mlkem_breakdown": probe_mlkem_breakdown,
 }
 
 
