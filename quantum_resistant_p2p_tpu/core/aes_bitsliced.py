@@ -276,7 +276,7 @@ def _sbox_planes_bp(x: list) -> list:
 
 
 def _sbox_planes(x: list) -> list:
-    if os.environ.get("QRP2P_AES_DERIVED_SBOX"):
+    if os.environ.get("QRP2P_AES_DERIVED_SBOX") == "1":
         return _sbox_planes_derived(x)
     return _sbox_planes_bp(x)
 

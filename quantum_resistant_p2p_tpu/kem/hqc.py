@@ -434,12 +434,9 @@ assert all(
 
 def _rm_encode(p: HQCParams, rs_cw: jax.Array) -> jax.Array:
     """(batch, n1) bytes -> (batch, n1*n2) bits (linear masked-XOR encode)."""
-    rows = jnp.asarray(_RM_ROWS, jnp.int32)  # (8, 128)
-    x = rs_cw[..., None].astype(jnp.int32)  # (batch, n1, 1)
-    acc = jnp.zeros(rs_cw.shape + (RM_N,), jnp.int32)
-    for k in range(8):
-        acc = acc ^ ((-((x >> k) & 1)) & rows[k])
-    cw = acc.astype(jnp.uint8)  # (batch, n1, 128)
+    cw = _gf_mul_const(
+        rs_cw[..., None], jnp.asarray(_RM_ROWS, jnp.int32)
+    ).astype(jnp.uint8)  # (batch, n1, 128)
     dup = jnp.repeat(cw[..., None, :], p.dup, axis=-2)  # (batch, n1, dup, 128)
     return dup.reshape(rs_cw.shape[:-1] + (p.n1 * p.n2,))
 

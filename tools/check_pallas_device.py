@@ -106,6 +106,22 @@ def check_sha256_compress() -> None:
     assert np.array_equal(got, ref), "sha256 compress_words diverges from jnp path"
 
 
+def check_sha512_compress() -> None:
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import sha512, sha512_pallas
+
+    rng = np.random.default_rng(10)
+    sh = jnp.asarray(rng.integers(0, 1 << 32, (B, 8), dtype=np.uint32))
+    sl = jnp.asarray(rng.integers(0, 1 << 32, (B, 8), dtype=np.uint32))
+    block = jnp.asarray(rng.integers(0, 256, (B, 128), dtype=np.uint8))
+    rh, rl = sha512.compress((sh, sl), block)
+    bh, bl = sha512._block_words(block)
+    oh, ol = sha512_pallas.compress_words(sh.T, sl.T, bh.T, bl.T)
+    assert np.array_equal(np.asarray(oh.T), np.asarray(rh)), "sha512 hi diverges"
+    assert np.array_equal(np.asarray(ol.T), np.asarray(rl)), "sha512 lo diverges"
+
+
 def check_sponge() -> None:
     """shake256 through sponge_words (multi-block absorb+squeeze) vs jnp."""
     import jax.numpy as jnp
@@ -132,6 +148,7 @@ CHECKS = [
     ("rej_bounded_words eta=2", lambda: check_rej_bounded(2)),
     ("rej_bounded_words eta=4", lambda: check_rej_bounded(4)),
     ("sha256 compress_words", check_sha256_compress),
+    ("sha512 compress_words", check_sha512_compress),
     ("sponge_words shake256", check_sponge),
 ]
 
