@@ -7,9 +7,11 @@ it hardest to map); the decomposition here:
 
 * Code vectors live as dense (batch, n) uint8 bit arrays — no 64-bit packing
   (TPUs have no 64-bit lanes and XLA vectorises byte lanes fine).  The
-  sparse-by-dense cyclic product x^p * a mod (x^n - 1) is a gather with
-  rotated indices; a fixed-weight product is a ``fori_loop`` of w <= 149 such
-  gathers accumulated in int32 and reduced mod 2.
+  sparse-by-dense cyclic product x^p * a mod (x^n - 1) is an exact-f32
+  FFT convolution by default (``_cyclic_mul_fft`` — conv values <= w <= 149
+  sit far inside float32's exact integer range); the blocked-Toeplitz MXU
+  contraction (``QRP2P_HQC_FFT=0``) and the rotated-gather loop
+  (``QRP2P_HQC_GATHER=1``) remain for A/B.
 * The inner RM(1,7) decoder is a batched fast Hadamard transform (7 static
   butterfly stages) over soft-combined duplicates — exactly the
   structure TPUs like.
@@ -211,13 +213,18 @@ def _sample_random_bits(p: HQCParams, seed: jax.Array) -> jax.Array:
 # -- cyclic arithmetic --------------------------------------------------------
 
 
-def _use_matmul_cyclic() -> bool:
-    """Blocked-circulant MXU formulation by default; QRP2P_HQC_GATHER=1
-    restores the rotated-gather loop for A/B runs.  Read at TRACE time
-    (fresh process per setting, same caveat as QRP2P_PALLAS)."""
+def _cyclic_impl() -> str:
+    """Which cyclic-product formulation to trace: "fft" (default),
+    "matmul" (QRP2P_HQC_FFT=0 — the blocked-circulant MXU path), or
+    "gather" (QRP2P_HQC_GATHER=1 — the rotated-gather loop).  Read at
+    TRACE time (fresh process per setting, same caveat as QRP2P_PALLAS)."""
     import os
 
-    return os.environ.get("QRP2P_HQC_GATHER", "0") != "1"
+    if os.environ.get("QRP2P_HQC_GATHER", "0") == "1":
+        return "gather"
+    if os.environ.get("QRP2P_HQC_FFT", "1") == "0":
+        return "matmul"
+    return "fft"
 
 
 def _cyclic_block(n: int) -> int:
@@ -272,14 +279,42 @@ def _cyclic_mul_matmul(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Ar
     return (acc & 1).astype(jnp.uint8)
 
 
+def _cyclic_mul_fft(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:
+    """Cyclic product as an exact float32 FFT convolution.
+
+    The integer circular convolution of two 0/1 vectors has values
+    <= w <= 149 — far inside float32's exact-integer range — and the
+    f32 round-trip error at these sizes measures ~1e-4 (worst case
+    all-ones dense, asserted in tests/test_hqc.py), a ~5000x margin
+    under the 0.5 rounding threshold.  O(N log N) replaces the Toeplitz
+    path's O(n^2) MACs and, more importantly, its ~chunk-materialisation
+    HBM traffic (the measured bottleneck of every HQC op).  n is prime
+    (no length-n FFT), so a pow2-padded LINEAR convolution is folded
+    back to circular: circ[i] = lin[i] + lin[i + n].
+    """
+    n = p.n
+    nfft = 1 << (2 * n - 2).bit_length()
+    y = _support_to_bits(p, sup)
+    fd = jnp.fft.rfft(dense.astype(jnp.float32), nfft, axis=-1)
+    fy = jnp.fft.rfft(y.astype(jnp.float32), nfft, axis=-1)
+    lin = jnp.fft.irfft(fd * fy, nfft, axis=-1)
+    tail = jnp.pad(lin[..., n : 2 * n - 1], [(0, 0)] * (lin.ndim - 1) + [(0, 1)])
+    circ = lin[..., :n] + tail
+    return (jnp.rint(circ).astype(jnp.int32) & 1).astype(jnp.uint8)
+
+
 def _cyclic_mul_sparse(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:
     """dense (batch, n) bits x support (batch, w) -> (batch, n) bits.
 
-    out[i] = XOR_k dense[(i - p_k) mod n].  Dispatches to the blocked
-    circulant MXU formulation by default; the per-support rotated-gather
-    loop remains for A/B (QRP2P_HQC_GATHER=1).
+    out[i] = XOR_k dense[(i - p_k) mod n].  Dispatches to the exact-f32
+    FFT convolution by default; the blocked-circulant MXU formulation
+    (QRP2P_HQC_FFT=0) and the per-support rotated-gather loop
+    (QRP2P_HQC_GATHER=1) remain for A/B.
     """
-    if _use_matmul_cyclic():
+    impl = _cyclic_impl()
+    if impl == "fft":
+        return _cyclic_mul_fft(p, dense, sup)
+    if impl == "matmul":
         return _cyclic_mul_matmul(p, dense, sup)
     n = p.n
     w = sup.shape[-1]

@@ -90,6 +90,40 @@ def test_cyclic_mul_matmul_matches_gather_loop():
     assert np.array_equal(got, ref)
 
 
+def test_cyclic_mul_fft_bit_exact_adversarial():
+    """The f32-FFT cyclic product (the default since late round 3) is
+    bit-exact vs an np.roll oracle at every parameter set, including the
+    worst-case-precision input (dense = all ones, maximal support
+    weight); also re-checks the support-duplicate path."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import hqc as H
+    from quantum_resistant_p2p_tpu.pyref.hqc_ref import PARAMS
+
+    rng = np.random.default_rng(13)
+    for name in ("HQC-128", "HQC-192", "HQC-256"):
+        p = PARAMS[name]
+        dense = np.stack([
+            np.ones(p.n, np.int32),  # adversarial: maximal spectral norm
+            rng.integers(0, 2, p.n, dtype=np.int32),
+        ])
+        sup = np.stack([
+            rng.choice(p.n, size=p.w, replace=False).astype(np.int32),
+            np.concatenate([  # duplicate positions collapse to one scatter hit
+                np.full(2, 7, np.int32),
+                rng.choice(p.n, size=p.w - 2, replace=False).astype(np.int32),
+            ]),
+        ])
+        got = np.asarray(H._cyclic_mul_fft(p, jnp.asarray(dense), jnp.asarray(sup)))
+        for b in range(2):
+            onehot = np.zeros(p.n, np.int64)
+            onehot[sup[b]] = 1  # duplicates collapse, matching _support_to_bits
+            ref = np.zeros(p.n, np.int64)
+            for pos in np.nonzero(onehot)[0]:
+                ref ^= np.roll(dense[b].astype(np.int64), pos)
+            assert np.array_equal(got[b], ref.astype(np.uint8)), (name, b)
+
+
 def test_cyclic_mul_matmul_large_n_block_branch():
     """The K=64 branch (n > 40000, HQC-256's regime) against an np.roll
     oracle on a synthetic parameter size — keeps _cyclic_block's largest-n

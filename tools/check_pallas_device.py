@@ -122,6 +122,35 @@ def check_sha512_compress() -> None:
     assert np.array_equal(np.asarray(ol.T), np.asarray(rl)), "sha512 lo diverges"
 
 
+def check_hqc_fft_cyclic() -> None:
+    """On-chip bit-exactness of the f32-FFT cyclic product (the HQC
+    default) vs the exact Toeplitz-MXU formulation, at every parameter
+    set, on the precision-worst-case input (dense = all ones — maximal
+    spectral norm).  The CPU suite asserts the same thing, but TPU FFT
+    accuracy differs from CPU FFT accuracy, and the KEM-level FO
+    roundtrip cannot catch a deterministic flip (encaps and decaps would
+    reproduce it identically) — this is the direct device check."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import hqc
+    from quantum_resistant_p2p_tpu.pyref.hqc_ref import PARAMS
+
+    rng = np.random.default_rng(14)
+    for name in ("HQC-128", "HQC-192", "HQC-256"):
+        p = PARAMS[name]
+        dense = jnp.asarray(np.stack([
+            np.ones(p.n, np.int32),
+            rng.integers(0, 2, p.n, dtype=np.int32),
+        ]))
+        sup = jnp.asarray(np.stack([
+            rng.choice(p.n, size=p.w, replace=False).astype(np.int32),
+            rng.choice(p.n, size=p.w, replace=False).astype(np.int32),
+        ]))
+        got = np.asarray(hqc._cyclic_mul_fft(p, dense, sup))
+        ref = np.asarray(hqc._cyclic_mul_matmul(p, dense, sup))
+        assert np.array_equal(got, ref), f"FFT cyclic product diverges on-chip: {name}"
+
+
 def check_sponge() -> None:
     """shake256 through sponge_words (multi-block absorb+squeeze) vs jnp."""
     import jax.numpy as jnp
@@ -150,6 +179,7 @@ CHECKS = [
     ("sha256 compress_words", check_sha256_compress),
     ("sha512 compress_words", check_sha512_compress),
     ("sponge_words shake256", check_sponge),
+    ("hqc fft cyclic product", check_hqc_fft_cyclic),
 ]
 
 

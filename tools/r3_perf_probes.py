@@ -129,6 +129,7 @@ def probe_hqc_tpu(out: dict) -> None:
     assert np.array_equal(np.asarray(ss2), np.asarray(ss)), "roundtrip"
     out["hqc_tpu"] = {
         "batch": batch,
+        "cyclic_impl": hqc._cyclic_impl(),
         "keygen_per_s": round(batch / timeit(kg, sk_seed, sigma, pk_seed), 1),
         "encaps_per_s": round(batch / timeit(enc, pk_d, m, salt), 1),
         "decaps_per_s": round(batch / timeit(dec, sk_d, ct_d), 1),
