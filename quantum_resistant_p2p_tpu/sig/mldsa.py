@@ -491,7 +491,7 @@ def _inf_norm(x: jax.Array, axes) -> jax.Array:
 
 
 def sign_mu_rounds(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array,
-                   kappa0: jax.Array, n_iters: int):
+                   kappa0: jax.Array, n_iters: int, unroll: int = 1):
     """At most ``n_iters`` rejection-loop iterations from per-lane ``kappa0``.
 
     Returns (sigma, done, kappa): each lane's kappa sequence depends only on
@@ -499,7 +499,21 @@ def sign_mu_rounds(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array,
     lanes into a smaller batch, and resume from the returned kappa — the
     produced signatures are bit-identical to the run-to-completion loop
     (the compact-and-refill driver below, ``sign_mu_compact``).
+
+    ``unroll`` runs that many attempts per ``while_loop`` body (masked
+    selection keeps each lane's FIRST accept, so results are bit-identical;
+    ``n_iters`` must be a multiple of ``unroll`` so the attempt budget —
+    and thus the returned (done, kappa) resumption state — is exactly the
+    unroll=1 contract).  Committed NEGATIVE result (bench_report.md):
+    an in-loop attempt measures ~155 ms at batch 8192 while its standalone
+    stages sum to ~55 ms, but unroll=5 changed nothing (784.7 vs 794.6 ms
+    for 5 attempts) — the gap is NOT the iteration boundary; standalone
+    stage timings are flattered by cross-dispatch overlap in the timing
+    harness, and the serial in-context chain is the true cost.  Default 1.
     """
+    if unroll < 1 or n_iters % unroll:
+        raise ValueError(f"n_iters ({n_iters}) must be a positive multiple "
+                         f"of unroll ({unroll})")
     sk = jnp.asarray(sk, jnp.uint8)
     mu = jnp.asarray(mu, jnp.uint8)
     rnd = jnp.asarray(rnd, jnp.uint8)
@@ -556,12 +570,13 @@ def sign_mu_rounds(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array,
 
     def body(state):
         done, kappa, sig, it = state
-        ok, sigma = attempt(kappa)
-        newly = (~done) & ok
-        sig = jnp.where(newly[..., None], sigma, sig)
-        kappa = jnp.where(done | ok, kappa, kappa + p.l)
-        done = done | ok
-        return done, kappa, sig, it + 1
+        for _ in range(unroll):
+            ok, sigma = attempt(kappa)
+            newly = (~done) & ok
+            sig = jnp.where(newly[..., None], sigma, sig)
+            kappa = jnp.where(done | ok, kappa, kappa + p.l)
+            done = done | ok
+        return done, kappa, sig, it + unroll
 
     done, kappa, sig, _ = lax.while_loop(
         cond, body, (done0, kappa_init, sig0, jnp.int32(0))

@@ -135,6 +135,36 @@ def test_sign_compact_bit_exact_vs_full_loop():
     assert np.array_equal(got_sig, ref_sig)
 
 
+def test_sign_rounds_unroll_bit_exact_and_validated():
+    """sign_mu_rounds(unroll=k) is bit-identical to unroll=1 — including
+    the returned resumption state — when n_iters is a multiple of k, and
+    rejects budgets that are not (the overshoot would change (done,
+    kappa) semantics) and non-positive unroll (non-terminating loop)."""
+    import pytest
+
+    name = "ML-DSA-44"
+    p = mldsa_ref.PARAMS[name]
+    kg, _, _ = jmldsa.get(name)
+    n = 6
+    xi = RNG.integers(0, 256, (n, 32), dtype=np.uint8)
+    _, sk = kg(xi)
+    sk = np.asarray(sk)
+    mus = RNG.integers(0, 256, (n, 64), dtype=np.uint8)
+    rnds = RNG.integers(0, 256, (n, 32), dtype=np.uint8)
+    k0 = np.zeros(n, np.int32)
+    ref = tuple(np.asarray(a)
+                for a in jmldsa.sign_mu_rounds(p, sk, mus, rnds, k0, 6, unroll=1))
+    for u in (2, 3):
+        got = tuple(np.asarray(a)
+                    for a in jmldsa.sign_mu_rounds(p, sk, mus, rnds, k0, 6, unroll=u))
+        for g, r in zip(got, ref):
+            assert np.array_equal(g, r), u
+    with pytest.raises(ValueError):
+        jmldsa.sign_mu_rounds(p, sk, mus, rnds, k0, 6, unroll=4)
+    with pytest.raises(ValueError):
+        jmldsa.sign_mu_rounds(p, sk, mus, rnds, k0, 6, unroll=0)
+
+
 def test_provider_sign_batch_uses_compact_driver():
     from quantum_resistant_p2p_tpu.provider.sig_providers import MLDSASignature
 
