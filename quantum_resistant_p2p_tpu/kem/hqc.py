@@ -56,12 +56,15 @@ from ..pyref.hqc_ref import (
 
 #: Single-dispatch batch cap (provider/base.py sliced_dispatch).  Round 2
 #: observed a 256-row keygen dispatch crashing the remote TPU worker; the
-#: round-3 bisection (tools/repro_worker_fault.py) ran every HQC op and
-#: sub-kernel clean at 256-1024 in fresh processes — no deterministic
-#: fault exists; the failure class is transient worker state.  The cap
-#: stays as a conservative guard (HQC dispatches are seconds-long, so
-#: slicing costs ~nothing).
-MAX_DEVICE_BATCH = 128
+#: round-3 bisection (tools/repro_worker_fault.py) found no deterministic
+#: fault (transient worker state), and the late-round FFT cyclic product
+#: shrank HQC's working set by orders of magnitude (33 MB spectra instead
+#: of the Toeplitz chunk expansion), removing the original caution's
+#: substance: batch 512 measured clean and ~8% faster than 128
+#: (bench_results/r3_hqc_fft_levels.json).  512 balances that against
+#: queue latency; the batched provider's cpu fallback + breaker absorb
+#: any transient recurrence.
+MAX_DEVICE_BATCH = 512
 
 _EXP = np.asarray(_GF_EXP, dtype=np.int32)  # length 512 (host-side table builds)
 
