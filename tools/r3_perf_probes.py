@@ -88,7 +88,7 @@ def probe_frodo_aes(out: dict) -> None:
 
     from quantum_resistant_p2p_tpu.kem import frodo
 
-    batch = 256  # MAX_DEVICE_BATCH
+    batch = frodo.MAX_DEVICE_BATCH
     kg, enc, _ = frodo.get("FrodoKEM-640-AES")
     sec = 16
     s1, s2, s3 = (_u8((batch, sec)) for _ in range(3))
