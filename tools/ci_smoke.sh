@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Install/entry-point smoke: proves the wheel metadata, console script, and
+# import graph are intact without touching a TPU. Run locally or in CI.
+set -euo pipefail
+
+python - <<'EOF'
+import importlib.metadata as md
+import quantum_resistant_p2p_tpu as pkg
+ver = md.version("quantum_resistant_p2p_tpu")
+assert ver == pkg.__version__, (ver, pkg.__version__)
+print(f"import ok: quantum_resistant_p2p_tpu {ver}")
+EOF
+
+qrp2p --help >/dev/null
+echo "qrp2p --help ok"
+
+python -m quantum_resistant_p2p_tpu --help >/dev/null
+echo "python -m quantum_resistant_p2p_tpu --help ok"
