@@ -216,6 +216,12 @@ def _sample_random_bits(p: HQCParams, seed: jax.Array) -> jax.Array:
 # -- cyclic arithmetic --------------------------------------------------------
 
 
+#: Set by :func:`get` when the FFT environment self-check FAILS: overrides
+#: the default formulation for the rest of the process (jit caches the
+#: traced path, so this must be decided before the first trace).
+_FORCED_IMPL: str | None = None
+
+
 def _cyclic_impl() -> str:
     """Which cyclic-product formulation to trace: "fft" (default),
     "matmul" (QRP2P_HQC_FFT=0 — the blocked-circulant MXU path), or
@@ -227,6 +233,8 @@ def _cyclic_impl() -> str:
         return "gather"
     if os.environ.get("QRP2P_HQC_FFT", "1") == "0":
         return "matmul"
+    if _FORCED_IMPL is not None:
+        return _FORCED_IMPL
     return "fft"
 
 
@@ -282,19 +290,9 @@ def _cyclic_mul_matmul(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Ar
     return (acc & 1).astype(jnp.uint8)
 
 
-def _cyclic_mul_fft(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:
-    """Cyclic product as an exact float32 FFT convolution.
-
-    The integer circular convolution of two 0/1 vectors has values
-    <= w <= 149 — far inside float32's exact-integer range — and the
-    f32 round-trip error at these sizes measures ~1e-4 (worst case
-    all-ones dense, asserted in tests/test_hqc.py), a ~5000x margin
-    under the 0.5 rounding threshold.  O(N log N) replaces the Toeplitz
-    path's O(n^2) MACs and, more importantly, its ~chunk-materialisation
-    HBM traffic (the measured bottleneck of every HQC op).  n is prime
-    (no length-n FFT), so a pow2-padded LINEAR convolution is folded
-    back to circular: circ[i] = lin[i] + lin[i + n].
-    """
+def _fft_circ(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:
+    """Float32 circular-convolution counts (pre-rounding) — shared by the
+    production path and the environment self-check probe."""
     n = p.n
     nfft = 1 << (2 * n - 2).bit_length()
     y = _support_to_bits(p, sup)
@@ -302,7 +300,26 @@ def _cyclic_mul_fft(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array
     fy = jnp.fft.rfft(y.astype(jnp.float32), nfft, axis=-1)
     lin = jnp.fft.irfft(fd * fy, nfft, axis=-1)
     tail = jnp.pad(lin[..., n : 2 * n - 1], [(0, 0)] * (lin.ndim - 1) + [(0, 1)])
-    circ = lin[..., :n] + tail
+    return lin[..., :n] + tail
+
+
+def _cyclic_mul_fft(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Array:
+    """Cyclic product as an exact float32 FFT convolution.
+
+    The integer circular convolution of two 0/1 vectors has values
+    <= w <= 149 — far inside float32's exact-integer range — and the
+    f32 round-trip error at these sizes measures ~1e-4 (worst case
+    all-ones dense, asserted in tests/test_hqc.py), a ~5000x margin
+    under the 0.5 rounding threshold.  Because that margin is measured,
+    not proven, the first :func:`get` in an environment runs
+    :func:`_fft_selfcheck` on-device and falls back to the Toeplitz
+    path if it fails.  O(N log N) replaces the Toeplitz path's O(n^2)
+    MACs and, more importantly, its ~chunk-materialisation HBM traffic
+    (the measured bottleneck of every HQC op).  n is prime (no length-n
+    FFT), so a pow2-padded LINEAR convolution is folded back to
+    circular: circ[i] = lin[i] + lin[i + n].
+    """
+    circ = _fft_circ(p, dense, sup)
     return (jnp.rint(circ).astype(jnp.int32) & 1).astype(jnp.uint8)
 
 
@@ -313,6 +330,13 @@ def _cyclic_mul_sparse(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Ar
     FFT convolution by default; the blocked-circulant MXU formulation
     (QRP2P_HQC_FFT=0) and the per-support rotated-gather loop
     (QRP2P_HQC_GATHER=1) remain for A/B.
+
+    PRECONDITION: support positions must be pairwise distinct (guaranteed
+    by :func:`_fixed_weight_support`'s dedup).  The three formulations
+    disagree on duplicates — FFT/matmul go through ``_support_to_bits``
+    where duplicates collapse to ONE hit, while the rotated-gather loop
+    counts each, so a doubled position cancels mod 2.  Distinctness is the
+    stated common contract; nothing in the KEM can violate it.
     """
     impl = _cyclic_impl()
     if impl == "fft":
@@ -330,6 +354,124 @@ def _cyclic_mul_sparse(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Ar
 
     acc = lax.fori_loop(0, w, step, jnp.zeros(dense.shape, jnp.int32))
     return (acc & 1).astype(jnp.uint8)
+
+
+# -- FFT environment self-check ----------------------------------------------
+#
+# The FFT cyclic product is exact only while device-FFT rounding stays under
+# 0.5; the measured margin (~1e-4) is empirical, so a new device / XLA / JAX
+# version could silently flip KEM bits.  The first `get()` per environment
+# therefore runs an on-device probe and falls back to the Toeplitz-MXU path
+# on failure.  The verdict is cached per (jax version, jaxlib version,
+# device kind) in ~/.cache/qrp2p_tpu so the cost is once per environment,
+# not per process.  QRP2P_HQC_SELFCHECK=0 skips the gate (trust the FFT);
+# tools/check_pallas_device.py remains the manual on-chip A/B.
+
+
+def _fft_selfcheck(p: HQCParams) -> tuple[bool, float]:
+    """On-device exactness probe for the f32 FFT cyclic product.
+
+    Runs the largest transform in the suite with (a) all-ones dense — the
+    worst-case convolution magnitude — and (b) random dense, comparing bits
+    against a host-exact XOR-of-rotations and requiring the pre-rounding
+    residual max|circ - rint(circ)| < 0.25 (2x margin under the rounding
+    threshold).  Returns (ok, worst_residual).
+    """
+    rng = np.random.default_rng(0x48514346)  # "HQCF"
+    sup = np.sort(rng.choice(p.n, size=p.wr, replace=False)).astype(np.int32)
+
+    @jax.jit
+    def probe(dense, sup):
+        circ = _fft_circ(p, dense, sup)
+        bits = (jnp.rint(circ).astype(jnp.int32) & 1).astype(jnp.uint8)
+        return bits, jnp.max(jnp.abs(circ - jnp.rint(circ)))
+
+    ok, worst = True, 0.0
+    for dense in (np.ones(p.n, np.uint8), rng.integers(0, 2, p.n, np.uint8)):
+        bits, resid = probe(dense[None], sup[None])
+        acc = np.zeros(p.n, np.int64)
+        for pos in sup:
+            acc += np.roll(dense.astype(np.int64), pos)
+        ok &= bool((np.asarray(bits)[0] == (acc & 1).astype(np.uint8)).all())
+        worst = max(worst, float(resid))
+    return ok and worst < 0.25, worst
+
+
+def _fft_env_key() -> str:
+    import jaxlib
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
+    return f"jax={jax.__version__}|jaxlib={jaxlib.__version__}|dev={kind}"
+
+
+#: In-process memo of the environment verdict (None = not yet decided) —
+#: without it an unwritable ~/.cache would re-run the on-device probe on
+#: every get() call.
+_FFT_ENV_OK: bool | None = None
+
+
+def _fft_env_validated() -> bool:
+    """Cached per-environment verdict; runs the probe on first sight."""
+    global _FFT_ENV_OK
+    import hashlib
+    import json
+    import logging
+    import pathlib
+
+    if _FFT_ENV_OK is not None:
+        return _FFT_ENV_OK
+    from ..native import _CACHE_DIR  # shared cache dir (QRP_NATIVE_CACHE)
+
+    key = _fft_env_key()
+    p = max(PARAMS.values(), key=lambda q: q.n)  # largest transform + weight
+    cache = pathlib.Path(_CACHE_DIR)
+    marker = cache / f"hqc_fft_ok_{hashlib.sha256(key.encode()).hexdigest()[:16]}.json"
+    try:
+        rec = json.loads(marker.read_text())
+        # probe_n guards against a stale verdict from an older package
+        # whose largest parameter set was smaller than today's.  Only a
+        # POSITIVE verdict is trusted from the marker: this platform's
+        # device faults are documented transient, so a failed probe
+        # re-runs every process (self-healing) rather than pinning the
+        # slow Toeplitz path forever.
+        if isinstance(rec, dict) and rec.get("key") == key and rec.get("probe_n") == p.n:
+            if rec.get("ok"):
+                _FFT_ENV_OK = True
+                return True
+    except (OSError, ValueError, KeyError):
+        pass
+    ok, resid = _fft_selfcheck(p)
+    if not ok:
+        logging.getLogger(__name__).warning(
+            "HQC f32-FFT self-check FAILED on %s (residual %.3g) — "
+            "falling back to the Toeplitz-MXU cyclic product for this "
+            "process (re-probed at next process start)", key, resid
+        )
+    if ok:
+        try:
+            cache.mkdir(parents=True, exist_ok=True)
+            marker.write_text(json.dumps(
+                {"key": key, "ok": ok, "worst_residual": resid, "probe_n": p.n}
+            ))
+        except OSError:
+            pass
+    _FFT_ENV_OK = ok
+    return ok
+
+
+def _maybe_gate_fft() -> None:
+    """Decide the FFT-vs-Toeplitz default for this process (called by
+    :func:`get` before anything is traced)."""
+    global _FORCED_IMPL
+    import os
+
+    if _FORCED_IMPL is not None or _cyclic_impl() != "fft":
+        return
+    if os.environ.get("QRP2P_HQC_SELFCHECK", "1") == "0":
+        return
+    if not _fft_env_validated():
+        _FORCED_IMPL = "matmul"
 
 
 # -- Reed-Solomon over GF(2^8), in-graph --------------------------------------
@@ -586,6 +728,7 @@ def decaps(p: HQCParams, sk: jax.Array, ct: jax.Array):
 def get(name: str):
     """Jitted (keygen, encaps, decaps) triple for a parameter-set name."""
     p = PARAMS[name]
+    _maybe_gate_fft()
     return (
         jax.jit(functools.partial(keygen, p)),
         jax.jit(functools.partial(encaps, p)),

@@ -312,8 +312,16 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--probe", help="child mode: run one probe and exit")
     ap.add_argument("--batch", type=int, default=128)
-    ap.add_argument("--only", nargs="*", help="subset of probes to bisect")
-    ap.add_argument("--out", default="bench_results/worker_fault_bisect.json")
+    ap.add_argument("--only", nargs="*", help="subset of probes to run")
+    ap.add_argument("--out", default=None,
+                    help="output JSON (default: bench_results/"
+                         "worker_fault_bisect.json, or _atcap with --at-cap)")
+    ap.add_argument(
+        "--at-cap", action="store_true",
+        help="sentinel mode: run each end-to-end probe ONCE at the family's "
+             "current MAX_DEVICE_BATCH (the ADVICE round-3 ask: keep the "
+             "repro in periodic runs after the 512 cap raise so a transient-"
+             "fault recurrence is caught by tooling, not production fallback)")
     args = ap.parse_args(argv)
 
     if args.probe:
@@ -322,8 +330,45 @@ def main(argv=None) -> int:
         print("ok")
         return 0
 
-    out_path = Path(args.out)
+    out_path = Path(args.out or (
+        "bench_results/worker_fault_atcap.json" if args.at_cap
+        else "bench_results/worker_fault_bisect.json"))
     out_path.parent.mkdir(parents=True, exist_ok=True)
+
+    if args.at_cap:
+        from quantum_resistant_p2p_tpu.kem import frodo as _frodo, hqc as _hqc
+
+        caps = {
+            "hqc_keygen": _hqc.MAX_DEVICE_BATCH,
+            "hqc_encaps": _hqc.MAX_DEVICE_BATCH,
+            "hqc_decaps": _hqc.MAX_DEVICE_BATCH,
+            "frodo_keygen": _frodo.MAX_DEVICE_BATCH,
+            "frodo_encaps": _frodo.MAX_DEVICE_BATCH,
+            "frodo_decaps": _frodo.MAX_DEVICE_BATCH,
+        }
+        if args.only:
+            unknown = [name for name in args.only if name not in caps]
+            if unknown:
+                ap.error(f"--at-cap probes are {sorted(caps)}; unknown: {unknown}")
+            caps = {k: v for k, v in caps.items() if k in args.only}
+        if not _wait_healthy():
+            print("chip not healthy at start", flush=True)
+            return 1
+        results = {}
+        for name, cap in caps.items():
+            print(f"{name} @ cap {cap} ...", end=" ", flush=True)
+            res = _run_child(name, cap, PROBE_TIMEOUT_S)
+            print(res["status"], f"({res['elapsed_s']}s)", flush=True)
+            results[name] = {str(cap): res}
+            out_path.write_text(json.dumps(results, indent=1))
+            if res["status"] != "ok" and not _wait_healthy():
+                print("chip did not recover; aborting", flush=True)
+                break
+        print(json.dumps(results, indent=1))
+        return 0 if all(
+            list(r.values())[0]["status"] == "ok" for r in results.values()
+        ) else 1
+
     probes = args.only or [p for p in PROBES if p != "tiny"]
     if not _wait_healthy():
         print("chip not healthy at start", flush=True)
