@@ -12,9 +12,15 @@ SHAKE256 seedexpander (``seed || 0x02`` domain byte, one continuing stream
 per context), ``vect_set_random_fixed_weight`` with multiplicative range
 reduction ``i + (rand32 * (n-i)) >> 32`` and index-replacement dedup, G/K as
 SHAKE256-512 with trailing domain bytes, keygen drawing y then x from one sk
-stream, encrypt drawing r2, e, r1 from one theta stream — but the exact
-byte-level call order cannot be verified offline; official KAT .rsp files
-dropped into tests/vectors/ are the decisive check (docs/correctness.md).
+stream, encrypt drawing r2, e, r1 from one theta stream.  The byte-level
+call order is RECONSTRUCTED from the official round-4 reference with
+corroborating evidence — serialized sizes match liboqs's published
+2249/2305/4433 (128), 4522/4586/8978 (192), 7245/7317/14421 (256) exactly,
+and official decaps re-deriving ONLY y from sk_seed forces the y-first
+order — but remains unverified against official .rsp files; interop
+confidence is moderate, and ``tools/verify_vectors.py`` carries a
+divergence-diagnosis decision tree naming exactly which assumption a
+failing official file refutes (docs/correctness.md §HQC seam).
 Both backends (this oracle and the batched JAX implementation in ``kem.hqc``)
 are bit-exact against each other, which is the property the application
 protocol needs (reference behavior: crypto/key_exchange.py:189-309).

@@ -389,6 +389,73 @@ def test_rsp_parser_roundtrip(tmp_path):
     assert len(sub.random_bytes(64)) == 64
 
 
+def test_hqc_official_mismatch_diagnosis():
+    """The HQC divergence-diagnosis decision tree pinpoints which seam
+    assumption a failing official .rsp refutes: synthesize stanzas with
+    each enumerable variant seam and assert the diagnosis names it
+    (docs/correctness.md §HQC seam)."""
+    from quantum_resistant_p2p_tpu.pyref import hqc_ref
+    from quantum_resistant_p2p_tpu.utils.ctr_drbg import CtrDrbg
+    from tools.verify_vectors import (
+        _hqc_encrypt_order,
+        _hqc_keygen_order,
+        check_rsp_hqc,
+    )
+
+    p = hqc_ref.PARAMS["HQC-128"]
+    seed = bytes(range(48))
+    # Per-call DRBG semantics (each randombytes call pads to the AES block
+    # and rekeys) — the draws must be made exactly like the checker's.
+    drbg = CtrDrbg(seed)
+    sk_seed, sigma, pk_seed = (
+        drbg.random_bytes(40), drbg.random_bytes(p.k), drbg.random_bytes(40)
+    )
+    m, salt = drbg.random_bytes(p.k), drbg.random_bytes(16)
+
+    def stanza(pk, sk, ct, ss):
+        return "\n".join(
+            ["count = 0", f"seed = {seed.hex().upper()}",
+             f"pk = {pk.hex().upper()}", f"sk = {sk.hex().upper()}",
+             f"ct = {ct.hex().upper()}", f"ss = {ss.hex().upper()}", ""]
+        )
+
+    # implemented seam reproduces its own stanza (sanity)
+    pk, sk = hqc_ref.keygen(p, sk_seed, sigma, pk_seed)
+    ct, ss = hqc_ref.encaps(p, pk, m, salt)
+    n, ok, errors = check_rsp_hqc(stanza(pk, sk, ct, ss), "PQCgenKAT_hqc128.rsp")
+    assert (n, ok) == (1, 1), errors
+
+    # variant: round-3 x-before-y sk draw order
+    pk_v = _hqc_keygen_order(p, sk_seed, sigma, pk_seed, x_first=True)
+    ct_v, ss_v = hqc_ref.encaps(p, pk_v, m, salt)
+    _, ok, errors = check_rsp_hqc(
+        stanza(pk_v, sk_seed + sigma + pk_v, ct_v, ss_v), "PQCgenKAT_hqc128.rsp"
+    )
+    assert ok == 0 and any("ROUND-3 sk-draw order" in e for e in errors), errors
+
+    # variant: pk_seed drawn before sk_seed
+    d2 = CtrDrbg(seed)
+    pk_seed_b, sk_seed_b, sigma_b = (
+        d2.random_bytes(40), d2.random_bytes(40), d2.random_bytes(p.k)
+    )
+    _, ok, errors = check_rsp_hqc(
+        stanza(*hqc_ref.keygen(p, sk_seed_b, sigma_b, pk_seed_b), ct, ss),
+        "PQCgenKAT_hqc128.rsp",
+    )
+    assert ok == 0 and any("drawn FIRST" in e for e in errors), errors
+
+    # variant: theta-expander draw order r1,r2,e instead of r2,e,r1
+    theta = hqc_ref._hash_g(m + pk[:32] + salt)
+    u, v = _hqc_encrypt_order(p, pk, m, theta, ("r1", "r2", "e"))
+    ct_o = (u.to_bytes(p.n_bytes, "little")
+            + v.to_bytes(p.n1n2_bytes, "little") + salt)
+    ss_o = hqc_ref._hash_k(m + ct_o[:-16])
+    _, ok, errors = check_rsp_hqc(stanza(pk, sk, ct_o, ss_o), "PQCgenKAT_hqc128.rsp")
+    assert ok == 0 and any(
+        "VARIANT" in e and "r1>r2>e" in e for e in errors
+    ), errors
+
+
 def test_verify_vectors_all_families():
     """tools/verify_vectors.py over the committed vector dir: every family
     has at least a fixture exercising its official-format parser + DRBG

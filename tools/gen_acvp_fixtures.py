@@ -160,7 +160,8 @@ def gen_rsp_hqc() -> str:
     master = _drbg(b"qrp2p rsp hqc fixture")
     lines = _rsp_header(
         "qrp2p seam: DRBG stream sk_seed(40)||sigma(k)||pk_seed(40), m||salt "
-        "— NOT the official HQC randombytes order (docs/correctness.md)"
+        "— reconstructed official round-4 randombytes order, unverified "
+        "offline (docs/correctness.md §HQC seam)"
     )
     for i in range(N_TESTS):
         seed = master.random_bytes(48)
