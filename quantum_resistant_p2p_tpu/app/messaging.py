@@ -99,6 +99,7 @@ class SecureMessaging:
         use_batching: bool = False,
         max_batch: int = 4096,
         max_wait_ms: float = 2.0,
+        batch_floor: int = 1,
         mesh_devices: int = 0,
     ):
         self.node = node
@@ -118,6 +119,10 @@ class SecureMessaging:
         # into padded device batches instead of dispatching one-by-one.
         self.use_batching = use_batching
         self._batch_cfg = (max_batch, max_wait_ms)
+        # bucket_floor collapses the flush-size bucket space so a pre-warm
+        # covers every size a live swarm can hit (keyword so the positional
+        # _batch_cfg unpacking at hot-swap stays untouched)
+        self._batch_floor = batch_floor
         self._bkem = self._bsig = None
         self._warmup_thread = None
         self._queue_breaker = None
@@ -129,10 +134,12 @@ class SecureMessaging:
             self._queue_breaker = Breaker()
             self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms,
                                     fallback=self._cpu_fallback_kem(),
-                                    breaker=self._queue_breaker)
+                                    breaker=self._queue_breaker,
+                                    bucket_floor=batch_floor)
             self._bsig = BatchedSignature(self.signature, max_batch, max_wait_ms,
                                           fallback=self._cpu_fallback_sig(),
-                                          breaker=self._queue_breaker)
+                                          breaker=self._queue_breaker,
+                                          bucket_floor=batch_floor)
             self._spawn_warmup()
 
         # per-peer protocol state
@@ -737,7 +744,8 @@ class SecureMessaging:
 
             self._bkem = BatchedKEM(self.kem, *self._batch_cfg,
                                     fallback=self._cpu_fallback_kem(),
-                                    breaker=self._queue_breaker)
+                                    breaker=self._queue_breaker,
+                                    bucket_floor=self._batch_floor)
             self._spawn_warmup(kem=True, sig=False)
         peers = list(self.shared_keys)
         self.shared_keys.clear()
@@ -772,7 +780,8 @@ class SecureMessaging:
 
             self._bsig = BatchedSignature(self.signature, *self._batch_cfg,
                                            fallback=self._cpu_fallback_sig(),
-                                           breaker=self._queue_breaker)
+                                           breaker=self._queue_breaker,
+                                           bucket_floor=self._batch_floor)
             self._spawn_warmup(kem=False, sig=True)
         self._sig_keypair = self._load_or_generate_sig_keypair()
         self._log("crypto_settings_changed", component="signature", algorithm=name)

@@ -161,6 +161,7 @@ class OpQueue:
         dispatch_timeout_ms: float = 15000.0,
         degrade_ref_batch: int = 256,
         breaker: Breaker | None = None,
+        bucket_floor: int = 1,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max_batch
@@ -168,6 +169,14 @@ class OpQueue:
         self.fallback_fn = fallback_fn
         self.degrade_after_s = degrade_after_ms / 1e3
         self.dispatch_timeout_s = dispatch_timeout_ms / 1e3
+        #: flushes pad UP to at least this pow2 bucket.  Collapses the
+        #: bucket space from log2(max_batch) sizes to a handful, so a
+        #: pre-warm covers every size a live swarm can hit; small flushes
+        #: cost the same as a floor-sized one (device dispatches at these
+        #: sizes are launch-dominated, see bench_report.md scaling curves).
+        #: Rounded up to a power of two and capped at max_batch so the
+        #: effective bucket always matches what warmup() compiles.
+        self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
         #: thresholds are for a <= degrade_ref_batch flush and scale
         #: linearly above it — a 4096-row dispatch is ALLOWED to take 16x
         #: longer than a 256-row one before it counts as "slow"; without
@@ -236,7 +245,7 @@ class OpQueue:
             return await loop.run_in_executor(None, self.batch_fn, items)
         if self.breaker.is_open():
             return await self._run_fallback(items)
-        bucket = _next_pow2(len(items))
+        bucket = max(self.bucket_floor, _next_pow2(len(items)))
         scale = max(1.0, bucket / self.degrade_ref_batch)
         if bucket not in self._warm_buckets:
             # A bucket's first device dispatch is a jit compile — tens of
@@ -326,7 +335,7 @@ class OpQueue:
                     f.set_exception(exc)
 
 
-def _run_valid(items, is_valid, dispatch, invalid_result):
+def _run_valid(items, is_valid, dispatch, invalid_result, floor=1):
     """Shared filter-pad-dispatch-scatter skeleton for the batch fns.
 
     ``is_valid(item) -> bool`` selects items safe to stack; ``dispatch(valid
@@ -337,11 +346,11 @@ def _run_valid(items, is_valid, dispatch, invalid_result):
     valid_idx = [i for i, it in enumerate(items) if is_valid(it)]
     results = [invalid_result() for _ in items]
     if valid_idx:
-        # pad to the pow2 of the FLUSH size, not the valid count: OpQueue
-        # keys its warm-bucket tracking on the flush size, so the compiled
-        # program shape must match it even when attacker-supplied invalid
-        # items were filtered out of the batch
-        tgt = _next_pow2(len(items))
+        # pad to the pow2 of the FLUSH size (raised to the facade's bucket
+        # floor), not the valid count: OpQueue keys its warm-bucket tracking
+        # on that same size, so the compiled program shape must match it
+        # even when attacker-supplied invalid items were filtered out
+        tgt = max(floor, _next_pow2(len(items)))
         out = dispatch([items[i] for i in valid_idx], tgt)
         for j, i in enumerate(valid_idx):
             results[i] = out[j]
@@ -349,15 +358,18 @@ def _run_valid(items, is_valid, dispatch, invalid_result):
 
 
 def _make_queues(algo, fallback, breaker, max_batch, max_wait_ms,
-                 batch_meths, degrade_opts):
+                 batch_meths, degrade_opts, bucket_floor=1):
     """Build one OpQueue per batch method, wiring the shared breaker and the
-    fallback partials (used by both facades below)."""
+    fallback partials (used by both facades below).  The device path pads to
+    ``bucket_floor``; the cpu fallback keeps floor 1 (padding would only add
+    serial native work)."""
     out = []
     for meth in batch_meths:
-        fb = functools.partial(meth, fallback) if fallback is not None else None
+        fb = functools.partial(meth, fallback, 1) if fallback is not None else None
         out.append(
-            OpQueue(functools.partial(meth, algo), max_batch, max_wait_ms,
-                    fallback_fn=fb, breaker=breaker, **degrade_opts)
+            OpQueue(functools.partial(meth, algo, bucket_floor), max_batch,
+                    max_wait_ms, fallback_fn=fb, breaker=breaker,
+                    bucket_floor=bucket_floor, **degrade_opts)
         )
     return out
 
@@ -384,26 +396,29 @@ class BatchedKEM:
                  fallback: KeyExchangeAlgorithm | None = None,
                  breaker: Breaker | None = None,
                  cooloff_s: float | None = None,
+                 bucket_floor: int = 1,
                  **degrade_opts):
         self.algo = algo
         self.fallback = fallback
         self.name = algo.name
+        self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
         # one breaker across keygen/encaps/decaps: the device is shared, so
         # any op discovering slowness shields the others immediately
         self.breaker = _facade_breaker(breaker, cooloff_s)
         self._kg, self._enc, self._dec = _make_queues(
             algo, fallback, self.breaker, max_batch, max_wait_ms,
             (self._kg_batch, self._enc_batch, self._dec_batch), degrade_opts,
+            self.bucket_floor,
         )
 
     @staticmethod
-    def _kg_batch(algo, items: list[None]) -> list[tuple[bytes, bytes]]:
+    def _kg_batch(algo, floor, items: list[None]) -> list[tuple[bytes, bytes]]:
         n = len(items)
-        pks, sks = algo.generate_keypair_batch(_next_pow2(n))
+        pks, sks = algo.generate_keypair_batch(max(floor, _next_pow2(n)))
         return [(bytes(pk), bytes(sk)) for pk, sk in zip(pks[:n], sks[:n])]
 
     @staticmethod
-    def _enc_batch(algo, items: list[bytes]):
+    def _enc_batch(algo, floor, items: list[bytes]):
         def dispatch(valid, tgt):
             pks = _pad_rows(np.stack([np.frombuffer(pk, np.uint8) for pk in valid]), tgt)
             cts, sss = algo.encapsulate_batch(pks)
@@ -414,10 +429,11 @@ class BatchedKEM:
             lambda pk: len(pk) == algo.public_key_len,
             dispatch,
             lambda: ValueError("bad public-key length"),
+            floor,
         )
 
     @staticmethod
-    def _dec_batch(algo, items: list[tuple[bytes, bytes]]):
+    def _dec_batch(algo, floor, items: list[tuple[bytes, bytes]]):
         def dispatch(valid, tgt):
             sks = _pad_rows(np.stack([np.frombuffer(sk, np.uint8) for sk, _ in valid]), tgt)
             cts = _pad_rows(np.stack([np.frombuffer(ct, np.uint8) for _, ct in valid]), tgt)
@@ -431,6 +447,7 @@ class BatchedKEM:
             ),
             dispatch,
             lambda: ValueError("bad secret-key/ciphertext length"),
+            floor,
         )
 
     def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:
@@ -438,7 +455,8 @@ class BatchedKEM:
         background thread).  Cold jit of the first handshake's size-1 bucket
         otherwise races the protocol timeout (SURVEY.md §7.4 item 6)."""
         for n in sizes:
-            n2 = _next_pow2(n)  # compile the shape the live bucket will use
+            # compile the shape the live bucket will use
+            n2 = max(self.bucket_floor, _next_pow2(n))
             pks, sks = self.algo.generate_keypair_batch(n2)
             cts, _ = self.algo.encapsulate_batch(pks)
             self.algo.decapsulate_batch(sks, cts)
@@ -474,18 +492,21 @@ class BatchedSignature:
                  fallback: SignatureAlgorithm | None = None,
                  breaker: Breaker | None = None,
                  cooloff_s: float | None = None,
+                 bucket_floor: int = 1,
                  **degrade_opts):
         self.algo = algo
         self.fallback = fallback
         self.name = algo.name
+        self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
         self.breaker = _facade_breaker(breaker, cooloff_s)
         self._sign, self._verify = _make_queues(
             algo, fallback, self.breaker, max_batch, max_wait_ms,
             (self._sign_batch, self._verify_batch), degrade_opts,
+            self.bucket_floor,
         )
 
     @staticmethod
-    def _sign_batch(algo, items: list[tuple[bytes, bytes]]):
+    def _sign_batch(algo, floor, items: list[tuple[bytes, bytes]]):
         def dispatch(valid, tgt):
             sks = _pad_rows(np.stack([np.frombuffer(sk, np.uint8) for sk, _ in valid]), tgt)
             msgs = [m for _, m in valid] + [valid[-1][1]] * (tgt - len(valid))
@@ -496,10 +517,11 @@ class BatchedSignature:
             lambda it: len(it[0]) == algo.secret_key_len,
             dispatch,
             lambda: ValueError("bad secret-key length"),
+            floor,
         )
 
     @staticmethod
-    def _verify_batch(algo, items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
+    def _verify_batch(algo, floor, items: list[tuple[bytes, bytes, bytes]]) -> list[bool]:
         # Per the verify contract, malformed input means False — never raise.
         def dispatch(valid, tgt):
             pks = _pad_rows(np.stack([np.frombuffer(pk, np.uint8) for pk, _, _ in valid]), tgt)
@@ -520,13 +542,15 @@ class BatchedSignature:
             ),
             dispatch,
             lambda: False,
+            floor,
         )
 
     def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:
         """Compile keygen/sign/verify for the pow2 buckets (blocking)."""
         pk, sk = self.algo.generate_keypair()
         for n in sizes:
-            n2 = _next_pow2(n)  # compile the shape the live bucket will use
+            # compile the shape the live bucket will use
+            n2 = max(self.bucket_floor, _next_pow2(n))
             sks = np.stack([np.frombuffer(sk, np.uint8)] * n2)
             pks = np.stack([np.frombuffer(pk, np.uint8)] * n2)
             sigs = self.algo.sign_batch(sks, [b"warmup"] * n2)
