@@ -104,8 +104,26 @@ def _center(x: jax.Array, m: int = Q) -> jax.Array:
 # --------------------------------------------------------------------------
 
 
+def _ntt_pallas(f: jax.Array, inverse: bool) -> jax.Array:
+    """Route one (inv)NTT through the VMEM-resident kernel: flatten every
+    leading axis into the lane dimension (polys transform independently),
+    transpose to the words layout, and back."""
+    from . import mldsa_pallas  # deferred: pallas import
+
+    sh = f.shape
+    x = f.reshape(-1, N).T  # (256, L)
+    out = mldsa_pallas.ntt_words(x, inverse=inverse)
+    return out.T.reshape(sh)
+
+
 def ntt(f: jax.Array) -> jax.Array:
-    """(..., 256) int32 in [0,q) -> NTT domain."""
+    """(..., 256) int32 in [0,q) -> NTT domain.
+
+    On TPU the transform runs as one VMEM-resident Pallas program (1 HBM
+    read + 1 write instead of 16 stage round-trips; sig/mldsa_pallas.py) —
+    the sign rejection loop runs ~29 poly transforms per attempt."""
+    if keccak._use_pallas():
+        return _ntt_pallas(f, inverse=False)
     zetas = jnp.asarray(_ZETAS)
     k = 1
     length = 128
@@ -122,6 +140,8 @@ def ntt(f: jax.Array) -> jax.Array:
 
 
 def ntt_inv(f: jax.Array) -> jax.Array:
+    if keccak._use_pallas():
+        return _ntt_pallas(f, inverse=True)
     zetas = jnp.asarray(_ZETAS)
     k = 255
     length = 1
@@ -149,17 +169,57 @@ def pw_mul(a: jax.Array, b: jax.Array) -> jax.Array:
 
 
 def simple_bit_pack(vals: jax.Array, bits: int) -> jax.Array:
-    """(..., 256) int32 in [0, 2^bits) -> (..., 32*bits) uint8, LSB-first."""
-    b = (vals[..., :, None] >> jnp.arange(bits)) & 1
-    b = b.reshape(vals.shape[:-1] + (32 * bits, 8))
-    return jnp.sum(b << jnp.arange(8), axis=-1).astype(jnp.uint8)
+    """(..., 256) int32 in [0, 2^bits) -> (..., 32*bits) uint8, LSB-first.
+
+    Byte-assembly formulation: the LSB-first bitstream is periodic with
+    period lcm(bits, 8) — ``pc`` coefficients fill ``pb`` bytes — so each
+    output byte position is a STATIC shift/or of at most a few
+    coefficients.  The naive bit-matrix route (explode to (..., 256, bits)
+    then regroup by 8) materialises a bits-x blowup in HBM: for the z
+    packing inside the sign rejection loop (bits=20, batch 8192 x l=5)
+    that alone measured tens of ms per attempt (r4 prefix probe)."""
+    import math
+
+    period = math.lcm(bits, 8)
+    pb, pc = period // 8, period // bits
+    g = vals.reshape(vals.shape[:-1] + (N // pc, pc))
+    outs = []
+    for j in range(pb):
+        lo = 8 * j
+        acc = None
+        for c in range(pc):
+            s = c * bits
+            if s + bits <= lo or s >= lo + 8:
+                continue
+            sh = lo - s
+            contrib = (g[..., c] >> sh) if sh >= 0 else (g[..., c] << (-sh))
+            acc = contrib if acc is None else (acc | contrib)
+        outs.append(acc & 0xFF)
+    b = jnp.stack(outs, axis=-1)  # (..., 256/pc, pb)
+    return b.reshape(vals.shape[:-1] + (32 * bits,)).astype(jnp.uint8)
 
 
 def simple_bit_unpack(b: jax.Array, bits: int) -> jax.Array:
-    """(..., 32*bits) uint8 -> (..., 256) int32."""
-    x = (b[..., :, None].astype(jnp.int32) >> jnp.arange(8)) & 1
-    x = x.reshape(b.shape[:-1] + (N, bits))
-    return jnp.sum(x << jnp.arange(bits), axis=-1)
+    """(..., 32*bits) uint8 -> (..., 256) int32 (byte-assembly, see pack)."""
+    import math
+
+    period = math.lcm(bits, 8)
+    pb, pc = period // 8, period // bits
+    g = b.reshape(b.shape[:-1] + (N // pc, pb)).astype(jnp.int32)
+    outs = []
+    for c in range(pc):
+        s = c * bits
+        acc = None
+        for j in range(pb):
+            lo = 8 * j
+            if lo + 8 <= s or lo >= s + bits:
+                continue
+            sh = lo - s
+            contrib = (g[..., j] << sh) if sh >= 0 else (g[..., j] >> (-sh))
+            acc = contrib if acc is None else (acc | contrib)
+        outs.append(acc & ((1 << bits) - 1))
+    x = jnp.stack(outs, axis=-1)  # (..., 256/pc, pc)
+    return x.reshape(b.shape[:-1] + (N,))
 
 
 def bit_pack(vals: jax.Array, up: int, bits: int) -> jax.Array:
@@ -382,22 +442,34 @@ def sample_in_ball(p: MLDSAParams, ctilde: jax.Array) -> jax.Array:
 
 
 def hint_bit_pack(p: MLDSAParams, h: jax.Array) -> jax.Array:
-    """h (..., k, 256) in {0,1} -> (..., omega + k) uint8."""
+    """h (..., k, 256) in {0,1} -> (..., omega + k) uint8.
+
+    Gather/scatter/sort-free: the destination byte of each set hint bit is
+    its prefix rank (cumsum) plus the preceding rows' total, and the output
+    is a one-hot contraction out[w] = sum_n pos_n * [dest_n == w] over the
+    k*256 candidate bits — (omega+k) x 1536 compares per lane, pure VPU.
+    The previous stable-argsort + put_along_axis formulation serialised
+    per-lane on TPU and dominated the sign attempt (r4 prefix probe: the
+    pack stage was ~68%% of the whole attempt at batch 8192)."""
     batch = h.shape[:-2]
-    # positions of ones within each row, compacted to the front (stable order)
-    order = jnp.argsort(1 - h, axis=-1, stable=True)  # ones first, index order
+    h = h.astype(jnp.int32)
     counts = jnp.sum(h, axis=-1)  # (..., k)
-    ends = jnp.cumsum(counts, axis=-1)  # running totals -> trailing bytes
+    ends = jnp.cumsum(counts, axis=-1)
     starts = ends - counts
-    npos = jnp.arange(N)
-    valid = npos < counts[..., None]  # (..., k, 256)
-    dest = jnp.where(valid, starts[..., None] + npos, p.omega + p.k)  # sentinel: dropped
-    out = jnp.zeros(batch + (p.omega + p.k + 1,), dtype=jnp.int32)
-    out = out.at[..., p.omega : p.omega + p.k].set(ends.astype(jnp.int32))
-    flat_dest = dest.reshape(batch + (-1,))
-    flat_val = jnp.where(valid, order, 0).reshape(batch + (-1,))
-    out = jnp.put_along_axis(out, flat_dest, flat_val, axis=-1, inplace=False)
-    return out[..., : p.omega + p.k].astype(jnp.uint8)
+    # rank of each set bit within its row (0-based among ones, index order)
+    rank = jnp.cumsum(h, axis=-1) - h
+    dest = jnp.where(h == 1, starts[..., None] + rank, -1)  # (..., k, 256)
+    npos = jnp.arange(N, dtype=jnp.int32)
+    flat_dest = dest.reshape(batch + (1, -1))  # (..., 1, k*256)
+    flat_pos = jnp.broadcast_to(
+        jnp.tile(npos, h.shape[-2]), flat_dest.shape[:-2] + (flat_dest.shape[-1],)
+    )[..., None, :]
+    w = jnp.arange(p.omega, dtype=jnp.int32)[..., :, None]  # (omega, 1)
+    packed = jnp.sum(
+        jnp.where(flat_dest == w, flat_pos, 0), axis=-1
+    )  # (..., omega)
+    out = jnp.concatenate([packed, ends], axis=-1)
+    return out.astype(jnp.uint8)
 
 
 def hint_bit_unpack(p: MLDSAParams, b: jax.Array) -> tuple[jax.Array, jax.Array]:

@@ -143,6 +143,114 @@ def rej_bounded_words(in_hi: jax.Array, in_lo: jax.Array, *, eta: int,
                         RB_RATE_WORDS, N_OUT, in_hi, in_lo, interpret=interpret)
 
 
+# --------------------------------------------------------------------------
+# NTT / invNTT over Z_q[X]/(X^256+1) (FIPS 204 §7.5) — VMEM-resident
+# --------------------------------------------------------------------------
+#
+# The jnp formulation (sig/mldsa.py ntt/ntt_inv) materialises the full
+# batched coefficient array between each of the 8 butterfly stages — 16 HBM
+# round-trips per transform, and a sign attempt runs ~29 poly transforms
+# (ntt(y) x l, invntt(w) x k, ntt(c), invntt(cs1/cs2/ct0) x l+2k).  Here a
+# poly's 256 coefficients live as 256 (8, 128) int32 register tiles across
+# 1024 lanes; all 1024 butterflies run in VMEM and HBM sees one read + one
+# write.  Same register-resident recipe as the sampler kernels above.
+
+from ..pyref.mldsa_ref import ZETAS as _ZETAS_PY
+
+_N = 256
+_N_INV = pow(_N, -1, Q)
+
+
+def _mm_zeta(a, z: int):
+    """(a * z) % Q for an int32 tile a in [0, q) and STATIC z in [0, q).
+
+    Horner over 8-bit limbs of z keeps every intermediate under 2**31
+    (identical arithmetic to sig/mldsa.py:_mm with b static)."""
+    b2, b1, b0 = z >> 16, (z >> 8) & 0xFF, z & 0xFF
+    r = (a * b2) % Q
+    r = (((r << 8) % Q) + (a * b1) % Q) % Q
+    r = (((r << 8) % Q) + (a * b0) % Q) % Q
+    return r
+
+
+def ntt_tiles(f: list) -> list:
+    """256 int32 tiles in [0, q) -> NTT domain (bit-exact vs mldsa.ntt)."""
+    f = list(f)
+    k = 1
+    length = 128
+    while length >= 1:
+        groups = _N // (2 * length)
+        for g in range(groups):
+            z = int(_ZETAS_PY[k + g])
+            base = g * 2 * length
+            for j in range(length):
+                i0, i1 = base + j, base + length + j
+                t = _mm_zeta(f[i1], z)
+                f[i0], f[i1] = (f[i0] + t) % Q, (f[i0] - t) % Q
+        k += groups
+        length //= 2
+    return f
+
+
+def ntt_inv_tiles(f: list) -> list:
+    """Inverse transform; bit-exact vs mldsa.ntt_inv."""
+    f = list(f)
+    k = 255
+    length = 1
+    while length <= 128:
+        groups = _N // (2 * length)
+        zs = [int(_ZETAS_PY[k - groups + 1 + i]) for i in range(groups)][::-1]
+        for g in range(groups):
+            base = g * 2 * length
+            for j in range(length):
+                i0, i1 = base + j, base + length + j
+                s = (f[i0] + f[i1]) % Q
+                t = _mm_zeta((f[i1] - f[i0]) % Q, zs[g])
+                f[i0], f[i1] = s, t
+        k -= groups
+        length *= 2
+    return [_mm_zeta(x, _N_INV) for x in f]
+
+
+def _ntt_kernel(in_ref, out_ref, *, inverse: bool):
+    f = [in_ref[i] for i in range(_N)]
+    out = ntt_inv_tiles(f) if inverse else ntt_tiles(f)
+    for i in range(_N):
+        out_ref[i] = out[i]
+
+
+@functools.partial(jax.jit, static_argnames=("inverse", "interpret"))
+def ntt_words(x: jax.Array, *, inverse: bool = False, interpret: bool = False):
+    """Batched (inv)NTT over words layout.
+
+    Args:
+      x: (256, L) int32 coefficients in [0, q), lanes batch-minor (L is
+        padded to the 1024-lane tile internally).
+
+    Returns:
+      (256, L) int32 transformed coefficients.
+    """
+    from jax.experimental import pallas as pl
+
+    from ..core.keccak_pallas import _TL, _TS, BT
+
+    n, l = x.shape
+    assert n == _N
+    lp = -(-l // BT) * BT
+    if lp != l:
+        x = jnp.pad(x, ((0, 0), (0, lp - l)))
+    x = x.reshape(_N, lp // _TL, _TL)
+    out = pl.pallas_call(
+        functools.partial(_ntt_kernel, inverse=inverse),
+        grid=(lp // BT,),
+        in_specs=[pl.BlockSpec((_N, _TS, _TL), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((_N, _TS, _TL), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((_N, lp // _TL, _TL), jnp.int32),
+        interpret=interpret,
+    )(x)
+    return out.reshape(_N, lp)[:, :l]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def rej_ntt_words(in_hi: jax.Array, in_lo: jax.Array, *, interpret: bool = False):
     """Batched RejNTTPoly over word-transposed padded seed blocks.

@@ -70,3 +70,24 @@ def test_rej_bounded_tiles_bit_exact_vs_jnp_path(eta, monkeypatch):
     z = np.stack([np.asarray(o) for o in out], axis=-1)
     got = (2 - z % 5) % mldsa.Q if eta == 2 else (4 - z) % mldsa.Q
     assert np.array_equal(got, ref)
+
+
+def test_ntt_tiles_bit_exact_vs_jnp(monkeypatch):
+    """VMEM NTT/invNTT tile functions (eager) against the jnp transforms,
+    plus round-trip."""
+    monkeypatch.setenv("QRP2P_PALLAS", "0")  # reference = jnp ntt/ntt_inv
+    rng = np.random.default_rng(21)
+    lanes = 7
+    f = rng.integers(0, mldsa.Q, (lanes, 256), dtype=np.int32)
+    tiles = [jnp.asarray(f[:, i]) for i in range(256)]
+
+    fwd = mldsa_pallas.ntt_tiles(tiles)
+    got_fwd = np.stack([np.asarray(t) for t in fwd], axis=-1)
+    ref_fwd = np.asarray(mldsa.ntt(jnp.asarray(f)))
+    assert np.array_equal(got_fwd, ref_fwd)
+
+    inv = mldsa_pallas.ntt_inv_tiles(fwd)
+    got_inv = np.stack([np.asarray(t) for t in inv], axis=-1)
+    ref_inv = np.asarray(mldsa.ntt_inv(jnp.asarray(ref_fwd)))
+    assert np.array_equal(got_inv, ref_inv)
+    assert np.array_equal(got_inv, f)  # round-trip

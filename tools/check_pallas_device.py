@@ -169,6 +169,49 @@ def check_sponge() -> None:
     assert np.array_equal(got, ref), "sponge_words diverges from jnp path"
 
 
+def check_mldsa_ntt() -> None:
+    """On-chip (inv)NTT kernel vs the jnp stage-loop transforms, including
+    the non-tile-aligned lane padding path and a round-trip."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.sig import mldsa, mldsa_pallas
+
+    rng = np.random.default_rng(23)
+    for lanes in (B, 130):  # tile-aligned and padded
+        f = rng.integers(0, mldsa.Q, (lanes, 256), dtype=np.int32)
+        # reference = the jnp stage loop, independent of QRP2P_PALLAS routing
+        zref = _mldsa_ntt_jnp(f)
+        got = np.asarray(mldsa_pallas.ntt_words(jnp.asarray(f.T))).T
+        assert np.array_equal(got, zref), f"ntt_words diverges (lanes={lanes})"
+        gi = np.asarray(
+            mldsa_pallas.ntt_words(jnp.asarray(got.T), inverse=True)
+        ).T
+        assert np.array_equal(gi, f), f"ntt_inv round-trip fails (lanes={lanes})"
+
+
+def _mldsa_ntt_jnp(f: np.ndarray) -> np.ndarray:
+    """The jnp stage-loop NTT, inlined so the check is independent of the
+    QRP2P_PALLAS routing inside mldsa.ntt."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.sig.mldsa import _ZETAS, _mm, N, Q
+
+    zetas = jnp.asarray(_ZETAS)
+    g = jnp.asarray(f)
+    k = 1
+    length = 128
+    while length >= 1:
+        groups = N // (2 * length)
+        z = zetas[k : k + groups]
+        fr = g.reshape(g.shape[:-1] + (groups, 2, length))
+        f0, f1 = fr[..., 0, :], fr[..., 1, :]
+        t = _mm(jnp.broadcast_to(z[:, None], f1.shape), f1)
+        g = jnp.stack([(f0 + t) % Q, (f0 - t) % Q], axis=-2).reshape(g.shape)
+        k += groups
+        length //= 2
+    return np.asarray(g)
+
+
 CHECKS = [
     ("sample_ntt_words", check_sample_ntt),
     ("cbd_words eta=2", lambda: check_cbd(2)),
@@ -180,6 +223,7 @@ CHECKS = [
     ("sha512 compress_words", check_sha512_compress),
     ("sponge_words shake256", check_sponge),
     ("hqc fft cyclic product", check_hqc_fft_cyclic),
+    ("mldsa ntt/ntt_inv words", check_mldsa_ntt),
 ]
 
 

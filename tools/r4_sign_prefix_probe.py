@@ -1,0 +1,152 @@
+"""In-context ML-DSA sign-attempt attribution via jitted PREFIX programs.
+
+The round-3 breakdown timed each attempt stage STANDALONE and found they sum
+to ~55 ms while the in-loop attempt costs ~155 ms at batch 8192 — and the
+committed unroll experiment proved the gap is not the while_loop boundary.
+Standalone timings overlap host/device work across timing reps, so they
+under-attribute the serial chain.  This probe times CUMULATIVE PREFIXES of
+the attempt pipeline (p0 = ExpandMask only, p1 = +NTT(y), ... p7 = full
+attempt): each prefix is one jitted program on device-resident operands,
+ended with a host readback, so the DELTAS between consecutive prefixes are
+the true in-context marginal cost of each stage.
+
+Usage: python -m tools.r4_sign_prefix_probe [--batch 8192] [--name ML-DSA-65]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--name", default="ML-DSA-65")
+    ap.add_argument("--out", default="bench_results/r4_sign_prefix_breakdown.json")
+    args = ap.parse_args(argv)
+
+    from quantum_resistant_p2p_tpu.utils.benchmarking import (
+        enable_compile_cache, timeit,
+    )
+
+    enable_compile_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.core import keccak
+    from quantum_resistant_p2p_tpu.sig import mldsa as M
+
+    p = M.PARAMS[args.name]
+    B = args.batch
+    rng = np.random.default_rng(7)
+
+    # one key replicated across the batch (the swarm-hub shape); mu distinct
+    kg, _, _ = M.get(args.name)
+    _, sk1 = kg(rng.integers(0, 256, (1, 32), np.uint8))
+    sk = jnp.broadcast_to(jnp.asarray(sk1)[0], (B, sk1.shape[-1]))
+    mu = jax.device_put(rng.integers(0, 256, (B, 64), np.uint8))
+    rnd = jax.device_put(rng.integers(0, 256, (B, 32), np.uint8))
+
+    # hoisted per-key work (outside the rejection loop in sign_mu_rounds)
+    @jax.jit
+    def hoist(sk, mu, rnd):
+        rho, cap_k, tr, s1, s2, t0 = M._unpack_sk(p, sk)
+        a_hat = M.expand_a(p, rho)
+        s1_hat, s2_hat, t0_hat = M.ntt(s1), M.ntt(s2), M.ntt(t0)
+        rhopp = keccak.shake256(jnp.concatenate([cap_k, rnd, mu], axis=-1), 64)
+        return a_hat, s1_hat, s2_hat, t0_hat, rhopp
+
+    a_hat, s1_hat, s2_hat, t0_hat, rhopp = (
+        jnp.asarray(x) for x in hoist(sk, mu, rnd)
+    )
+    kappa = jnp.zeros((B,), jnp.int32)
+    batch = (B,)
+
+    def prefix(stage: int):
+        """Build the attempt pipeline up to `stage`; returns a jittable fn."""
+
+        def fn(rhopp, kappa, mu, a_hat, s1_hat, s2_hat, t0_hat):
+            y = M.expand_mask(p, rhopp, kappa)                        # p0
+            if stage == 0:
+                return y
+            y_hat = M.ntt(y)                                          # p1
+            if stage == 1:
+                return y_hat
+            w = M.ntt_inv(M._matvec(a_hat, y_hat))                    # p2
+            if stage == 2:
+                return w
+            w1, _ = M.decompose(p, w)                                 # p3
+            w1_enc = M.simple_bit_pack(w1, p.w1_bits).reshape(batch + (-1,))
+            ctilde = keccak.shake256(
+                jnp.concatenate([mu, w1_enc], axis=-1), p.ctilde_len
+            )
+            if stage == 3:
+                return ctilde
+            c_hat = M.ntt(M.sample_in_ball(p, ctilde))                # p4
+            if stage == 4:
+                return c_hat
+            cs1 = M.ntt_inv(M.pw_mul(c_hat[..., None, :], s1_hat))    # p5
+            z = (y + cs1) % M.Q
+            ok = M._inf_norm(z, (-1, -2)) < p.gamma1 - p.beta
+            if stage == 5:
+                return z, ok
+            cs2 = M.ntt_inv(M.pw_mul(c_hat[..., None, :], s2_hat))    # p6
+            r_minus = (w - cs2) % M.Q
+            _, r0 = M.decompose(p, r_minus)
+            ok &= jnp.max(jnp.abs(r0), axis=(-1, -2)) < p.gamma2 - p.beta
+            if stage == 6:
+                return r_minus, ok
+            ct0 = M.ntt_inv(M.pw_mul(c_hat[..., None, :], t0_hat))    # p7
+            ok &= M._inf_norm(ct0, (-1, -2)) < p.gamma2
+            h_arg = (M._center(r_minus) + M._center(ct0)) % M.Q
+            hi_with = M.decompose(p, h_arg)[0]
+            hi_base = M.decompose(p, r_minus)[0]
+            h = (hi_with != hi_base).astype(jnp.int32)
+            ok &= jnp.sum(h, axis=(-1, -2)) <= p.omega
+            sigma = jnp.concatenate(
+                [
+                    ctilde,
+                    M.bit_pack(z, p.gamma1, p.z_bits).reshape(batch + (-1,)),
+                    M.hint_bit_pack(p, h),
+                ],
+                axis=-1,
+            )
+            return sigma, ok
+
+        return jax.jit(fn)
+
+    labels = [
+        "p0_expand_mask", "p1_ntt_y", "p2_w_matvec_invntt",
+        "p3_decompose_pack_ctilde", "p4_ball_ntt", "p5_cs1_z_check",
+        "p6_cs2_r0_check", "p7_ct0_hint_pack_sigma",
+    ]
+    out = {"batch": B, "name": args.name, "cumulative_ms": {}, "delta_ms": {}}
+    prev = 0.0
+    for stage, lab in enumerate(labels):
+        fn = prefix(stage)
+        fn(rhopp, kappa, mu, a_hat, s1_hat, s2_hat, t0_hat)  # compile
+        t = timeit(functools.partial(
+            fn, rhopp, kappa, mu, a_hat, s1_hat, s2_hat, t0_hat
+        ))
+        ms = 1e3 * t
+        out["cumulative_ms"][lab] = round(ms, 2)
+        out["delta_ms"][lab] = round(ms - prev, 2)
+        prev = ms
+        print(f"{lab:28s} cum {ms:8.2f} ms   delta {out['delta_ms'][lab]:8.2f} ms",
+              flush=True)
+
+    Path(args.out).write_text(json.dumps(out, indent=1))
+    print(json.dumps({"prefix_total_ms": out["cumulative_ms"][labels[-1]]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
