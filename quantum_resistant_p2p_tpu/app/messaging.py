@@ -101,6 +101,7 @@ class SecureMessaging:
         max_wait_ms: float = 2.0,
         batch_floor: int = 1,
         mesh_devices: int = 0,
+        sig_keypair: tuple[bytes, bytes] | None = None,
     ):
         self.node = node
         self.key_storage = key_storage
@@ -152,7 +153,13 @@ class SecureMessaging:
         self._processed_ids: dict[str, float] = {}
         self._listeners: list[Callable[[str, Message], None]] = []
 
-        self._sig_keypair = self._load_or_generate_sig_keypair()
+        # sig_keypair injection skips the one-time scalar keygen dispatch —
+        # swarm simulations construct thousands of stacks and pre-generate
+        # their keypairs in one device batch (tools/swarm_bench.py)
+        self._sig_keypair = (
+            sig_keypair if sig_keypair is not None
+            else self._load_or_generate_sig_keypair()
+        )
 
         for msg_type, handler in (
             ("ke_init", self._handle_ke_init),

@@ -233,6 +233,18 @@ class SignatureAlgorithm(CryptoAlgorithm):
     def generate_keypair(self) -> tuple[bytes, bytes]:
         """-> (public_key, secret_key)"""
 
+    def generate_keypair_batch(self, n: int) -> tuple[np.ndarray, np.ndarray]:
+        """-> (public_keys (n, pk_len), secret_keys (n, sk_len)) uint8.
+
+        Default loops the scalar path; batched backends override (the KEM
+        interface's counterpart is abstract, but signature keypairs are
+        long-lived so most callers never need the batch form)."""
+        pairs = [self.generate_keypair() for _ in range(n)]
+        return (
+            np.stack([np.frombuffer(pk, np.uint8) for pk, _ in pairs]),
+            np.stack([np.frombuffer(sk, np.uint8) for _, sk in pairs]),
+        )
+
     @abc.abstractmethod
     def sign(self, secret_key: bytes, message: bytes) -> bytes:
         """-> signature"""

@@ -105,6 +105,16 @@ class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
             return self._native.keygen(xi)
         return mldsa_ref.keygen(self.params, xi)
 
+    def generate_keypair_batch(self, n: int) -> tuple[np.ndarray, np.ndarray]:
+        if self.backend != "tpu":
+            return super().generate_keypair_batch(n)
+        xi = np.frombuffer(os.urandom(32 * n), np.uint8).reshape(n, 32)
+        # _dispatch routes through the provider mesh when configured, like
+        # every other ML-DSA device path (sign/verify); ML-DSA has no
+        # sliced-dispatch cap (batch 8192 keygen is a routine dispatch)
+        pk, sk = self._dispatch(self._kg, xi)
+        return np.asarray(pk), np.asarray(sk)
+
     def sign(self, secret_key: bytes, message: bytes) -> bytes:
         expect_len(secret_key, self.secret_key_len, "secret key", self.name)
         rnd = os.urandom(32)  # hedged variant
