@@ -152,6 +152,9 @@ class SecureMessaging:
         self._pending: dict[str, asyncio.Future] = {}
         self._processed_ids: dict[str, float] = {}
         self._listeners: list[Callable[[str, Message], None]] = []
+        #: strong refs to fire-and-forget tasks — the event loop only keeps
+        #: weak ones, so an unreferenced task can be GC'd mid-flight
+        self._bg_tasks: set[asyncio.Task] = set()
 
         # sig_keypair injection skips the one-time scalar keygen dispatch —
         # swarm simulations construct thousands of stacks and pre-generate
@@ -183,6 +186,20 @@ class SecureMessaging:
     def register_message_listener(self, cb: Callable[[str, Message], None]) -> None:
         if cb not in self._listeners:
             self._listeners.append(cb)
+
+    def _spawn(self, coro, what: str) -> asyncio.Task:
+        """Supervised fire-and-forget: keep a strong reference until done and
+        log unexpected exceptions (otherwise they only surface at GC)."""
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+
+        def _done(t: asyncio.Task) -> None:
+            self._bg_tasks.discard(t)
+            if not t.cancelled() and t.exception() is not None:
+                logger.error("background %s failed", what, exc_info=t.exception())
+
+        task.add_done_callback(_done)
+        return task
 
     def _notify(self, peer_id: str, message: Message) -> None:
         for cb in list(self._listeners):
@@ -258,13 +275,13 @@ class SecureMessaging:
                 return None
             try:
                 return verifier.verify(pk, message, sig)
-            except Exception:
+            except Exception:  # qrlint: disable=broad-except  — verify contract: malformed attacker input maps to False, never an exception
                 return False
         try:
             if self._bsig is not None:
                 return await self._bsig.verify(pk, message, sig)
             return self.signature.verify(pk, message, sig)
-        except Exception:
+        except Exception:  # qrlint: disable=broad-except  — verify contract: malformed attacker input maps to False, never an exception
             return False
 
     def _dedup(self, message_id: str) -> bool:
@@ -285,7 +302,7 @@ class SecureMessaging:
             self.shared_keys.pop(peer_id, None)
             self.raw_secrets.pop(peer_id, None)
             self.ke_state[peer_id] = KeyExchangeState.NONE
-            asyncio.ensure_future(self.request_peer_settings(peer_id))
+            self._spawn(self.request_peer_settings(peer_id), "settings gossip")
         elif event == "disconnect":
             self.ke_state[peer_id] = KeyExchangeState.NONE
 
@@ -655,9 +672,10 @@ class SecureMessaging:
 
     async def send_file(self, peer_id: str, path: str | Path) -> Message | None:
         p = Path(path)
-        return await self.send_message(
-            peer_id, p.read_bytes(), is_file=True, filename=p.name
-        )
+        # Read on a worker thread: a large file would otherwise stall every
+        # peer this loop is serving.
+        content = await asyncio.get_running_loop().run_in_executor(None, p.read_bytes)
+        return await self.send_message(peer_id, content, is_file=True, filename=p.name)
 
     async def _handle_secure_message(self, peer_id: str, msg: dict) -> None:
         """Decrypt -> verify -> cross-check -> dedup -> fan out (ref: :1437-1558)."""
@@ -766,7 +784,7 @@ class SecureMessaging:
         await self.notify_peers_of_settings_change()
         for peer_id in peers:
             if self.node.is_connected(peer_id):
-                asyncio.ensure_future(self.initiate_key_exchange(peer_id))
+                self._spawn(self.initiate_key_exchange(peer_id), "re-handshake")
 
     async def set_symmetric_algorithm(self, name: str) -> None:
         """Re-derive per-peer keys from stored raw secrets (reference: :1783-1810)."""

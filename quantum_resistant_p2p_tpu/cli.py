@@ -177,7 +177,9 @@ class CLI:
                 if native.load() is not None:
                     return "native C++ core: ✓"
             except Exception:
-                pass
+                # The fallback banner already tells the user; keep the cause
+                # findable instead of silently discarding it.
+                logger.debug("native core probe failed", exc_info=True)
             return "native C++ core: ✗ (pure-Python fallback)"
 
         core = await asyncio.get_running_loop().run_in_executor(None, _probe_native)
@@ -189,6 +191,9 @@ class CLI:
             await self.discovery.stop()
         if self.node:
             await self.node.stop()
+        if self.secure_logger:
+            # Key hygiene: the log key must not outlive the session.
+            self.secure_logger.zeroize()
         self._stop.set()
 
     def _on_message(self, peer_id: str, message: Message) -> None:
@@ -364,9 +369,9 @@ class CLI:
             self.secure_logger.log_event("key_history_changed", cleared=n)
             self.print(f"deleted {n} entries")
         elif cmd == "/passwd":
-            old = getpass.getpass("old password: ")
-            new = getpass.getpass("new password: ")
-            if new != getpass.getpass("confirm: "):
+            old = await self._getpass("old password: ")
+            new = await self._getpass("new password: ")
+            if new != await self._getpass("confirm: "):
                 self.print("mismatch")
             elif self.storage.change_password(old, new):
                 self.secure_logger.log_event("password_change")
@@ -376,7 +381,7 @@ class CLI:
         elif cmd == "/reset":
             confirm = await self._prompt("type RESET to destroy the vault and start fresh: ")
             if confirm == "RESET":
-                new = getpass.getpass("new password: ")
+                new = await self._getpass("new password: ")
                 self.storage.reset_storage(new)
                 self.print("vault reset")
             else:
@@ -403,7 +408,16 @@ class CLI:
             self.print(text)
             line = await self._reader.readline()
             return line.decode().strip()
-        return input(text).strip()
+        # No REPL reader: a blocking input() would stall every connected peer
+        # (the loop also serves TCP); read it on a worker thread instead.
+        line = await asyncio.get_running_loop().run_in_executor(None, input, text)
+        return line.strip()
+
+    async def _getpass(self, prompt: str) -> str:
+        """Echo-free password read off the event loop (getpass blocks)."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, getpass.getpass, prompt
+        )
 
     def _peer(self, prefix: str) -> str:
         """Resolve a peer-id prefix to a full id."""

@@ -53,7 +53,10 @@ def _rotl(hi, lo, n: int):
         n -= 32
         if n == 0:
             return hi, lo
-    return (hi << n) | (lo >> (32 - n)), (lo << n) | (hi >> (32 - n))
+    return (
+        (hi << n) | (lo >> (32 - n)),  # qrlint: disable=int32-narrowing — uint32 lane words: bits shifted past 32 drop by design, the rotation recovers them from the partner word
+        (lo << n) | (hi >> (32 - n)),  # qrlint: disable=int32-narrowing — same wrap-by-design rotation, low word
+    )
 
 
 def _f1600(sh: list, sl: list) -> tuple[list, list]:

@@ -63,11 +63,25 @@ class NodeDiscovery:
             local_addr=("0.0.0.0", self.discovery_port),
             allow_broadcast=True,
         )
+        # self._tasks is the strong reference keeping both loops alive (the
+        # event loop itself only holds weak refs); the done-callback surfaces
+        # a loop that dies unexpectedly — otherwise discovery would go silent
+        # with the exception parked on the task until GC.
         self._tasks = [
-            asyncio.create_task(self._announce_loop()),
-            asyncio.create_task(self._expiry_loop()),
+            self._supervise(self._announce_loop(), "announce loop"),
+            self._supervise(self._expiry_loop(), "expiry loop"),
         ]
         logger.info("discovery listening on UDP %d", self.discovery_port)
+
+    def _supervise(self, coro, what: str) -> asyncio.Task:
+        task = asyncio.create_task(coro)
+
+        def _done(t: asyncio.Task) -> None:
+            if not t.cancelled() and t.exception() is not None:
+                logger.error("discovery %s died", what, exc_info=t.exception())
+
+        task.add_done_callback(_done)
+        return task
 
     async def stop(self) -> None:
         for t in self._tasks:

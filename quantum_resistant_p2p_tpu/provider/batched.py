@@ -196,6 +196,9 @@ class OpQueue:
         self._futures: list[asyncio.Future] = []
         self._timer: asyncio.TimerHandle | None = None
         self._first_enqueue_t = 0.0
+        #: strong refs to in-flight dispatch tasks: the loop holds only weak
+        #: references, so an unreferenced flush could be GC'd mid-dispatch
+        self._dispatch_tasks: set[asyncio.Task] = set()
 
     async def submit(self, item: Any) -> Any:
         loop = asyncio.get_running_loop()
@@ -222,7 +225,18 @@ class OpQueue:
             futs = self._futures[: self.max_batch]
             del self._items[: self.max_batch]
             del self._futures[: self.max_batch]
-            loop.create_task(self._dispatch(items, futs, self._first_enqueue_t))
+            task = loop.create_task(self._dispatch(items, futs, self._first_enqueue_t))
+            self._dispatch_tasks.add(task)
+            task.add_done_callback(self._reap_dispatch)
+
+    def _reap_dispatch(self, task: asyncio.Task) -> None:
+        self._dispatch_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            # _dispatch forwards batch errors to the waiter futures; anything
+            # surfacing HERE escaped that path and must not vanish.
+            logging.getLogger(__name__).error(
+                "batch dispatch task failed", exc_info=task.exception()
+            )
 
     def _trip_breaker(self, reason: str, dt: float) -> None:
         self.stats.breaker_trips += 1
@@ -530,7 +544,7 @@ class BatchedSignature:
             sigs = [s for _, _, s in valid] + [valid[-1][2]] * pad
             try:
                 oks = algo.verify_batch(pks, msgs, sigs)
-            except Exception:
+            except Exception:  # qrlint: disable=broad-except  — verify contract: malformed input means False for the whole batch, never an exception
                 oks = [False] * tgt
             return [bool(ok) for ok in oks]
 

@@ -138,7 +138,7 @@ class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
                     public_key, _m_prime(message), signature
                 )
             return mldsa_ref.verify(self.params, public_key, message, signature)
-        except Exception:
+        except Exception:  # qrlint: disable=broad-except  — verify contract (base.py): malformed attacker input maps to False, never an exception
             return False
 
     # -- batch API (tpu-native; cpu falls back to base-class loop) ----------
@@ -278,7 +278,7 @@ class SPHINCSSignature(_MeshDispatchMixin, SignatureAlgorithm):
             if self._native is not None:
                 return self._native.verify_internal(message, signature, public_key)
             return slhdsa_ref.verify(self.params, public_key, message, signature)
-        except Exception:
+        except Exception:  # qrlint: disable=broad-except  — verify contract (base.py): malformed attacker input maps to False, never an exception
             return False
 
     # -- batch API ----------------------------------------------------------

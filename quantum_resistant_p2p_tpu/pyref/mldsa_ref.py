@@ -494,5 +494,5 @@ def verify(p: MLDSAParams, pk: bytes, message: bytes, sigma: bytes, ctx: bytes =
     m_prime = bytes([0, len(ctx)]) + ctx + message
     try:
         return verify_internal(p, pk, m_prime, sigma)
-    except Exception:
+    except Exception:  # qrlint: disable=broad-except  — FIPS 204 verify contract: any malformed signature/key decodes to False, never an exception
         return False

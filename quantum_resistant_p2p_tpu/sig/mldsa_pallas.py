@@ -53,7 +53,7 @@ def _rej_ntt_tiles(in_hi: list, in_lo: list) -> list:
         byts = block_bytes(sh, sl, RATE_WORDS)
         for t in range(len(byts) // 3):
             b0, b1, b2 = byts[3 * t], byts[3 * t + 1], byts[3 * t + 2]
-            c = (b0 | (b1 << 8) | ((b2 & 0x7F) << 16)).astype(jnp.int32)
+            c = (b0 | (b1 << 8) | ((b2 & 0x7F) << 16)).astype(jnp.int32)  # qrlint: disable=int32-narrowing — bytes < 256: the assembled candidate is at most 23 bits
             cand.append(c)
         if blk + 1 < N_SQUEEZE:
             sh, sl = _f1600(sh, sl)
@@ -167,9 +167,9 @@ def _mm_zeta(a, z: int):
     Horner over 8-bit limbs of z keeps every intermediate under 2**31
     (identical arithmetic to sig/mldsa.py:_mm with b static)."""
     b2, b1, b0 = z >> 16, (z >> 8) & 0xFF, z & 0xFF
-    r = (a * b2) % Q
-    r = (((r << 8) % Q) + (a * b1) % Q) % Q
-    r = (((r << 8) % Q) + (a * b0) % Q) % Q
+    r = (a * b2) % Q  # qrlint: disable=int32-narrowing — a < q < 2**23 and b2 = z >> 16 <= 0x7F, so a * b2 < 2**30
+    r = (((r << 8) % Q) + (a * b1) % Q) % Q  # qrlint: disable=int32-narrowing — r < q < 2**23 so r << 8 < 2**31; a * b1 < 2**23 * 2**8 = 2**31
+    r = (((r << 8) % Q) + (a * b0) % Q) % Q  # qrlint: disable=int32-narrowing — same bounds as the previous limb step
     return r
 
 

@@ -78,7 +78,7 @@ def _default_kdf() -> dict:
 
         Argon2id(salt=b"\0" * 16, length=32, iterations=1, lanes=1, memory_cost=32)
         return {"algo": "argon2id", "iterations": 3, "lanes": 4, "memory_cost": 100 * 1024}
-    except Exception:
+    except Exception:  # qrlint: disable=broad-except  — capability probe: any failure (old OpenSSL, import error) means "use scrypt", which IS the handling
         return {"algo": "scrypt", "n": 2**15, "r": 8, "p": 1}
 
 
@@ -119,7 +119,7 @@ class KeyStorage:
             master = _derive_key(password, _unb64(vault["salt"]), vault["kdf"])
             check = vault["check"]
             AESGCM(master).decrypt(_unb64(check["nonce"]), _unb64(check["ct"]), None)
-        except Exception:
+        except Exception:  # qrlint: disable=broad-except  — unlock contract: wrong password and corrupt vault both map to False; logging the cause would oracle which one it was
             return False
         self._set_master(master)
         return True
@@ -295,7 +295,7 @@ class KeyStorage:
         vault = self._file.read_json()
         try:
             old_master = _derive_key(old_password, _unb64(vault["salt"]), vault["kdf"])
-        except Exception:
+        except Exception:  # qrlint: disable=broad-except  — same contract as unlock(): any KDF failure means "wrong password" -> False
             return False
         if old_master != self._master:
             return False

@@ -33,6 +33,30 @@ logger = logging.getLogger(__name__)
 
 STALE_AFTER = 3600.0  # seconds after which a lockfile is presumed abandoned
 
+#: lock-retry backoff bounds: start fast (a writer usually finishes in
+#: milliseconds), grow 2x per miss so a contended lock doesn't spin the CPU,
+#: never wait longer than the cap (keeps worst-case latency additive, not
+#: multiplicative, near the deadline)
+_BACKOFF_INITIAL = 0.005
+_BACKOFF_CAP = 0.25
+
+
+def backoff_delays(deadline: float):
+    """Monotonic-deadline exponential backoff: yields sleep durations until
+    ``time.monotonic()`` passes ``deadline``, then stops.
+
+    Pure iterator — it never sleeps itself, so the SAME schedule drives both
+    the sync path (``time.sleep``) and the async path (``asyncio.sleep``)
+    without this module choosing a blocking primitive for its callers.
+    """
+    delay = _BACKOFF_INITIAL
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        yield min(delay, remaining, _BACKOFF_CAP)
+        delay = min(delay * 2, _BACKOFF_CAP)
+
 
 def _pid_alive(pid: int) -> bool:
     try:
@@ -55,21 +79,40 @@ class FileLock:
         self._fd: int | None = None
 
     def acquire(self) -> None:
-        deadline = time.monotonic() + self.timeout
-        while True:
-            self._break_if_stale()
-            try:
-                fd = os.open(self.lock_path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
-            except FileExistsError:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(f"could not lock {self.lock_path}")
-                time.sleep(0.05)
-                continue
-            os.write(fd, f"{os.getpid()}:{time.time()}".encode())
-            if fcntl is not None:
-                fcntl.flock(fd, fcntl.LOCK_EX)
-            self._fd = fd
-            return
+        """Take the lock, sleeping between retries (SYNC-ONLY: blocks the
+        calling thread; from a coroutine use :meth:`acquire_async` — qrlint's
+        blocking-in-async rule rejects direct calls in ``async def``)."""
+        delays = backoff_delays(time.monotonic() + self.timeout)
+        while not self._try_once():
+            delay = next(delays, None)
+            if delay is None:
+                raise TimeoutError(f"could not lock {self.lock_path}")
+            time.sleep(delay)
+
+    async def acquire_async(self) -> None:
+        """Async twin of :meth:`acquire`: identical backoff schedule, but
+        yields the event loop between retries instead of blocking it."""
+        import asyncio
+
+        delays = backoff_delays(time.monotonic() + self.timeout)
+        while not self._try_once():
+            delay = next(delays, None)
+            if delay is None:
+                raise TimeoutError(f"could not lock {self.lock_path}")
+            await asyncio.sleep(delay)
+
+    def _try_once(self) -> bool:
+        """One non-blocking acquisition attempt."""
+        self._break_if_stale()
+        try:
+            fd = os.open(self.lock_path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        except FileExistsError:
+            return False
+        os.write(fd, f"{os.getpid()}:{time.time()}".encode())
+        if fcntl is not None:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        self._fd = fd
+        return True
 
     def release(self) -> None:
         if self._fd is None:
@@ -100,6 +143,13 @@ class FileLock:
         return self
 
     def __exit__(self, *exc: object) -> None:
+        self.release()
+
+    async def __aenter__(self) -> "FileLock":
+        await self.acquire_async()
+        return self
+
+    async def __aexit__(self, *exc: object) -> None:
         self.release()
 
 

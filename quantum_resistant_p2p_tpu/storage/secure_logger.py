@@ -147,6 +147,15 @@ class SecureLogger:
             "algorithms_used": dict(algos),
         }
 
+    # -- hygiene -------------------------------------------------------------
+
+    def zeroize(self) -> None:
+        """Drop the AEAD (and with it the only handle on the log key): after
+        this the instance can neither write nor decrypt — re-derive the
+        purpose key from the vault to resume logging."""
+        with self._lock:
+            self._aead = None
+
     def clear_logs(self) -> int:
         with self._lock:
             n = 0

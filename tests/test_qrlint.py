@@ -1,0 +1,431 @@
+"""qrlint self-tests: every rule pack fires on a minimal bad fixture, stays
+quiet on the good twin, honours inline suppression — and the live codebase
+is violation-free (the CI ratchet this suite exists to keep taut)."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.analysis import default_rules
+from tools.analysis.engine import Engine
+from tools.analysis.run import main as qrlint_main
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "quantum_resistant_p2p_tpu"
+
+
+def lint(source: str):
+    findings, suppressed = Engine(default_rules()).lint_source(textwrap.dedent(source))
+    return findings, suppressed
+
+
+def rule_ids(source: str) -> list[str]:
+    return [f.rule for f in lint(source)[0]]
+
+
+# -- secret-hygiene pack ------------------------------------------------------
+
+
+def test_secret_in_log_fires_on_logging_sink():
+    ids = rule_ids(
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def unlock(secret_key):
+            logger.info("derived %s", secret_key)
+        """
+    )
+    assert ids == ["secret-in-log"]
+
+
+def test_secret_in_log_fires_on_exception_and_repr_and_fstring():
+    src = """
+        def f(master_key):
+            raise ValueError(master_key)
+
+        def g(shared_key):
+            return repr(shared_key)
+
+        def h(entry_key):
+            return f"state: {entry_key!r}"
+        """
+    assert rule_ids(src) == ["secret-in-log"] * 3
+
+
+def test_secret_in_log_allows_sanitized_and_public_values():
+    ids = rule_ids(
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def f(secret_key, public_key):
+            logger.info("have %d-byte key", len(secret_key))
+            logger.info("peer pk %s", public_key.hex())
+        """
+    )
+    assert ids == []
+
+
+def test_secret_in_log_suppression():
+    findings, suppressed = lint(
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def f(secret_key):
+            logger.debug("kat trace %s", secret_key)  # qrlint: disable=secret-in-log
+        """
+    )
+    assert not findings
+    assert [s.rule for s in suppressed] == ["secret-in-log"]
+
+
+def test_zeroize_incomplete_fires_and_clean_twin_passes():
+    bad = """
+        class Vault:
+            def __init__(self, key):
+                self._master_key = key
+                self._aead = AESGCM(key)
+
+            def zeroize(self):
+                self._master_key = None
+        """
+    assert rule_ids(bad) == ["zeroize-incomplete"]
+    good = bad.replace(
+        "self._master_key = None",
+        "self._master_key = None\n                self._aead = None",
+    )
+    assert rule_ids(good) == []
+
+
+# -- jax-kernel pack ----------------------------------------------------------
+
+
+def test_traced_branch_fires_inside_jit():
+    ids = rule_ids(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """
+    )
+    assert ids == ["traced-branch"]
+
+
+def test_traced_branch_allows_shape_and_static_argnames():
+    ids = rule_ids(
+        """
+        import functools
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.shape[0] > 8:
+                return x
+            return -x
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            if n > 0:
+                return x
+            return -x
+        """
+    )
+    assert ids == []
+
+
+def test_int32_narrowing_fires_on_tile_multiply():
+    ids = rule_ids(
+        """
+        from jax.experimental import pallas as pl
+
+        def _square_kernel(a_ref, out_ref):
+            a = a_ref[...]
+            out_ref[...] = a * a
+        """
+    )
+    assert ids == ["int32-narrowing"]
+
+
+def test_int32_narrowing_allows_host_scalars_and_suppression():
+    findings, suppressed = lint(
+        """
+        from jax.experimental import pallas as pl
+
+        def _scale_kernel(a_ref, out_ref, n: int):
+            stride = n * 4
+            a = a_ref[...]
+            out_ref[...] = a * a  # qrlint: disable=int32-narrowing — fixture: inputs bounded by 2**15
+        """
+    )
+    assert not findings
+    assert [s.rule for s in suppressed] == ["int32-narrowing"]
+
+
+def test_host_sync_fires_on_item_inside_jit():
+    ids = rule_ids(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+        """
+    )
+    assert ids == ["host-sync"]
+
+
+# -- asyncio-discipline pack --------------------------------------------------
+
+
+def test_dangling_task_fires_on_discarded_create_task():
+    ids = rule_ids(
+        """
+        import asyncio
+
+        async def main(worker):
+            asyncio.create_task(worker())
+        """
+    )
+    assert ids == ["dangling-task"]
+
+
+def test_dangling_task_allows_stored_reference():
+    ids = rule_ids(
+        """
+        import asyncio
+
+        async def main(worker):
+            task = asyncio.create_task(worker())
+            await task
+        """
+    )
+    assert ids == []
+
+
+def test_unawaited_coroutine_fires():
+    ids = rule_ids(
+        """
+        async def worker():
+            pass
+
+        def main():
+            worker()
+        """
+    )
+    assert ids == ["unawaited-coroutine"]
+
+
+def test_blocking_in_async_fires_on_sleep_open_and_sync_lock():
+    ids = rule_ids(
+        """
+        import time
+
+        async def f(path, lock):
+            time.sleep(0.05)
+            open(path)
+            path.read_bytes()
+            lock.acquire()
+        """
+    )
+    assert ids == ["blocking-in-async"] * 4
+
+
+def test_blocking_calls_fine_outside_async():
+    ids = rule_ids(
+        """
+        import time
+
+        def f(path):
+            time.sleep(0.05)
+            return open(path)
+        """
+    )
+    assert ids == []
+
+
+def test_broad_except_fires_when_silent_and_passes_when_logged():
+    bad = """
+        def f(g):
+            try:
+                g()
+            except Exception:
+                pass
+        """
+    assert rule_ids(bad) == ["broad-except"]
+    good = """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def f(g):
+            try:
+                g()
+            except Exception:
+                logger.exception("g failed")
+        """
+    assert rule_ids(good) == []
+
+
+def test_bare_except_fires_even_with_logging():
+    # bare except swallows CancelledError; logging does not excuse it
+    ids = rule_ids(
+        """
+        import logging
+
+        def f(g):
+            try:
+                g()
+            except:
+                logging.error("boom")
+        """
+    )
+    assert ids == ["broad-except"]
+
+
+# -- provider-contract pack (cross-file) --------------------------------------
+
+_BASE = """
+import abc
+
+
+class KeyExchangeAlgorithm(abc.ABC):
+    @abc.abstractmethod
+    def encapsulate(self, public_key):
+        ...
+
+    def encapsulate_batch(self, public_keys):
+        return [self.encapsulate(pk) for pk in public_keys]
+"""
+
+_REGISTRY = """
+from .impls import BadKEM, GoodKEM
+
+
+def register_kem(name, factory, backends=None):
+    pass
+
+
+register_kem("good", lambda: GoodKEM())
+register_kem("bad", lambda: BadKEM())
+"""
+
+_IMPLS = """
+from .base import KeyExchangeAlgorithm
+
+
+class GoodKEM(KeyExchangeAlgorithm):
+    def encapsulate(self, public_key):
+        return b""
+
+
+class BadKEM(KeyExchangeAlgorithm):
+    def encapsulate_batch(self, keys):
+        return []
+"""
+
+
+def _write_provider_tree(tmp_path: Path) -> Path:
+    pkg = tmp_path / "provider"
+    pkg.mkdir()
+    (pkg / "base.py").write_text(_BASE)
+    (pkg / "registry.py").write_text(_REGISTRY)
+    (pkg / "impls.py").write_text(_IMPLS)
+    return pkg
+
+
+def test_provider_contract_flags_missing_and_renamed(tmp_path):
+    pkg = _write_provider_tree(tmp_path)
+    findings, _ = Engine(default_rules()).lint_paths([pkg])
+    contract = [f for f in findings if f.rule == "provider-contract"]
+    messages = "\n".join(f.message for f in contract)
+    assert "BadKEM" in messages and "encapsulate()" in messages
+    assert "encapsulate_batch(keys)" in messages  # renamed positional param
+    assert "GoodKEM" not in messages
+
+
+# -- engine mechanics ---------------------------------------------------------
+
+
+def test_file_level_suppression():
+    findings, suppressed = lint(
+        """
+        # qrlint: disable-file=broad-except
+
+        def f(g):
+            try:
+                g()
+            except Exception:
+                pass
+
+        def h(g):
+            try:
+                g()
+            except Exception:
+                pass
+        """
+    )
+    assert not findings
+    assert [s.rule for s in suppressed] == ["broad-except"] * 2
+
+
+def test_multi_rule_suppression_on_one_line():
+    findings, _ = lint(
+        """
+        import asyncio
+
+        async def main(worker):
+            asyncio.create_task(worker())  # qrlint: disable=dangling-task, unawaited-coroutine
+        """
+    )
+    assert not findings
+
+
+def test_findings_carry_location_and_json_shape(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("async def f():\n    import time\n    time.sleep(1)\n")
+    rc = qrlint_main([str(bad), "--json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["error"] == 1
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "blocking-in-async"
+    assert finding["path"] == str(bad) and finding["line"] == 3
+
+
+# -- the CI ratchet -----------------------------------------------------------
+
+
+def test_cli_exits_nonzero_on_violation(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(g):\n    try:\n        g()\n    except Exception:\n        pass\n")
+    assert qrlint_main([str(bad)]) == 1
+    bad.write_text("def f(g):\n    g()\n")
+    assert qrlint_main([str(bad)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_select_and_unknown_rule(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("async def f():\n    import time\n    time.sleep(1)\n")
+    # selecting an unrelated rule skips the finding; unknown ids are an error
+    assert qrlint_main([str(bad), "--select", "broad-except"]) == 0
+    assert qrlint_main([str(bad), "--select", "no-such-rule"]) == 2
+    capsys.readouterr()
+
+
+def test_live_codebase_is_violation_free(capsys):
+    """The whole package lints clean: every historical finding is either
+    fixed or carries a justified inline suppression.  New violations fail
+    here AND in the CI qrlint step."""
+    rc = qrlint_main([str(PACKAGE)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"qrlint found new violations:\n{out}"

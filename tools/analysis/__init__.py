@@ -1,0 +1,40 @@
+"""qrlint — crypto/JAX/asyncio-aware static analysis for quantum_resistant_p2p_tpu.
+
+Generic linters cannot see this codebase's three domain-specific failure
+modes: silent int32 overflow inside Pallas NTT arithmetic, swallowed
+exceptions on fire-and-forget asyncio tasks, and secret material leaking
+into logs or reprs.  qrlint is a small AST rule engine (engine.py) plus four
+rule packs:
+
+* rules_secret   — secret-hygiene (no secrets into logging/exceptions/repr;
+                   zeroize methods must clear every secret-holding attribute)
+* rules_jax      — jax-kernel discipline (no Python control flow on traced
+                   values, no silently-narrowing int32 multiplies/shifts in
+                   kernel arithmetic, no host<->device sync inside jit)
+* rules_asyncio  — asyncio discipline (no dangling tasks, no unawaited
+                   coroutines, no blocking calls in async defs, no silent
+                   broad excepts)
+* rules_provider — provider-contract (every registered algorithm implements
+                   the full provider/base.py surface with matching batch
+                   signatures)
+
+Run: ``python -m tools.analysis.run quantum_resistant_p2p_tpu`` (or the
+``qrlint`` console script).  Docs: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+from .engine import Engine, Finding, Rule  # noqa: F401
+
+
+def default_rules() -> list[Rule]:
+    """All four rule packs, instantiated fresh (rules keep per-run state)."""
+    from .rules_asyncio import ASYNCIO_RULES
+    from .rules_jax import JAX_RULES
+    from .rules_provider import PROVIDER_RULES
+    from .rules_secret import SECRET_RULES
+
+    return [
+        cls()
+        for cls in (*SECRET_RULES, *JAX_RULES, *ASYNCIO_RULES, *PROVIDER_RULES)
+    ]

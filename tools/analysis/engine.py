@@ -1,0 +1,318 @@
+"""qrlint rule engine: one AST walk per file, visitor dispatch, suppressions.
+
+Design (docs/static_analysis.md):
+
+* A :class:`Rule` registers node handlers per file via ``start_file``; the
+  engine does ONE depth-first walk per file and dispatches each node to every
+  handler registered for its type — rules never re-walk the tree themselves.
+* During the walk ``ctx.stack`` holds the ancestor chain, so handlers can ask
+  for the nearest enclosing function/class without parent bookkeeping.
+* Cross-file rules implement ``check_project`` and run once after every file
+  has been parsed (used by the provider-contract pack).
+* Suppression is inline: ``# qrlint: disable=rule-id[,rule-id]`` on the
+  flagged line (or any line of the smallest enclosing statement) silences
+  exactly those rules there; ``# qrlint: disable-file=rule-id`` at module
+  level silences a rule for the whole file.  Suppressions are counted, so a
+  run can report how many findings were explicitly waived.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Any, Callable, Iterable
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(r"#\s*qrlint:\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[\w.,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class for a lint rule.
+
+    Subclasses set ``id``/``description`` and implement ``start_file`` (for
+    per-file AST checks) and/or ``check_project`` (for cross-file checks).
+    """
+
+    id: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def start_file(self, ctx: "FileContext") -> dict[type, Callable[[ast.AST], None]] | None:
+        """Return ``{node_type: handler}`` for this file, or None to skip it."""
+        return None
+
+    def finish_file(self, ctx: "FileContext") -> None:
+        """Called after the walk of one file (emit deferred findings here)."""
+
+    def check_project(self, project: "Project") -> None:
+        """Called once per run with every parsed file (cross-file checks)."""
+
+
+class FileContext:
+    """Parsed source + suppression map + the walk-time ancestor stack."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        #: ancestor chain of the node currently being visited (outermost first)
+        self.stack: list[ast.AST] = []
+        self.findings: list[Finding] = []
+        self.suppressed: list[Finding] = []
+        self._line_disables: dict[int, set[str]] = {}
+        self._file_disables: set[str] = set()
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+            if m.group("scope"):
+                self._file_disables |= rules
+            else:
+                self._line_disables.setdefault(lineno, set()).update(rules)
+
+    # -- scope helpers (valid during the walk) ------------------------------
+
+    def enclosing(self, *types: type) -> ast.AST | None:
+        """Innermost ancestor of one of ``types`` (walk-time only)."""
+        for node in reversed(self.stack):
+            if isinstance(node, types):
+                return node
+        return None
+
+    def enclosing_function(self) -> ast.AST | None:
+        return self.enclosing(ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+    def enclosing_statement(self, node: ast.AST) -> ast.stmt | None:
+        for anc in reversed([*self.stack, node]):
+            if isinstance(anc, ast.stmt):
+                return anc
+        return None
+
+    # -- reporting ----------------------------------------------------------
+
+    def report(self, rule: Rule, node: ast.AST, message: str) -> None:
+        finding = Finding(
+            rule=rule.id,
+            severity=rule.severity,
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+        )
+        if self._is_suppressed(finding, node):
+            self.suppressed.append(finding)
+        else:
+            self.findings.append(finding)
+
+    def _is_suppressed(self, finding: Finding, node: ast.AST) -> bool:
+        if finding.rule in self._file_disables:
+            return True
+        candidates = {finding.line}
+        stmt = self.enclosing_statement(node)
+        if stmt is not None:
+            candidates.update(range(stmt.lineno, (stmt.end_lineno or stmt.lineno) + 1))
+        return any(
+            finding.rule in self._line_disables.get(line, ()) for line in candidates
+        )
+
+
+class Project:
+    """All parsed files of one run, for cross-file rules."""
+
+    def __init__(self, contexts: dict[str, FileContext]):
+        self.contexts = contexts
+        self.findings: list[Finding] = []
+        self.suppressed: list[Finding] = []
+
+    def find_file(self, suffix: str) -> FileContext | None:
+        """Locate a file by path suffix (e.g. ``provider/base.py``)."""
+        for path, ctx in self.contexts.items():
+            if path.replace("\\", "/").endswith(suffix):
+                return ctx
+        return None
+
+    def report(self, rule: Rule, ctx: FileContext, node: ast.AST, message: str) -> None:
+        before = len(ctx.findings)
+        ctx.report(rule, node, message)
+        if len(ctx.findings) > before:
+            self.findings.append(ctx.findings.pop())
+        else:
+            self.suppressed.append(ctx.suppressed.pop())
+
+
+class Engine:
+    """Runs a rule set over files: parse once, walk once, dispatch handlers."""
+
+    def __init__(self, rules: Iterable[Rule]):
+        self.rules = list(rules)
+
+    # -- entry points -------------------------------------------------------
+
+    def lint_source(self, source: str, path: str = "<string>") -> tuple[list[Finding], list[Finding]]:
+        """Lint one in-memory module (used by the test fixtures)."""
+        ctx = FileContext(path, source)
+        self._run_file(ctx)
+        project = Project({path: ctx})
+        self._run_project(project)
+        return (
+            ctx.findings + project.findings,
+            ctx.suppressed + project.suppressed,
+        )
+
+    def lint_paths(self, paths: Iterable[str | Path]) -> tuple[list[Finding], list[Finding]]:
+        files: list[Path] = []
+        for p in paths:
+            p = Path(p)
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            else:
+                files.append(p)
+        contexts: dict[str, FileContext] = {}
+        findings: list[Finding] = []
+        suppressed: list[Finding] = []
+        for f in files:
+            try:
+                ctx = FileContext(str(f), f.read_text(encoding="utf-8"))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                findings.append(
+                    Finding("parse-error", "error", str(f), 1, 1, f"cannot parse: {e}")
+                )
+                continue
+            self._run_file(ctx)
+            contexts[str(f)] = ctx
+            findings.extend(ctx.findings)
+            suppressed.extend(ctx.suppressed)
+            ctx.findings = []
+            ctx.suppressed = []
+        project = Project(contexts)
+        self._run_project(project)
+        findings.extend(project.findings)
+        suppressed.extend(project.suppressed)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings, suppressed
+
+    # -- internals ----------------------------------------------------------
+
+    def _run_file(self, ctx: FileContext) -> None:
+        dispatch: dict[type, list[Callable[[ast.AST], None]]] = {}
+        active: list[Rule] = []
+        for rule in self.rules:
+            handlers = rule.start_file(ctx)
+            if handlers is None:
+                continue
+            active.append(rule)
+            for node_type, handler in handlers.items():
+                dispatch.setdefault(node_type, []).append(handler)
+        if dispatch:
+            self._walk(ctx, ctx.tree, dispatch)
+        for rule in active:
+            rule.finish_file(ctx)
+
+    def _walk(self, ctx: FileContext, node: ast.AST,
+              dispatch: dict[type, list[Callable[[ast.AST], None]]]) -> None:
+        for handler in dispatch.get(type(node), ()):
+            handler(node)
+        ctx.stack.append(node)
+        try:
+            for child in ast.iter_child_nodes(node):
+                self._walk(ctx, child, dispatch)
+        finally:
+            ctx.stack.pop()
+
+    def _run_project(self, project: Project) -> None:
+        for rule in self.rules:
+            rule.check_project(project)
+
+
+# -- shared AST helpers used by the rule packs --------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def last_attr(node: ast.AST) -> str | None:
+    """The final identifier of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def decorator_names(func: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    """Dotted names of all decorators; for ``functools.partial(f, ...)`` and
+    similar calls, the name of the called function AND its first argument."""
+    out: list[str] = []
+    for dec in func.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = dotted_name(dec.func)
+            if name:
+                out.append(name)
+            if dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner:
+                    out.append(inner)
+        else:
+            name = dotted_name(dec)
+            if name:
+                out.append(name)
+    return out
+
+
+def render_findings(findings: list[Finding], suppressed: list[Finding],
+                    as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(
+            {
+                "findings": [f.as_dict() for f in findings],
+                "suppressed": [f.as_dict() for f in suppressed],
+                "counts": {
+                    "error": sum(f.severity == "error" for f in findings),
+                    "warning": sum(f.severity == "warning" for f in findings),
+                    "suppressed": len(suppressed),
+                },
+            },
+            indent=2,
+        )
+    lines = [f.format() for f in findings]
+    lines.append(
+        f"qrlint: {sum(f.severity == 'error' for f in findings)} error(s), "
+        f"{sum(f.severity == 'warning' for f in findings)} warning(s), "
+        f"{len(suppressed)} suppressed"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,226 @@
+"""Asyncio-discipline rule pack.
+
+The networking stack (net/, app/) serves every peer from one event loop;
+the ML-KEM TLS literature (arxiv 2404.13544, PAPERS.md) shows handshake
+stacks live or die on exception/timeout discipline.  Four failure modes:
+
+* ``dangling-task`` — ``asyncio.create_task``/``ensure_future`` whose result
+  is discarded: the task can be garbage-collected mid-flight and its
+  exception is silently dropped at interpreter exit.
+* ``unawaited-coroutine`` — calling a coroutine function defined in the same
+  module without awaiting it: the body never runs (RuntimeWarning at GC).
+* ``blocking-in-async`` — ``time.sleep``/``getpass``/sync file I/O directly
+  inside ``async def``: stalls every connected peer for the duration (the
+  event loop is shared).  ``FileLock.acquire`` is on the blocklist because
+  its retry loop sleeps (storage/secure_file.py documents it as sync-only;
+  use ``acquire_async`` from coroutines).
+* ``broad-except`` — ``except Exception``/bare ``except`` whose handler
+  neither logs, re-raises, nor forwards the error to a future: failures
+  vanish.  Bare ``except`` additionally swallows ``CancelledError``, wedging
+  task cancellation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import FileContext, Rule, call_name
+
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+#: dotted call names that block the event loop when called from async code
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "getpass.getpass": "run it in an executor: `await loop.run_in_executor(None, getpass.getpass, prompt)`",
+    "input": "read through the asyncio stream reader or an executor",
+    "open": "wrap the I/O in `loop.run_in_executor`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
+    "socket.create_connection": "use `asyncio.open_connection`",
+}
+#: method names that are sync file I/O or documented-sync locks regardless of
+#: receiver (Path.read_bytes(...), FileLock.acquire(), ...)
+_BLOCKING_METHODS = {
+    "read_bytes": "sync file I/O",
+    "write_bytes": "sync file I/O",
+    "read_text": "sync file I/O",
+    "write_text": "sync file I/O",
+}
+#: attribute calls blocking only for specific receivers — FileLock.acquire is
+#: sync-only by contract (storage/secure_file.py); asyncio primitives named
+#: `acquire` (Lock, Semaphore) are awaited, so a bare `.acquire()` expression
+#: statement inside async code is wrong either way.
+_SYNC_ONLY_METHODS = {"acquire": "FileLock.acquire is sync-only; await acquire_async() instead"}
+
+_LOGGING_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
+                    "log", "log_event", "print"}
+
+
+def _async_def_names(tree: ast.Module) -> set[str]:
+    return {n.name for n in ast.walk(tree) if isinstance(n, ast.AsyncFunctionDef)}
+
+
+def _in_async_function(ctx: FileContext) -> bool:
+    func = ctx.enclosing(ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    return isinstance(func, ast.AsyncFunctionDef)
+
+
+class DanglingTaskRule(Rule):
+    id = "dangling-task"
+    description = (
+        "create_task/ensure_future result discarded: task may be GC'd "
+        "mid-flight and its exception silently dropped"
+    )
+
+    def start_file(self, ctx: FileContext):
+        return {ast.Expr: lambda n: self._check(ctx, n)}
+
+    def _check(self, ctx: FileContext, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Await):
+            return
+        if not isinstance(call, ast.Call):
+            return
+        name = call_name(call) or ""
+        if name.split(".")[-1] in _TASK_SPAWNERS:
+            ctx.report(
+                self, call,
+                f"result of {name}() discarded: keep a strong reference and "
+                "attach a done-callback that logs unexpected exceptions",
+            )
+
+
+class UnawaitedCoroutineRule(Rule):
+    id = "unawaited-coroutine"
+    description = "coroutine called without await: its body never runs"
+
+    def start_file(self, ctx: FileContext):
+        self._async_names = _async_def_names(ctx.tree)
+        if not self._async_names:
+            return None
+        return {ast.Expr: lambda n: self._check(ctx, n)}
+
+    def _check(self, ctx: FileContext, node: ast.Expr) -> None:
+        call = node.value
+        if not isinstance(call, ast.Call):
+            return
+        # only bare names and self/cls methods: `asyncio.run(run())` must not
+        # collide with a local coroutine that happens to be called `run`
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif (isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name)
+              and func.value.id in ("self", "cls")):
+            name = func.attr
+        else:
+            return
+        if name in self._async_names:
+            ctx.report(
+                self, call,
+                f"coroutine {name}() is never awaited (its body will not run); "
+                "await it or schedule it as a supervised task",
+            )
+
+
+class BlockingInAsyncRule(Rule):
+    id = "blocking-in-async"
+    description = "blocking call inside async def stalls the shared event loop"
+
+    def start_file(self, ctx: FileContext):
+        return {ast.Call: lambda n: self._check(ctx, n)}
+
+    def _check(self, ctx: FileContext, node: ast.Call) -> None:
+        if not _in_async_function(ctx):
+            return
+        name = call_name(node) or ""
+        if name in _BLOCKING_CALLS:
+            ctx.report(self, node,
+                       f"blocking {name}() inside async def; {_BLOCKING_CALLS[name]}")
+            return
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _BLOCKING_METHODS:
+                ctx.report(
+                    self, node,
+                    f"{_BLOCKING_METHODS[attr]} (.{attr}()) inside async def; "
+                    "wrap it in `loop.run_in_executor`",
+                )
+            elif attr in _SYNC_ONLY_METHODS and self._is_bare_expr(ctx, node):
+                ctx.report(self, node, _SYNC_ONLY_METHODS[attr])
+
+    @staticmethod
+    def _is_bare_expr(ctx: FileContext, node: ast.Call) -> bool:
+        stmt = ctx.enclosing_statement(node)
+        return isinstance(stmt, ast.Expr) and stmt.value is node
+
+
+class BroadExceptRule(Rule):
+    id = "broad-except"
+    description = (
+        "broad except that neither logs, re-raises, nor forwards the error; "
+        "bare except additionally swallows CancelledError"
+    )
+
+    def start_file(self, ctx: FileContext):
+        return {ast.ExceptHandler: lambda n: self._check(ctx, n)}
+
+    def _check(self, ctx: FileContext, node: ast.ExceptHandler) -> None:
+        kind = self._broad_kind(node.type)
+        if kind is None:
+            return
+        if kind in ("bare", "BaseException"):
+            if not self._reraises(node):
+                ctx.report(
+                    self, node,
+                    f"{'bare except' if kind == 'bare' else 'except BaseException'} "
+                    "swallows CancelledError/KeyboardInterrupt; catch Exception "
+                    "or re-raise",
+                )
+            return
+        if not self._handles(node):
+            ctx.report(
+                self, node,
+                "except Exception with no logging/re-raise/set_exception: "
+                "failures vanish silently; narrow the except, log the error, "
+                "or annotate why silence is the contract",
+            )
+
+    @staticmethod
+    def _broad_kind(type_node: ast.AST | None) -> str | None:
+        if type_node is None:
+            return "bare"
+        names = []
+        if isinstance(type_node, ast.Tuple):
+            names = [getattr(e, "id", getattr(e, "attr", "")) for e in type_node.elts]
+        else:
+            names = [getattr(type_node, "id", getattr(type_node, "attr", ""))]
+        if "BaseException" in names:
+            return "BaseException"
+        if "Exception" in names:
+            return "Exception"
+        return None
+
+    @staticmethod
+    def _reraises(node: ast.ExceptHandler) -> bool:
+        return any(isinstance(n, ast.Raise) for n in ast.walk(node))
+
+    @staticmethod
+    def _handles(node: ast.ExceptHandler) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                # attr check (not dotted-name) so chained receivers like
+                # logging.getLogger(__name__).exception(...) count
+                if isinstance(n.func, ast.Attribute) and (
+                    n.func.attr in _LOGGING_METHODS or n.func.attr == "set_exception"
+                ):
+                    return True
+                name = (call_name(n) or "").split(".")[-1]
+                if name in _LOGGING_METHODS:
+                    return True
+        return False
+
+
+ASYNCIO_RULES = (DanglingTaskRule, UnawaitedCoroutineRule,
+                 BlockingInAsyncRule, BroadExceptRule)

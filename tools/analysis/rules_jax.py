@@ -1,0 +1,331 @@
+"""JAX-kernel rule pack.
+
+Three failure modes generic linters cannot see in the Pallas/JAX layers
+(core/, kem/, sig/):
+
+* ``traced-branch`` — Python ``if``/``while`` on a traced value inside a
+  ``@jax.jit`` function: raises TracerBoolConversionError at best, silently
+  bakes one branch into the compiled program at worst.  Names derived from
+  ``static_argnames`` parameters, module constants, or ``.shape``/``.ndim``/
+  ``.dtype`` accesses are compile-time static and fine.
+* ``int32-narrowing`` — ``*`` / ``<<`` on kernel tile values: TPU vector
+  registers are 32-bit, so a product of two mod-q residues (q=8380417 needs
+  23 bits) silently wraps.  Every flagged site must either widen, restructure
+  (Horner over limbs, as sig/mldsa_pallas._mm_zeta does), or carry a
+  suppression whose comment states the overflow bound.
+* ``host-sync`` — ``.item()`` / ``np.asarray`` / ``float()`` on a traced
+  value inside a jit function: forces a device→host transfer and a pipeline
+  stall on the hot path.
+
+File scoping: traced-branch/host-sync run on any file importing jax;
+int32-narrowing runs only on files that use Pallas (where arithmetic runs on
+fixed-width vregs and overflow is silent).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import FileContext, Rule, call_name, decorator_names, last_attr
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+#: builtins whose result is host-static when applied to anything
+_STATIC_CALLS = {"len", "range", "int", "float", "bool", "min", "max", "isinstance",
+                 "getattr", "hasattr", "tuple", "sorted", "abs", "pow", "divmod"}
+
+
+def _imports_jax(ctx: FileContext) -> bool:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.") for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax" or node.module.startswith("jax.")):
+                return True
+    return False
+
+
+def _uses_pallas(ctx: FileContext) -> bool:
+    return "pallas" in ctx.source
+
+
+def _is_jit_decorated(func: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    names = decorator_names(func)
+    return any(n in ("jax.jit", "jit") or n.endswith(".jit") for n in names)
+
+
+def _static_argnames(func: ast.FunctionDef) -> set[str]:
+    """String literals of ``static_argnames=...`` in the jit decorator."""
+    out: set[str] = set()
+    for dec in func.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                        out.add(node.value)
+    return out
+
+
+def _param_names(func: ast.FunctionDef) -> list[ast.arg]:
+    a = func.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs,
+            *([a.vararg] if a.vararg else []), *([a.kwarg] if a.kwarg else [])]
+
+
+class _TaintMap:
+    """Fixed-point name propagation inside one function body.
+
+    ``tainted`` starts as the traced/tile parameters; an assignment taints
+    its targets iff the RHS *references* a tainted name outside of a
+    host-static context (``x.shape``, ``len(x)``, ``enumerate`` indices,
+    ``range`` loop variables stay host-side).
+    """
+
+    def __init__(self, func: ast.FunctionDef, seed: set[str]):
+        self.tainted = set(seed)
+        body = func.body
+        for _ in range(3):  # fixed point for straight-line + simple loops
+            before = len(self.tainted)
+            for stmt in body:
+                self._visit(stmt)
+            if len(self.tainted) == before:
+                break
+
+    # -- taint tests --------------------------------------------------------
+
+    def is_tainted(self, expr: ast.AST) -> bool:
+        """True if ``expr`` references a tainted name outside a static context."""
+        return self._first_tainted(expr) is not None
+
+    def _first_tainted(self, expr: ast.AST) -> ast.AST | None:
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return None  # x.shape is a host int even when x is traced
+            return self._first_tainted(expr.value)
+        if isinstance(expr, ast.Call):
+            fname = call_name(expr)
+            if fname and fname.split(".")[-1] in _STATIC_CALLS:
+                return None
+        if isinstance(expr, ast.Name):
+            return expr if expr.id in self.tainted else None
+        for child in ast.iter_child_nodes(expr):
+            hit = self._first_tainted(child)
+            if hit is not None:
+                return hit
+        return None
+
+    # -- propagation --------------------------------------------------------
+
+    def _targets(self, target: ast.AST) -> list[str]:
+        """Names BOUND by an assignment target.  A subscript store taints the
+        container, never the index expression (``sh[x + 5*y] = v`` taints
+        ``sh``, not ``x``/``y``)."""
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            return [n for e in target.elts for n in self._targets(e)]
+        if isinstance(target, ast.Starred):
+            return self._targets(target.value)
+        if isinstance(target, ast.Subscript):
+            return self._targets(target.value)
+        return []  # attribute stores don't bind local names
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            if self.is_tainted(node.value):
+                self._assign_targets(node.targets, node.value)
+        elif isinstance(node, ast.AugAssign):
+            if self.is_tainted(node.value) or self.is_tainted(node.target):
+                self.tainted.update(self._targets(node.target))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if self.is_tainted(node.value):
+                self.tainted.update(self._targets(node.target))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._loop_target(node.target, node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                self._loop_target(gen.target, gen.iter)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _assign_targets(self, targets: list[ast.AST], value: ast.AST) -> None:
+        # `i, c = enumerate(...)` style pairs handled at the loop level; a
+        # plain tainted assignment taints every bound name.
+        for t in targets:
+            self.tainted.update(self._targets(t))
+
+    def _loop_target(self, target: ast.AST, iter_expr: ast.AST) -> None:
+        if isinstance(iter_expr, ast.Call):
+            fname = (call_name(iter_expr) or "").split(".")[-1]
+            if fname == "range":
+                return  # range indices are host ints
+            if fname == "enumerate" and isinstance(target, ast.Tuple) and len(target.elts) == 2:
+                # index is a host int; only the element inherits taint
+                if any(self.is_tainted(a) for a in iter_expr.args):
+                    self.tainted.update(self._targets(target.elts[1]))
+                return
+        if self.is_tainted(iter_expr):
+            self.tainted.update(self._targets(target))
+
+
+class TracedBranchRule(Rule):
+    id = "traced-branch"
+    description = "Python if/while on a traced value inside a @jax.jit function"
+
+    def start_file(self, ctx: FileContext):
+        if not _imports_jax(ctx):
+            return None
+        return {ast.FunctionDef: lambda n: self._check(ctx, n)}
+
+    def _check(self, ctx: FileContext, func: ast.FunctionDef) -> None:
+        if not _is_jit_decorated(func):
+            return
+        static = _static_argnames(func)
+        traced = {a.arg for a in _param_names(func) if a.arg not in static}
+        taint = _TaintMap(func, traced)
+        for node in ast.walk(func):
+            if isinstance(node, (ast.If, ast.While)):
+                hit = taint._first_tainted(node.test)
+                if hit is not None:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    ctx.report(
+                        self, node,
+                        f"`{kind}` on traced value {last_attr(hit)!r} in jit "
+                        f"function {func.name!r}: use jnp.where/lax.cond, or "
+                        "mark the argument static",
+                    )
+
+
+class Int32NarrowingRule(Rule):
+    id = "int32-narrowing"
+    description = (
+        "multiply/left-shift on kernel tile values can exceed 31 bits and "
+        "silently wrap in int32 vector registers"
+    )
+
+    #: functions whose parameters are VMEM tiles: Pallas kernel bodies and
+    #: the register-resident helpers they are built from
+    _TILE_FUNC_SUFFIXES = ("_kernel", "_tiles")
+
+    def start_file(self, ctx: FileContext):
+        if not _uses_pallas(ctx):
+            return None
+        self._helper_names = self._tile_helper_names(ctx)
+        return {ast.FunctionDef: lambda n: self._check(ctx, n)}
+
+    def _tile_helper_names(self, ctx: FileContext) -> set[str]:
+        """Top-level helpers that tile functions call with tile arguments
+        (e.g. _rotl/_mm_zeta): their params are tiles too."""
+        tile_funcs = set()
+        calls_in_tiles: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) and node.name.endswith(self._TILE_FUNC_SUFFIXES):
+                tile_funcs.add(node.name)
+                for call in ast.walk(node):
+                    if isinstance(call, ast.Call):
+                        name = call_name(call)
+                        if name and "." not in name:
+                            calls_in_tiles.add(name)
+        # fixed point: helpers called from helpers (absorb_block -> _f1600)
+        for _ in range(3):
+            grew = False
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name in calls_in_tiles
+                        and node.name not in tile_funcs):
+                    tile_funcs.add(node.name)
+                    grew = True
+                    for call in ast.walk(node):
+                        if isinstance(call, ast.Call):
+                            name = call_name(call)
+                            if name and "." not in name:
+                                calls_in_tiles.add(name)
+            if not grew:
+                break
+        return tile_funcs
+
+    def _check(self, ctx: FileContext, func: ast.FunctionDef) -> None:
+        if not (func.name.endswith(self._TILE_FUNC_SUFFIXES)
+                or func.name in self._helper_names):
+            return
+        # parameters annotated as host scalars are not tiles
+        tile_params = {
+            a.arg
+            for a in _param_names(func)
+            if not (isinstance(a.annotation, ast.Name)
+                    and a.annotation.id in ("int", "bool", "float", "str"))
+        } - {"self"}
+        taint = _TaintMap(func, tile_params)
+        seen_lines: set[int] = set()
+        for node in ast.walk(func):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, (ast.Mult, ast.LShift))):
+                continue
+            if isinstance(node.left, (ast.List, ast.Tuple)) or \
+                    isinstance(node.right, (ast.List, ast.Tuple)):
+                continue  # sequence replication, not tile arithmetic
+            hit = taint._first_tainted(node.left) or taint._first_tainted(node.right)
+            if hit is None or node.lineno in seen_lines:
+                continue
+            seen_lines.add(node.lineno)
+            op = "*" if isinstance(node.op, ast.Mult) else "<<"
+            ctx.report(
+                self, node,
+                f"`{op}` on tile value {last_attr(hit)!r} in {func.name!r}: "
+                "prove the 31-bit bound in a suppression comment, or widen/"
+                "restructure (Horner over limbs)",
+            )
+
+
+class HostSyncRule(Rule):
+    id = "host-sync"
+    description = "device->host sync (.item()/np.asarray/float()) inside a jit function"
+
+    _SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                   "jax.device_get"}
+    _SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+    _SYNC_CASTS = {"float", "int", "bool", "complex"}
+
+    def start_file(self, ctx: FileContext):
+        if not _imports_jax(ctx):
+            return None
+        self._stack: list[_TaintMap | None] = []
+        return {
+            ast.FunctionDef: lambda n: self._enter(n),
+            ast.Call: lambda n: self._call(ctx, n),
+        }
+
+    def _enter(self, func: ast.FunctionDef) -> None:
+        if _is_jit_decorated(func):
+            static = _static_argnames(func)
+            traced = {a.arg for a in _param_names(func) if a.arg not in static}
+            self._taint = _TaintMap(func, traced)
+            self._jit_func = func
+        elif not getattr(self, "_jit_func", None):
+            self._taint = None
+
+    def _call(self, ctx: FileContext, node: ast.Call) -> None:
+        func = ctx.enclosing(ast.FunctionDef, ast.AsyncFunctionDef)
+        if func is not getattr(self, "_jit_func", None) or self._taint is None:
+            return
+        name = call_name(node) or ""
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) else None
+        tainted_arg = any(self._taint.is_tainted(a) for a in node.args)
+        if name in self._SYNC_CALLS and tainted_arg:
+            ctx.report(self, node,
+                       f"{name}() on a traced value forces a device->host sync "
+                       "inside a jit function; keep data on device (jnp.asarray)")
+        elif attr in self._SYNC_METHODS and isinstance(node.func, ast.Attribute) \
+                and self._taint.is_tainted(node.func.value):
+            ctx.report(self, node,
+                       f".{attr}() on a traced value forces a device->host sync "
+                       "inside a jit function")
+        elif name in self._SYNC_CASTS and tainted_arg:
+            ctx.report(self, node,
+                       f"{name}() on a traced value concretizes it on the host "
+                       "inside a jit function")
+
+
+JAX_RULES = (TracedBranchRule, Int32NarrowingRule, HostSyncRule)

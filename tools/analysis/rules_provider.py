@@ -1,0 +1,208 @@
+"""Provider-contract rule pack (cross-file).
+
+The registry (provider/registry.py) is the only seam between the protocol
+engine and the crypto backends: ``SecureMessaging`` calls whatever the
+factory returns through the ``provider/base.py`` surface, and the batching
+queue (provider/batched.py) additionally requires the ``*_batch`` methods to
+accept the exact positional shape it forwards.  A registered class missing a
+method, or overriding a batch method with renamed/reordered parameters, only
+fails at runtime — mid-handshake.  This rule proves the contract statically:
+
+* every class reachable from a ``register_kem``/``register_signature`` call
+  (or listed in the AEAD table) implements each ``@abc.abstractmethod`` of
+  its base-interface, directly or via a project base class;
+* every override of a base-class method keeps the base's positional
+  parameter names in order (extra trailing parameters must have defaults).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Project, Rule, call_name
+
+_BASE_SUFFIX = "provider/base.py"
+_REGISTRY_SUFFIX = "provider/registry.py"
+
+#: interface -> the registry call that binds implementations to it
+_INTERFACES = {
+    "KeyExchangeAlgorithm": "register_kem",
+    "SignatureAlgorithm": "register_signature",
+    "SymmetricAlgorithm": "_AEADS",
+}
+
+
+def _method_params(func: ast.FunctionDef) -> list[str]:
+    """Positional parameter names (without self) + set of defaulted names."""
+    args = func.args
+    return [a.arg for a in [*args.posonlyargs, *args.args] if a.arg != "self"]
+
+
+def _defaulted_params(func: ast.FunctionDef) -> set[str]:
+    args = func.args
+    pos = [a.arg for a in [*args.posonlyargs, *args.args]]
+    out = set(pos[len(pos) - len(args.defaults):]) if args.defaults else set()
+    out.update(a.arg for a, d in zip(args.kwonlyargs, args.kw_defaults) if d is not None)
+    return out
+
+
+def _is_abstract(func: ast.FunctionDef) -> bool:
+    for dec in func.decorator_list:
+        name = call_name(dec) if isinstance(dec, ast.Call) else None
+        name = name or (dec.attr if isinstance(dec, ast.Attribute) else
+                        dec.id if isinstance(dec, ast.Name) else None)
+        if name and "abstractmethod" in name:
+            return True
+    return False
+
+
+class _ClassIndex:
+    """All class defs in the project, by name, with base-name edges."""
+
+    def __init__(self, project: Project):
+        self.classes: dict[str, tuple[ast.ClassDef, object]] = {}
+        for ctx in project.contexts.values():
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef):
+                    # last definition wins; names are unique in this package
+                    self.classes[node.name] = (node, ctx)
+
+    def mro_methods(self, name: str) -> dict[str, ast.FunctionDef]:
+        """Methods visible on ``name``: own methods shadow base methods."""
+        out: dict[str, ast.FunctionDef] = {}
+        seen: set[str] = set()
+
+        def collect(cls_name: str) -> None:
+            if cls_name in seen or cls_name not in self.classes:
+                return
+            seen.add(cls_name)
+            cls, _ctx = self.classes[cls_name]
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.setdefault(item.name, item)
+            for base in cls.bases:
+                base_name = base.id if isinstance(base, ast.Name) else (
+                    base.attr if isinstance(base, ast.Attribute) else None)
+                if base_name:
+                    collect(base_name)
+
+        collect(name)
+        return out
+
+
+class ProviderContractRule(Rule):
+    id = "provider-contract"
+    description = (
+        "registered algorithm must implement the full provider/base.py "
+        "surface with matching batch-method signatures"
+    )
+
+    def check_project(self, project: Project) -> None:
+        base_ctx = project.find_file(_BASE_SUFFIX)
+        registry_ctx = project.find_file(_REGISTRY_SUFFIX)
+        if base_ctx is None or registry_ctx is None:
+            return  # not linting the provider layer in this run
+        index = _ClassIndex(project)
+        contracts = self._interface_contracts(base_ctx)
+        for cls_name, interface in self._registered_classes(registry_ctx, index):
+            contract = contracts.get(interface)
+            if contract is None:
+                continue
+            self._check_class(project, index, cls_name, interface, contract)
+
+    # -- contract extraction ------------------------------------------------
+
+    def _interface_contracts(self, base_ctx) -> dict[str, dict]:
+        """interface name -> {"abstract": {name}, "signatures": {name: params}}."""
+        out: dict[str, dict] = {}
+        for node in ast.walk(base_ctx.tree):
+            if not isinstance(node, ast.ClassDef) or node.name not in _INTERFACES:
+                continue
+            abstract: set[str] = set()
+            signatures: dict[str, list[str]] = {}
+            for item in node.body:
+                if not isinstance(item, ast.FunctionDef):
+                    continue
+                if _is_abstract(item):
+                    abstract.add(item.name)
+                if not item.name.startswith("__"):
+                    signatures[item.name] = _method_params(item)
+            out[node.name] = {"abstract": abstract, "signatures": signatures}
+        return out
+
+    def _registered_classes(self, registry_ctx, index: _ClassIndex):
+        """Yield (class_name, interface_name) for every registration site."""
+        seen: set[str] = set()
+        for node in ast.walk(registry_ctx.tree):
+            # register_kem("name", lambda ...: ClassName(...), backends)
+            if isinstance(node, ast.Call):
+                fname = (call_name(node) or "").split(".")[-1]
+                interface = {v: k for k, v in _INTERFACES.items()}.get(fname)
+                if interface is None:
+                    continue
+                for cls_name in self._called_classes(node):
+                    if cls_name not in seen:
+                        seen.add(cls_name)
+                        yield cls_name, interface
+            # _AEADS = {"AES-256-GCM": AES256GCM, ...} (plain or annotated)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                if isinstance(node, ast.Assign):
+                    targets = [getattr(t, "id", None) for t in node.targets]
+                else:
+                    targets = [getattr(node.target, "id", None)]
+                if "_AEADS" in targets and isinstance(node.value, ast.Dict):
+                    for v in node.value.values:
+                        if isinstance(v, ast.Name) and v.id not in seen:
+                            seen.add(v.id)
+                            yield v.id, "SymmetricAlgorithm"
+
+    @staticmethod
+    def _called_classes(call: ast.Call):
+        """CapitalizedName(...) calls inside a registration's factory arg."""
+        for node in ast.walk(call):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                if node.func.id[:1].isupper():
+                    yield node.func.id
+
+    # -- checking -----------------------------------------------------------
+
+    def _check_class(self, project: Project, index: _ClassIndex, cls_name: str,
+                     interface: str, contract: dict) -> None:
+        if cls_name not in index.classes:
+            return  # defined outside the linted tree
+        cls, ctx = index.classes[cls_name]
+        methods = index.mro_methods(cls_name)
+        for name in sorted(contract["abstract"]):
+            impl = methods.get(name)
+            if impl is None or _is_abstract(impl):
+                project.report(
+                    self, ctx, cls,
+                    f"{cls_name} is registered as a {interface} but does not "
+                    f"implement abstract method {name}()",
+                )
+        for name, base_params in contract["signatures"].items():
+            impl = methods.get(name)
+            if impl is None or _is_abstract(impl):
+                continue
+            impl_params = _method_params(impl)
+            if impl_params[: len(base_params)] != base_params:
+                project.report(
+                    self, ctx, impl,
+                    f"{cls_name}.{name}({', '.join(impl_params)}) does not "
+                    f"match the {interface} signature ({', '.join(base_params)}): "
+                    "the batch queue forwards these positionally",
+                )
+                continue
+            extra = impl_params[len(base_params):]
+            defaulted = _defaulted_params(impl)
+            bad = [p for p in extra if p not in defaulted]
+            if bad:
+                project.report(
+                    self, ctx, impl,
+                    f"{cls_name}.{name} adds required parameter(s) "
+                    f"{', '.join(bad)} beyond the {interface} surface; give "
+                    "them defaults so interface callers keep working",
+                )
+
+
+PROVIDER_RULES = (ProviderContractRule,)

@@ -1,0 +1,201 @@
+"""Secret-hygiene rule pack.
+
+The storage layer holds long-lived secret material (vault master keys,
+per-peer shared secrets, signature secret keys).  Two failure modes this
+pack catches:
+
+* ``secret-in-log`` — a secret-named value flowing into a logging call, an
+  exception message, a ``repr()``, or an ``{x!r}`` f-string conversion.
+  Audit-log sinks (``log_event`` / ``_log``) count as logging: the audit log
+  is encrypted, but its queries are displayed in cleartext (cli.py /logs).
+* ``zeroize-incomplete`` — a class that CLAIMS zeroization (defines
+  ``zeroize``/``_zeroize``) but forgets to clear one of its secret-holding
+  attributes, silently extending key lifetime (storage/key_storage.py's
+  lock() contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import FileContext, Rule, call_name, last_attr
+
+#: identifiers that hold secret material.  ``_key`` suffixes are secret by
+#: default in this codebase (entry_key, index_key, log_key, shared_key, ...);
+#: the NONSECRET list walks back the public/verification-side names.
+SECRET_NAME_RE = re.compile(
+    r"(password|passwd|secret|private|master|keypair)"
+    r"|(^|_)(sk|skey)($|_)"
+    r"|(^|_)key$"
+    r"|^key$",
+    re.IGNORECASE,
+)
+NONSECRET_NAME_RE = re.compile(r"(public|pub($|_)|(^|_)pk($|_)|verify|test)", re.IGNORECASE)
+
+#: method names treated as logging sinks.  log_event/_log are this repo's
+#: encrypted audit-log writers — decrypted and displayed by /logs.
+LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
+               "log", "log_event", "_log"}
+
+
+def is_secret_name(name: str | None) -> bool:
+    if not name:
+        return False
+    return bool(SECRET_NAME_RE.search(name)) and not NONSECRET_NAME_RE.search(name)
+
+
+#: calls whose result no longer reveals the secret (sizes, types, hashes of
+#: public data are fine to log)
+_SANITIZERS = {"len", "type", "bool", "id"}
+
+
+def secret_refs(node: ast.AST) -> list[ast.AST]:
+    """Secret-named Name/Attribute nodes reachable in ``node``, skipping
+    subtrees wrapped in a sanitizing call (``len(secret)`` is loggable)."""
+    out: list[ast.AST] = []
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Call):
+            fname = call_name(n)
+            if fname and fname.split(".")[-1] in _SANITIZERS:
+                return  # sanitized: do not descend into the arguments
+        if isinstance(n, (ast.Name, ast.Attribute)) and is_secret_name(last_attr(n)):
+            out.append(n)
+            return  # the chain itself is the finding; don't double-report
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+def _is_logging_call(call: ast.Call) -> bool:
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in LOG_METHODS:
+        return False
+    receiver = last_attr(func.value)
+    if func.attr in ("log_event", "_log"):
+        return True
+    # logger.info(...), logging.warning(...), self.logger.error(...)
+    return bool(receiver) and ("log" in receiver.lower() or receiver == "logging")
+
+
+class SecretInLogRule(Rule):
+    id = "secret-in-log"
+    description = (
+        "secret-named value flows into a logging call, exception message, "
+        "repr(), or {x!r} f-string"
+    )
+
+    def start_file(self, ctx: FileContext):
+        return {
+            ast.Call: lambda n: self._call(ctx, n),
+            ast.Raise: lambda n: self._raise(ctx, n),
+            ast.FormattedValue: lambda n: self._fvalue(ctx, n),
+        }
+
+    def _call(self, ctx: FileContext, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Name) and node.func.id == "repr":
+            for arg in node.args:
+                for ref in secret_refs(arg):
+                    ctx.report(self, node,
+                               f"repr() of secret {last_attr(ref)!r} exposes key material")
+            return
+        if not _is_logging_call(node):
+            return
+        for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+            for ref in secret_refs(arg):
+                ctx.report(
+                    self, ref,
+                    f"secret {last_attr(ref)!r} passed to logging sink "
+                    f"{call_name(node) or node.func.attr!r}",
+                )
+
+    def _raise(self, ctx: FileContext, node: ast.Raise) -> None:
+        if not isinstance(node.exc, ast.Call):
+            return
+        for arg in node.exc.args:
+            for ref in secret_refs(arg):
+                ctx.report(
+                    self, ref,
+                    f"secret {last_attr(ref)!r} embedded in exception message "
+                    "(exceptions end up in logs and tracebacks)",
+                )
+
+    def _fvalue(self, ctx: FileContext, node: ast.FormattedValue) -> None:
+        # {secret!r} in any f-string: the repr goes wherever the string goes.
+        if node.conversion == ord("r"):
+            for ref in secret_refs(node.value):
+                ctx.report(self, ref,
+                           f"{{{last_attr(ref)}!r}} formats secret material")
+
+
+class ZeroizeIncompleteRule(Rule):
+    id = "zeroize-incomplete"
+    description = (
+        "class defines zeroize()/_zeroize() but does not clear every "
+        "secret-holding attribute it assigns"
+    )
+
+    _ZEROIZE_NAMES = {"zeroize", "_zeroize"}
+
+    def start_file(self, ctx: FileContext):
+        return {ast.ClassDef: lambda n: self._check_class(ctx, n)}
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef) -> None:
+        zeroize = next(
+            (
+                n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name in self._ZEROIZE_NAMES
+            ),
+            None,
+        )
+        if zeroize is None:
+            return  # no zeroization claim, nothing to verify
+        secret_attrs = self._secret_attrs(cls)
+        cleared = {
+            t.attr
+            for stmt in ast.walk(zeroize)
+            if isinstance(stmt, ast.Assign)
+            for t in stmt.targets
+            if isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name) and t.value.id == "self"
+        }
+        missing = sorted(secret_attrs - cleared)
+        if missing:
+            ctx.report(
+                self, zeroize,
+                f"{cls.name}.{zeroize.name}() does not clear secret "
+                f"attribute(s): {', '.join(missing)}",
+            )
+
+    def _secret_attrs(self, cls: ast.ClassDef) -> set[str]:
+        """Attributes that are secret by NAME or assigned FROM a secret-named
+        value (``self._aead = AESGCM(key)`` holds the key even though the
+        attribute name doesn't say so)."""
+        out: set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    continue
+                if is_secret_name(t.attr) and not _is_cleared_value(node.value):
+                    out.add(t.attr)
+                elif secret_refs(node.value):
+                    out.add(t.attr)
+        return out
+
+
+def _is_cleared_value(value: ast.AST) -> bool:
+    """``None`` / ``b""`` / ``0`` assignments are clears, not holdings."""
+    return isinstance(value, ast.Constant) and not value.value
+
+
+SECRET_RULES = (SecretInLogRule, ZeroizeIncompleteRule)

@@ -1,0 +1,68 @@
+"""qrlint CLI — ``python -m tools.analysis.run <package-or-path>``.
+
+Exit status is the CI ratchet: 0 when the tree is clean (modulo explicit,
+justified suppressions), 1 when any error-severity finding remains, 2 on
+usage errors.  ``--json`` emits machine-readable output for tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import default_rules
+from .engine import Engine, render_findings
+
+
+def _resolve_target(target: str) -> Path:
+    """A target is a path, or a dotted/plain package name relative to cwd."""
+    p = Path(target)
+    if p.exists():
+        return p
+    p = Path(target.replace(".", "/"))
+    if p.exists():
+        return p
+    raise SystemExit(f"qrlint: no such file, directory, or package: {target!r}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="qrlint",
+        description="crypto/JAX/asyncio-aware static analysis (docs/static_analysis.md)",
+    )
+    ap.add_argument("targets", nargs="*", default=["quantum_resistant_p2p_tpu"],
+                    help="files, directories, or package names (default: the package)")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--select", help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    args = ap.parse_args(argv)
+
+    rules = default_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.id:18} [{rule.severity}] {rule.description}")
+        return 0
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",")}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"qrlint: unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+    if args.ignore:
+        dropped = {r.strip() for r in args.ignore.split(",")}
+        rules = [r for r in rules if r.id not in dropped]
+
+    targets = [_resolve_target(t) for t in (args.targets or ["quantum_resistant_p2p_tpu"])]
+    findings, suppressed = Engine(rules).lint_paths(targets)
+    out = render_findings(findings, suppressed, as_json=args.json)
+    if out:
+        print(out)
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

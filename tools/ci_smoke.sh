@@ -16,3 +16,7 @@ echo "qrp2p --help ok"
 
 python -m quantum_resistant_p2p_tpu --help >/dev/null
 echo "python -m quantum_resistant_p2p_tpu --help ok"
+
+# Static-analysis ratchet: the tree must lint clean (docs/static_analysis.md).
+python -m tools.analysis.run quantum_resistant_p2p_tpu
+echo "qrlint clean"
