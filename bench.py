@@ -9,6 +9,15 @@ configuration's figure rides along as ``value_at_provider_dispatch`` /
 size (so it reads ``mlkem768_encaps_batch4096_dispatch2048``; rounds 1-3
 recorded the same quantity as ``mlkem768_encaps_batch4096``).
 
+``--slo`` switches to the latency SLO probe: 32 sequential warm handshakes
+through the tpu+batch stack (tools/swarm_bench.py at concurrency 1), with
+single-handshake warm p50/p99 and MEASURED dispatch trips per handshake in
+the emitted JSON — so BENCH_* rounds track the latency frontier (dispatch
+count, docs/dispatch_budget.md) alongside the encaps/s headline.  The SLO
+baseline is round 4's measured warm p50 (bench_results/
+slo_single_handshake_r4.json, pre-fusion, same tunnel class):
+``vs_baseline`` > 1 means faster than round 4.
+
 Baseline: BASELINE.md / BASELINE.json north star — >= 50,000 ML-KEM-768
 encaps/sec on one v5e chip (the reference's serial liboqs path measures
 ~4 full handshakes/sec end-to-end), so vs_baseline is value / 50_000.
@@ -25,12 +34,50 @@ SPHINCS+, swarm) lives in tools/full_bench.py.
 
 from __future__ import annotations
 
+import argparse
 import json
 
 import numpy as np
 
 BATCH = 4096
 BASELINE_OPS_PER_S = 50_000.0
+#: round-4 single-handshake warm p50 (pre-fusion; ~9-11 serial trips/hs)
+SLO_BASELINE_P50_S = 1.5412
+SLO_PEERS = 32
+
+
+def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
+             warmup: int = 4) -> None:
+    """Single-handshake SLO probe as a first-class bench output."""
+    import asyncio
+
+    from tools.swarm_bench import run_swarm
+
+    stats = asyncio.run(
+        run_swarm(peers, backend="tpu", use_batching=True, max_batch=4096,
+                  max_wait_ms=2.0, concurrency=1, warmup=warmup,
+                  prewarm=True, slo=True)
+    )
+    p50 = stats.get("p50_handshake_s")
+    out = {
+        "metric": f"single_handshake_warm_p50_seq{peers}",
+        "value": p50,
+        "unit": "s",
+        # latency SLO: >1 means faster than the round-4 (pre-fusion) probe
+        "vs_baseline": round(SLO_BASELINE_P50_S / p50, 3) if p50 else None,
+        "p99_handshake_s": stats.get("p99_handshake_s"),
+        "trips_per_handshake": stats.get("trips_per_handshake"),
+        "initiator_trips_p50": stats.get("initiator_trips_p50"),
+        "initiator_trips_max": stats.get("initiator_trips_max"),
+        "device_served_pct": stats.get("device_served_pct"),
+        "failures": stats.get("failures"),
+        "detail": stats,
+    }
+    line = json.dumps(out)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
 
 
 def main() -> None:
@@ -101,4 +148,18 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--slo", action="store_true",
+                    help="latency SLO probe (sequential warm handshakes + "
+                         "trips/handshake) instead of the throughput headline")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON line to this path (slo mode)")
+    ap.add_argument("--peers", type=int, default=SLO_PEERS,
+                    help="handshakes in the slo probe")
+    ap.add_argument("--warmup", type=int, default=4,
+                    help="untimed warmup handshakes in the slo probe")
+    args = ap.parse_args()
+    if args.slo:
+        slo_main(args.out, args.peers, args.warmup)
+    else:
+        main()

@@ -35,7 +35,7 @@ from cryptography.hazmat.primitives import hashes
 from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
 from ..net.p2p_node import P2PNode
-from ..provider import get_kem, get_signature, get_symmetric
+from ..provider import get_fused, get_kem, get_signature, get_symmetric
 from ..provider.base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricAlgorithm
 from .message_store import Message
 
@@ -44,6 +44,12 @@ logger = logging.getLogger(__name__)
 REPLAY_WINDOW = 300.0  # seconds, matching the reference's timestamp check
 KEY_EXCHANGE_TIMEOUT = 20.0
 DEDUP_CAPACITY = 1000
+#: pow2 flush buckets precompiled by the background warmup: bucket 1 (the
+#: sequential-handshake case) plus the first pow-2 buckets a small burst of
+#: concurrent handshakes coalesces into — warming ONLY size 1 (the old
+#: default) left the first live size-2/4 flush eating a cold jit inside
+#: KEY_EXCHANGE_TIMEOUT
+WARMUP_SIZES = (1, 2, 4)
 
 
 class KeyExchangeState(enum.Enum):
@@ -66,6 +72,12 @@ class RejectReason(str, enum.Enum):
 
 def _canonical(data: dict) -> bytes:
     return json.dumps(data, sort_keys=True, separators=(",", ":")).encode()
+
+
+#: sentinel: a fused handler consumed the message (failed the exchange with
+#: a typed reason) — distinct from None, which means "not applicable, run
+#: the per-op path"
+_HANDLED = object()
 
 
 def derive_message_key(shared_secret: bytes, id_a: str, id_b: str, aead_name: str) -> bytes:
@@ -124,9 +136,15 @@ class SecureMessaging:
         # covers every size a live swarm can hit (keyword so the positional
         # _batch_cfg unpacking at hot-swap stays untouched)
         self._batch_floor = batch_floor
-        self._bkem = self._bsig = None
+        self._bkem = self._bsig = self._bfused = None
         self._warmup_thread = None
         self._queue_breaker = None
+        #: dispatch trips per completed initiated handshake (integer samples;
+        #: meaningful at concurrency 1 — overlapping handshakes share the
+        #:  breaker counter).  docs/dispatch_budget.md defines the budget.
+        from ..utils.profiling import LatencyHistogram
+
+        self._handshake_trips = LatencyHistogram()
         if use_batching:
             from ..provider.batched import BatchedKEM, BatchedSignature, Breaker
 
@@ -141,6 +159,7 @@ class SecureMessaging:
                                           fallback=self._cpu_fallback_sig(),
                                           breaker=self._queue_breaker,
                                           bucket_floor=batch_floor)
+            self._bfused = self._make_fused()
             self._spawn_warmup()
 
         # per-peer protocol state
@@ -150,6 +169,9 @@ class SecureMessaging:
         self.peer_settings: dict[str, dict] = {}
         self._ephemeral: dict[str, tuple[str, bytes]] = {}  # msg_id -> (peer, sk)
         self._pending: dict[str, asyncio.Future] = {}
+        #: msg_id -> confirm transcript signed by the fused initiator step,
+        #: parked so _handle_ke_response sends EXACTLY the signed bytes
+        self._fused_confirm: dict[str, dict] = {}
         self._processed_ids: dict[str, float] = {}
         self._listeners: list[Callable[[str, Message], None]] = []
         #: strong refs to fire-and-forget tasks — the event loop only keeps
@@ -332,24 +354,46 @@ class SecureMessaging:
             return False
 
         message_id = str(uuid.uuid4())
-        try:
-            pk, sk = await self._kem_keygen()
-        except Exception:
-            logger.exception("ephemeral keygen failed")
-            return False
-        self._ephemeral[message_id] = (peer_id, sk)
-        self.ke_state[peer_id] = KeyExchangeState.INITIATED
-
+        trips0 = self._trips_now()
         ke_data = {
             "message_id": message_id,
             "kem": self.kem.name,
             "aead": self.symmetric.name,
-            "public_key": pk.hex(),
+            "public_key": "",
             "sender": self.node_id,
             "recipient": peer_id,
             "timestamp": time.time(),
         }
-        sig = await self._sign(_canonical(ke_data))
+        pk = sk = sig = None
+        if self._bfused is not None:
+            # Composite path: keygen + sign(init transcript) in ONE device
+            # trip.  The transcript is shipped as a template — the canonical
+            # JSON with a same-length placeholder where the device hex-
+            # encodes the fresh public key — so the signed bytes are
+            # identical to the per-op path's (wire-compatible).
+            ke_data["public_key"] = "0" * (2 * self.kem.public_key_len)
+            template = _canonical(ke_data)
+            if len(template) <= self._bfused.fused.init_template_len:
+                try:
+                    pk, sk, sig = await self._bfused.keygen_sign(
+                        self._sig_keypair[1], template
+                    )
+                except Exception:
+                    logger.exception("fused keygen_sign failed; per-op fallback")
+                    pk = None
+        if pk is None:
+            try:
+                pk, sk = await self._kem_keygen()
+            except Exception:
+                logger.exception("ephemeral keygen failed")
+                return False
+            ke_data["public_key"] = pk.hex()
+            sig = await self._sign(_canonical(ke_data))
+        else:
+            ke_data["public_key"] = pk.hex()
+        self._ephemeral[message_id] = (peer_id, sk)
+        self.ke_state[peer_id] = KeyExchangeState.INITIATED
+
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[message_id] = fut
 
@@ -366,6 +410,7 @@ class SecureMessaging:
             return False
         try:
             await asyncio.wait_for(fut, KEY_EXCHANGE_TIMEOUT)
+            self._handshake_trips.record(self._trips_now() - trips0)
             return True
         except asyncio.TimeoutError:
             # Timeout-but-key-exists recovery (reference: :670-681).
@@ -403,6 +448,70 @@ class SecureMessaging:
             logger.exception("no cpu fallback for %s", self.signature.name)
             return None
 
+    def _make_fused(self):
+        """Composite-queue facade (provider.batched.BatchedFused) when the
+        active (KEM, signature) pair advertises the fused-handshake
+        capability — None (cpu backend, unregistered pair, batching off)
+        keeps every step on the per-op queues.  The transcript offsets are
+        protocol facts of THIS engine's canonical-JSON layout, computed here
+        and baked into the facade (jit keys on them)."""
+        if not self.use_batching:
+            return None
+        fused = get_fused(self.kem, self.signature)
+        if fused is None:
+            return None
+        from ..provider.batched import BatchedFused
+        from ..provider.fused_providers import init_pk_offset, resp_ct_offset
+
+        max_batch, max_wait_ms = self._batch_cfg
+        return BatchedFused(
+            fused,
+            pk_off=init_pk_offset(self.kem.name, self.symmetric.name),
+            ct_off=resp_ct_offset(),
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            fallback_kem=self._cpu_fallback_kem(),
+            fallback_sig=self._cpu_fallback_sig(),
+            breaker=self._queue_breaker,
+            bucket_floor=self._batch_floor,
+        )
+
+    def _trips_now(self) -> int:
+        """Serial dispatch steps (device + fallback) so far on the breaker
+        the live queues actually share (swarm clients share another stack's
+        queues, so the facade's breaker is the truthful one)."""
+        b = self._bkem.breaker if self._bkem is not None else None
+        return (b.device_trips + b.fallback_trips) if b is not None else 0
+
+    def metrics(self) -> dict[str, Any]:
+        """Operational counters: per-queue stats, aggregate dispatch trips,
+        operand-cache hit rates, and trips-per-initiated-handshake."""
+        out: dict[str, Any] = {
+            "backend": self.backend,
+            "batching": self.use_batching,
+        }
+        if self._bkem is not None:
+            out["kem_queue"] = self._bkem.stats()
+            out["sig_queue"] = self._bsig.stats()
+            if self._bfused is not None:
+                out["fused_queue"] = self._bfused.stats()
+            b = self._bkem.breaker
+            out["device_trips"] = b.device_trips
+            out["fallback_trips"] = b.fallback_trips
+            out["breaker_trips"] = b.trips
+        for algo, key in ((self.kem, "kem_opcache"), (self.signature, "sig_opcache")):
+            cache = getattr(algo, "opcache", None)
+            if cache is not None:
+                out[key] = cache.stats()
+        t = self._handshake_trips
+        out["handshake_trips"] = {
+            "count": t.count,
+            "last": int(t.last) if t.last is not None else None,
+            "p50": t.percentile(50),
+            "p99": t.percentile(99),
+        }
+        return out
+
     def _spawn_warmup(self, kem: bool = True, sig: bool = True) -> None:
         """Precompile batched providers' size-1 buckets in the background so
         a live handshake's cold jit never races KEY_EXCHANGE_TIMEOUT
@@ -416,15 +525,20 @@ class SecureMessaging:
         bsig = (
             self._bsig if sig and getattr(self.signature, "backend", "") == "tpu" else None
         )
-        if bkem is None and bsig is None:
+        # the fused facade is rebuilt on every swap (it bakes in the pair AND
+        # the transcript offsets), so whenever it exists it needs a warm
+        bfused = self._bfused
+        if bkem is None and bsig is None and bfused is None:
             return
 
         def _warm():
             try:
                 if bkem is not None:
-                    bkem.warmup()
+                    bkem.warmup(WARMUP_SIZES)
                 if bsig is not None:
-                    bsig.warmup()
+                    bsig.warmup(WARMUP_SIZES)
+                if bfused is not None:
+                    bfused.warmup(WARMUP_SIZES)
             except Exception:
                 logger.exception("batched-provider warmup failed")
 
@@ -459,6 +573,14 @@ class SecureMessaging:
             return RejectReason.ALGORITHM_MISMATCH
         if not ok:
             return RejectReason.INVALID_SIGNATURE
+        return self._check_host(peer_id, data)
+
+    def _check_host(self, peer_id: str, data: dict) -> RejectReason | None:
+        """The host-side half of _check_common (identity + replay window).
+        The fused handshake paths run these BEFORE dispatch and let the
+        signature check ride the composite device program — so a message
+        failing several checks at once may draw a different (equally valid)
+        typed rejection than the per-op path would."""
         if data.get("sender") != peer_id or data.get("recipient") != self.node_id:
             return RejectReason.IDENTITY_MISMATCH
         if abs(time.time() - float(data.get("timestamp", 0))) > REPLAY_WINDOW:
@@ -469,6 +591,8 @@ class SecureMessaging:
         """Responder: verify, encapsulate, derive, reply (reference: :695-905)."""
         data = msg.get("ke_data") or {}
         message_id = data.get("message_id", "?")
+        if await self._fused_handle_ke_init(peer_id, msg, data, message_id):
+            return
         err = await self._check_common(peer_id, data, msg.get("sig", b""),
                                  msg.get("sig_pk", b""), msg.get("sig_algo", ""))
         if err is not None:
@@ -483,12 +607,6 @@ class SecureMessaging:
             logger.exception("encapsulation failed")
             await self._reject(peer_id, message_id, RejectReason.ENCAPSULATION_ERROR)
             return
-        self.raw_secrets[peer_id] = secret
-        self.shared_keys[peer_id] = derive_message_key(
-            secret, self.node_id, peer_id, self.symmetric.name
-        )
-        self.ke_state[peer_id] = KeyExchangeState.RESPONDED
-
         resp = {
             "message_id": message_id,
             "ciphertext": ct.hex(),
@@ -497,6 +615,18 @@ class SecureMessaging:
             "timestamp": time.time(),
         }
         sig = await self._sign(_canonical(resp))
+        await self._respond_established(peer_id, secret, resp, sig)
+
+    async def _respond_established(self, peer_id: str, secret: bytes,
+                                   resp: dict, sig: bytes) -> None:
+        """Responder success tail, shared by the per-op and fused ke_init
+        paths (contractually wire-identical): adopt the shared secret and
+        send the signed ke_response."""
+        self.raw_secrets[peer_id] = secret
+        self.shared_keys[peer_id] = derive_message_key(
+            secret, self.node_id, peer_id, self.symmetric.name
+        )
+        self.ke_state[peer_id] = KeyExchangeState.RESPONDED
         await self.node.send_message(
             peer_id,
             "ke_response",
@@ -506,6 +636,58 @@ class SecureMessaging:
             sig_pk=self._sig_keypair[0],
         )
 
+    async def _fused_handle_ke_init(self, peer_id: str, msg: dict, data: dict,
+                                    message_id: str) -> bool:
+        """Composite responder step: verify(init) + encaps + sign(response)
+        in ONE device trip.  True = handled (replied or rejected); False =
+        not applicable (no capability, algorithm/shape mismatch, composite
+        failure) — the caller falls through to the per-op path, which owns
+        every typed rejection for malformed input."""
+        f = self._bfused
+        if f is None or msg.get("sig_algo", "") != self.signature.name:
+            return False
+        if data.get("kem") != self.kem.name or data.get("aead") != self.symmetric.name:
+            return False  # per-op path sends ALGORITHM_MISMATCH
+        err = self._check_host(peer_id, data)
+        if err is not None:
+            await self._reject(peer_id, message_id, err)
+            return True
+        try:
+            peer_pk = bytes.fromhex(data.get("public_key", ""))
+        except (TypeError, ValueError):  # non-str JSON value raises TypeError
+            return False
+        sig_pk, sig_in = msg.get("sig_pk", b""), msg.get("sig", b"")
+        if (
+            len(peer_pk) != self.kem.public_key_len
+            or len(sig_pk) != self.signature.public_key_len
+            or len(sig_in) != self.signature.signature_len
+        ):
+            return False
+        resp = {
+            "message_id": message_id,
+            "ciphertext": "0" * (2 * self.kem.ciphertext_len),
+            "sender": self.node_id,
+            "recipient": peer_id,
+            "timestamp": time.time(),
+        }
+        template = _canonical(resp)
+        if len(template) > f.fused.resp_template_len:
+            return False
+        try:
+            ok, ct, secret, sig = await f.encaps_verify_sign(
+                peer_pk, sig_pk, _canonical(data), sig_in,
+                self._sig_keypair[1], template,
+            )
+        except Exception:
+            logger.exception("fused encaps_verify_sign failed; per-op fallback")
+            return False
+        if not ok:
+            await self._reject(peer_id, message_id, RejectReason.INVALID_SIGNATURE)
+            return True
+        resp["ciphertext"] = ct.hex()
+        await self._respond_established(peer_id, secret, resp, sig)
+        return True
+
     async def _handle_ke_response(self, peer_id: str, msg: dict) -> None:
         """Initiator: verify, decapsulate, confirm + AEAD test (ref: :907-1146)."""
         data = msg.get("ke_data") or {}
@@ -514,20 +696,29 @@ class SecureMessaging:
         if entry is None or entry[0] != peer_id:
             logger.warning("ke_response for unknown exchange %s", message_id)
             return
-        err = await self._check_common(peer_id, data, msg.get("sig", b""),
-                                 msg.get("sig_pk", b""), msg.get("sig_algo", ""))
-        if err is not None:
-            self._fail_pending(message_id, err.value)
+        fused = await self._fused_handle_ke_response(
+            peer_id, msg, data, message_id, entry
+        )
+        if fused is _HANDLED:
             return
-        try:
-            secret = await self._kem_decaps(entry[1], bytes.fromhex(data["ciphertext"]))
-        except Exception:
-            logger.exception("decapsulation failed")
-            self._fail_pending(message_id, "decapsulation_error")
-            return
-        finally:
-            # Delete the ephemeral secret key immediately (reference: :1041).
-            self._ephemeral.pop(message_id, None)
+        if fused is not None:
+            secret, sig = fused
+        else:
+            err = await self._check_common(peer_id, data, msg.get("sig", b""),
+                                     msg.get("sig_pk", b""), msg.get("sig_algo", ""))
+            if err is not None:
+                self._fail_pending(message_id, err.value)
+                return
+            try:
+                secret = await self._kem_decaps(entry[1], bytes.fromhex(data["ciphertext"]))
+            except Exception:
+                logger.exception("decapsulation failed")
+                self._fail_pending(message_id, "decapsulation_error")
+                return
+            finally:
+                # Delete the ephemeral secret key immediately (reference: :1041).
+                self._ephemeral.pop(message_id, None)
+            sig = None
 
         self.raw_secrets[peer_id] = secret
         key = derive_message_key(secret, self.node_id, peer_id, self.symmetric.name)
@@ -541,7 +732,11 @@ class SecureMessaging:
             "recipient": peer_id,
             "timestamp": time.time(),
         }
-        sig = await self._sign(_canonical(confirm))
+        if sig is None:
+            sig = await self._sign(_canonical(confirm))
+        else:
+            # the fused step signed the confirm transcript it was handed
+            confirm = self._fused_confirm.pop(message_id)
         await self.node.send_message(
             peer_id, "ke_confirm", ke_data=confirm, sig=sig,
             sig_algo=self.signature.name, sig_pk=self._sig_keypair[0],
@@ -556,6 +751,58 @@ class SecureMessaging:
         fut = self._pending.pop(message_id, None)
         if fut is not None and not fut.done():
             fut.set_result(True)
+
+    async def _fused_handle_ke_response(self, peer_id: str, msg: dict,
+                                        data: dict, message_id: str, entry):
+        """Composite initiator step: verify(response) + decaps +
+        sign(confirm transcript) in ONE device trip.  Returns
+        (shared_secret, confirm_sig) on success; ``_HANDLED`` when the
+        exchange was failed here (the composite verify failing maps to
+        INVALID_SIGNATURE, matching the per-op rejection for a bad response
+        signature); None when not applicable (caller runs the per-op path).
+        The signed confirm transcript is parked in ``_fused_confirm`` so
+        the caller sends EXACTLY the signed bytes.
+        """
+        f = self._bfused
+        if f is None or msg.get("sig_algo", "") != self.signature.name:
+            return None
+        err = self._check_host(peer_id, data)
+        if err is not None:
+            self._fail_pending(message_id, err.value)
+            self._ephemeral.pop(message_id, None)
+            return _HANDLED
+        try:
+            ct = bytes.fromhex(data.get("ciphertext", ""))
+        except (TypeError, ValueError):  # non-str JSON value raises TypeError
+            return None
+        sig_pk, sig_in = msg.get("sig_pk", b""), msg.get("sig", b"")
+        if (
+            len(ct) != self.kem.ciphertext_len
+            or len(sig_pk) != self.signature.public_key_len
+            or len(sig_in) != self.signature.signature_len
+        ):
+            return None
+        confirm = {
+            "message_id": message_id,
+            "sender": self.node_id,
+            "recipient": peer_id,
+            "timestamp": time.time(),
+        }
+        try:
+            ok, secret, sig = await f.decaps_verify_sign(
+                entry[1], ct, sig_pk, _canonical(data), sig_in,
+                self._sig_keypair[1], _canonical(confirm),
+            )
+        except Exception:
+            logger.exception("fused decaps_verify_sign failed; per-op fallback")
+            return None
+        if not ok:
+            self._fail_pending(message_id, RejectReason.INVALID_SIGNATURE.value)
+            self._ephemeral.pop(message_id, None)
+            return _HANDLED
+        self._ephemeral.pop(message_id, None)
+        self._fused_confirm[message_id] = confirm
+        return secret, sig
 
     def _fail_pending(self, message_id: str, reason: str) -> None:
         fut = self._pending.pop(message_id, None)
@@ -771,6 +1018,7 @@ class SecureMessaging:
                                     fallback=self._cpu_fallback_kem(),
                                     breaker=self._queue_breaker,
                                     bucket_floor=self._batch_floor)
+            self._bfused = self._make_fused()
             self._spawn_warmup(kem=True, sig=False)
         peers = list(self.shared_keys)
         self.shared_keys.clear()
@@ -789,6 +1037,11 @@ class SecureMessaging:
     async def set_symmetric_algorithm(self, name: str) -> None:
         """Re-derive per-peer keys from stored raw secrets (reference: :1783-1810)."""
         self.symmetric = get_symmetric(name)
+        if self.use_batching and self._bfused is not None:
+            # the AEAD name sits BEFORE public_key in the canonical init
+            # JSON, so the fused facade's baked-in pk offset just moved
+            self._bfused = self._make_fused()
+            self._spawn_warmup(kem=False, sig=False)
         for peer_id, secret in self.raw_secrets.items():
             self.shared_keys[peer_id] = derive_message_key(
                 secret, self.node_id, peer_id, name
@@ -807,6 +1060,7 @@ class SecureMessaging:
                                            fallback=self._cpu_fallback_sig(),
                                            breaker=self._queue_breaker,
                                            bucket_floor=self._batch_floor)
+            self._bfused = self._make_fused()
             self._spawn_warmup(kem=False, sig=True)
         self._sig_keypair = self._load_or_generate_sig_keypair()
         self._log("crypto_settings_changed", component="signature", algorithm=name)

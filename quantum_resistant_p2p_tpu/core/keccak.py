@@ -305,6 +305,84 @@ def sponge(data: jax.Array, rate: int, ds_byte: int, out_len: int) -> jax.Array:
     return out[..., :out_len]
 
 
+def sponge_varlen(data: jax.Array, lengths: jax.Array, rate: int, ds_byte: int,
+                  out_len: int) -> jax.Array:
+    """Keccak sponge over per-lane VARIABLE-length messages.
+
+    The fixed-shape :func:`sponge` bakes the message length into the traced
+    program, which is right for every crypto-internal hash (their lengths
+    are parameters of the algorithm).  The fused handshake programs
+    (``fused_ops``) sign protocol transcripts whose JSON tail — peer ids,
+    timestamp repr — differs per lane, so the absorb must take the true
+    byte length as a traced operand:
+
+    * ``data`` is a (..., LMAX) uint8 buffer; bytes at index >= ``lengths``
+      are ignored (masked to zero before padding, so callers may leave
+      garbage there).
+    * the domain byte lands at index ``lengths`` and 0x80 at the end of the
+      block containing it, both via one-hot selects;
+    * the absorb scans over the maximal block count, applying the
+      permutation result only to lanes whose message reaches that block —
+      lanes with shorter messages carry their final state through unchanged.
+
+    Output matches ``hashlib`` byte-for-byte for every length <= LMAX
+    (tests/test_keccak.py sweeps the block boundaries).
+    """
+    data = jnp.asarray(data, jnp.uint8)
+    batch = data.shape[:-1]
+    lmax = data.shape[-1]
+    mlen = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), batch)
+    nblocks = lmax // rate + 1  # always room for the ds byte when mlen == lmax
+    padded_len = nblocks * rate
+    idx = jnp.arange(padded_len, dtype=jnp.int32)
+    buf = jnp.zeros(batch + (padded_len,), dtype=jnp.uint8)
+    buf = lax.dynamic_update_slice_in_dim(buf, data, 0, axis=-1) if lmax else buf
+    ml = mlen[..., None]
+    buf = jnp.where(idx < ml, buf, jnp.uint8(0))
+    buf = buf ^ jnp.where(idx == ml, jnp.uint8(ds_byte), jnp.uint8(0))
+    last_block = mlen // rate  # block index holding the ds byte
+    fin = (last_block[..., None] + 1) * rate - 1
+    # ds and 0x80 share a byte only when mlen % rate == rate-1; their bits
+    # are disjoint so xor == the spec's or
+    buf = buf ^ jnp.where(idx == fin, jnp.uint8(0x80), jnp.uint8(0))
+
+    nwords = rate // 8
+    hi = jnp.zeros(batch + (25,), dtype=jnp.uint32)
+    lo = jnp.zeros(batch + (25,), dtype=jnp.uint32)
+    blocks = jnp.moveaxis(buf.reshape(batch + (nblocks, rate)), -2, 0)
+
+    def absorb(state, xs):
+        hi, lo = state
+        blk, i = xs
+        bh, bl = _bytes_to_words(blk)
+        nh = hi.at[..., :nwords].set(hi[..., :nwords] ^ bh)
+        nl = lo.at[..., :nwords].set(lo[..., :nwords] ^ bl)
+        nh, nl = keccak_f1600(nh, nl)
+        take = (i <= last_block)[..., None]
+        return (jnp.where(take, nh, hi), jnp.where(take, nl, lo)), None
+
+    (hi, lo), _ = lax.scan(
+        absorb, (hi, lo), (blocks, jnp.arange(nblocks, dtype=jnp.int32))
+    )
+
+    out_nblocks = -(-out_len // rate)
+    out_blocks = []
+    for b in range(out_nblocks):
+        out_blocks.append(_words_to_bytes(hi[..., :nwords], lo[..., :nwords]))
+        if b + 1 < out_nblocks:
+            hi, lo = keccak_f1600(hi, lo)
+    out = (
+        jnp.concatenate(out_blocks, axis=-1) if len(out_blocks) > 1 else out_blocks[0]
+    )
+    return out[..., :out_len]
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def shake256_varlen(data: jax.Array, lengths: jax.Array, out_len: int) -> jax.Array:
+    """(..., LMAX) uint8 + (...,) int32 true lengths -> (..., out_len) uint8."""
+    return sponge_varlen(data, lengths, 136, 0x1F, out_len)
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def shake128(data: jax.Array, out_len: int) -> jax.Array:
     return sponge(data, 168, 0x1F, out_len)

@@ -1,0 +1,23 @@
+"""Fused multi-op handshake device programs (dispatch fusion).
+
+One handshake on the batched TPU path used to cost ~9-11 serial device
+round trips (r4 SLO decomposition): every protocol step dispatched its KEM
+op and its transcript signature/verification separately, each paying the
+full per-dispatch round trip while batch-1 device compute is single-digit
+milliseconds.  The programs in this package run what the protocol executes
+back-to-back as ONE jitted program — ML-KEM keygen/encaps/decaps, the
+transcript hash (device-side, variable-length: core.keccak.sponge_varlen)
+and the ML-DSA sign/verify — cutting the handshake to <= 4 trips without
+changing a byte on the wire.
+
+Exposed to the stack through the optional ``FusedHandshakeOps`` capability
+(provider/base.py, provider/fused_providers.py, registry ``get_fused``).
+"""
+
+from .mlkem_mldsa import (  # noqa: F401
+    encode_hex,
+    get_decaps_verify_sign,
+    get_encaps_verify_sign,
+    get_keygen_sign,
+    transcript_mu,
+)

@@ -1,0 +1,142 @@
+"""ML-KEM x ML-DSA fused handshake programs — three dispatches become one.
+
+Each program combines the device work one protocol step performs
+back-to-back today (kem op, transcript hash, signature op) into a single
+jitted XLA program, so a handshake step pays ONE dispatch round trip
+instead of two or three.  The tricky part is that two of the transcripts
+embed a device output (the hex of the fresh public key / ciphertext), so
+the host cannot pre-hash them: the host passes the canonical-JSON
+transcript as a *template* with a zeroed gap at a static offset, the
+device hex-encodes its output into the gap (static-shape concatenation,
+no gathers) and hashes the assembled message with the variable-length
+sponge (``core.keccak.sponge_varlen`` — the JSON tail length differs per
+lane: peer ids, timestamp reprs).
+
+Wire compatibility: the rendered message is byte-identical to what the
+separate-op path signs (``bytes.hex()`` is lowercase; the template is the
+canonical JSON with a same-length placeholder), so peers cannot tell fused
+and unfused stacks apart — tests/test_fused.py proves cross-path interop
+and bit-exactness against the separate-op programs under injected seeds.
+
+Program inventory (initiator/responder roles per app/messaging.py):
+
+* ``keygen_sign``         — ke_init:     ML-KEM keygen + sign(init transcript)
+* ``encaps_verify_sign``  — ke_init -> ke_response: verify(init) + encaps +
+                            sign(response transcript)
+* ``decaps_verify_sign``  — ke_response -> ke_confirm: verify(response) +
+                            decaps + sign(confirm transcript; the confirm
+                            transcript embeds no device output, so its mu
+                            is hashed host-side and passed in)
+
+The remaining step (verify of ke_confirm) is a plain single-op dispatch:
+4 trips per handshake total.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core import keccak
+from ..kem import mlkem
+from ..sig import mldsa
+from ..pyref.mlkem_ref import PARAMS as _KEM_PARAMS
+from ..pyref.mldsa_ref import PARAMS as _SIG_PARAMS
+
+
+def encode_hex(x: jax.Array) -> jax.Array:
+    """(..., L) uint8 -> (..., 2L) uint8 lowercase ASCII hex.
+
+    Device-side ``bytes.hex()``: pure arithmetic on the nibbles (digit or
+    letter via one compare), no lookup tables, so it fuses into the
+    surrounding program instead of forcing a host round trip.
+    """
+    x = jnp.asarray(x, jnp.uint8)
+    nib = jnp.stack([x >> 4, x & 0xF], axis=-1).astype(jnp.int32)
+    ch = nib + 48 + jnp.where(nib > 9, 39, 0)  # '0'..'9' then 'a'..'f'
+    return ch.astype(jnp.uint8).reshape(x.shape[:-1] + (2 * x.shape[-1],))
+
+
+def transcript_mu(sig_sk: jax.Array, msg: jax.Array, msg_len: jax.Array) -> jax.Array:
+    """mu = SHAKE256(tr || M', 64) for the FIPS 204 pure mode, on device.
+
+    M' = 0x00 || len(ctx)=0x00 || msg (empty context — the framing
+    sig_providers._m_prime applies host-side); tr is sk[64:128].  ``msg``
+    is a (..., LMAX) template buffer whose true per-lane length is
+    ``msg_len`` (bytes past it are ignored by the varlen sponge).
+    """
+    tr = jnp.asarray(sig_sk, jnp.uint8)[..., 64:128]
+    frame = jnp.zeros(msg.shape[:-1] + (2,), jnp.uint8)
+    buf = jnp.concatenate([tr, frame, jnp.asarray(msg, jnp.uint8)], axis=-1)
+    return keccak.sponge_varlen(buf, 66 + jnp.asarray(msg_len, jnp.int32),
+                                136, 0x1F, 64)
+
+
+def _insert_hex(tmpl: jax.Array, payload: jax.Array, off: int) -> jax.Array:
+    """Hex-encode ``payload`` into the zeroed gap at static offset ``off``."""
+    tmpl = jnp.asarray(tmpl, jnp.uint8)
+    hexp = encode_hex(payload)
+    return jnp.concatenate(
+        [tmpl[..., :off], hexp, tmpl[..., off + hexp.shape[-1]:]], axis=-1
+    )
+
+
+@functools.cache
+def get_keygen_sign(kem_name: str, sig_name: str, pk_off: int):
+    """Jitted ke_init program: (d, z, sig_sk, rnd, tmpl, msg_len) ->
+    (ek, dk, sigma, done).  ``tmpl`` is the canonical init transcript with
+    a 2*ek_len zeroed gap at static byte offset ``pk_off``."""
+    kp, sp = _KEM_PARAMS[kem_name], _SIG_PARAMS[sig_name]
+
+    def run(d, z, sig_sk, rnd, tmpl, msg_len):
+        ek, dk = mlkem.keygen(kp, d, z)
+        msg = _insert_hex(tmpl, ek, pk_off)
+        mu = transcript_mu(sig_sk, msg, msg_len)
+        sigma, done = mldsa.sign_mu(sp, sig_sk, mu, rnd)
+        return ek, dk, sigma, done
+
+    return jax.jit(run)
+
+
+@functools.cache
+def get_encaps_verify_sign(kem_name: str, sig_name: str, ct_off: int):
+    """Jitted ke_init->ke_response program:
+    (ek, m, peer_pk, mu_in, sig_in, sig_sk, rnd, tmpl, msg_len) ->
+    (ok, ct, shared_key, sigma, done).
+
+    The encaps + response signature run unconditionally (speculative: a
+    failed verify costs one wasted batch-1 compute, and lax.cond would
+    serialise the whole batch on the slowest lane anyway); the caller
+    discards everything when ``ok`` is False.
+    """
+    kp, sp = _KEM_PARAMS[kem_name], _SIG_PARAMS[sig_name]
+
+    def run(ek, m, peer_pk, mu_in, sig_in, sig_sk, rnd, tmpl, msg_len):
+        ok = mldsa.verify_mu(sp, peer_pk, mu_in, sig_in)
+        key, ct = mlkem.encaps(kp, ek, m)
+        msg = _insert_hex(tmpl, ct, ct_off)
+        mu = transcript_mu(sig_sk, msg, msg_len)
+        sigma, done = mldsa.sign_mu(sp, sig_sk, mu, rnd)
+        return ok, ct, key, sigma, done
+
+    return jax.jit(run)
+
+
+@functools.cache
+def get_decaps_verify_sign(kem_name: str, sig_name: str):
+    """Jitted ke_response->ke_confirm program:
+    (dk, ct, peer_pk, mu_in, sig_in, sig_sk, mu_out, rnd) ->
+    (ok, shared_secret, sigma, done).  The confirm transcript contains no
+    device output, so its mu is hashed host-side and passed as ``mu_out``.
+    """
+    kp, sp = _KEM_PARAMS[kem_name], _SIG_PARAMS[sig_name]
+
+    def run(dk, ct, peer_pk, mu_in, sig_in, sig_sk, mu_out, rnd):
+        ok = mldsa.verify_mu(sp, peer_pk, mu_in, sig_in)
+        ss = mlkem.decaps(kp, dk, ct)
+        sigma, done = mldsa.sign_mu(sp, sig_sk, mu_out, rnd)
+        return ok, ss, sigma, done
+
+    return jax.jit(run)

@@ -14,14 +14,17 @@ crypto/symmetric.py:19-63) and adds what the reference could not have:
 
 from .base import (
     CryptoAlgorithm,
+    FusedHandshakeOps,
     KeyExchangeAlgorithm,
     SignatureAlgorithm,
     SymmetricAlgorithm,
 )
 from .registry import (
+    get_fused,
     get_kem,
     get_signature,
     get_symmetric,
+    list_fused,
     list_kems,
     list_signatures,
     list_symmetrics,
@@ -29,12 +32,15 @@ from .registry import (
 
 __all__ = [
     "CryptoAlgorithm",
+    "FusedHandshakeOps",
     "KeyExchangeAlgorithm",
     "SignatureAlgorithm",
     "SymmetricAlgorithm",
+    "get_fused",
     "get_kem",
     "get_signature",
     "get_symmetric",
+    "list_fused",
     "list_kems",
     "list_signatures",
     "list_symmetrics",

@@ -105,9 +105,18 @@ def sliced_dispatch(fn, step: int, *arrays, mesh=None):
     full slice (last row repeated) so every dispatch hits an already-compiled
     shape, then trimmed.
 
+    Slices are DOUBLE-BUFFERED: slice N+1 is dispatched before slice N's
+    host readback, so the next slice's upload + compute overlaps the
+    previous readback instead of serialising behind it (jax dispatch is
+    async; ``np.asarray`` is the sync point).  Holding exactly one
+    in-flight slice bounds device memory to two slices' outputs, where an
+    eager dispatch-all would pin every slice of an arbitrarily large queue
+    flush.
+
     With a ``mesh``, each slice is sharded across the mesh's devices via
     ``mesh_dispatch`` and ``step`` is the PER-DEVICE cap, so one dispatch
-    covers ``step * mesh.size`` rows.
+    covers ``step * mesh.size`` rows.  (mesh_dispatch gathers to numpy
+    internally, so mesh slices do not pipeline.)
     """
     n = arrays[0].shape[0]
     if mesh is not None:
@@ -129,13 +138,24 @@ def sliced_dispatch(fn, step: int, *arrays, mesh=None):
     def slice_of(a, i):
         return pad_rows(a[i : i + cap], cap)
 
-    parts = [one(*(slice_of(a, i) for a in arrays)) for i in range(0, n, cap)]
+    def read_back(p):
+        return (
+            tuple(np.asarray(o) for o in p) if isinstance(p, tuple) else np.asarray(p)
+        )
+
+    parts = []
+    in_flight = None
+    for i in range(0, n, cap):
+        nxt = one(*(slice_of(a, i) for a in arrays))  # dispatch slice i ...
+        if in_flight is not None:
+            parts.append(read_back(in_flight))  # ... before reading slice i-1
+        in_flight = nxt
+    parts.append(read_back(in_flight))
     if isinstance(parts[0], tuple):
         return tuple(
-            np.concatenate([np.asarray(p[j]) for p in parts])[:n]
-            for j in range(len(parts[0]))
+            np.concatenate([p[j] for p in parts])[:n] for j in range(len(parts[0]))
         )
-    return np.concatenate([np.asarray(p) for p in parts])[:n]
+    return np.concatenate(parts)[:n]
 
 
 def make_provider_mesh(devices: int, backend: str):
@@ -262,6 +282,65 @@ class SignatureAlgorithm(CryptoAlgorithm):
         return np.array(
             [self.verify(bytes(pk), m, s) for pk, m, s in zip(public_keys, messages, signatures)]
         )
+
+
+class FusedHandshakeOps(abc.ABC):
+    """Optional capability: composite device programs for a (KEM, signature)
+    provider pair, fusing what one handshake step executes back-to-back
+    (kem op + transcript hash + signature op) into a single dispatch.
+
+    Discovered through ``provider.registry.get_fused(kem, sig)`` — ``None``
+    (capability absent: unregistered pair, or either provider not on the
+    tpu backend) means callers stay on the per-op path; the wire protocol
+    is identical either way.  ``templates`` are canonical transcript bytes
+    with a zeroed gap at the given static offset where the device
+    hex-encodes its own output (fresh public key / ciphertext) before
+    hashing; ``msgs_in``/``msgs_out`` are fully host-known transcripts.
+
+    Signature ops follow the provider conventions: sign raises when a lane
+    exhausts its rejection budget, verify maps any failure to False.
+    """
+
+    kem: KeyExchangeAlgorithm
+    sig: SignatureAlgorithm
+    name: str = ""
+    backend: str = "tpu"
+    #: per-kind template capacity (static compiled buffer widths); callers
+    #: fall back to the per-op path for transcripts that exceed them
+    init_template_len: int = 0
+    resp_template_len: int = 0
+
+    @abc.abstractmethod
+    def keygen_sign_batch(self, sig_sks: np.ndarray, templates: list[bytes],
+                          pk_off: int, rnd=None):
+        """-> (public_keys (n, pk_len), secret_keys (n, sk_len),
+        sigs list[bytes]) — KEM keygen + sign(template with hex(pk) at
+        ``pk_off``)."""
+
+    @abc.abstractmethod
+    def encaps_verify_sign_batch(self, public_keys: np.ndarray,
+                                 peer_sig_pks: np.ndarray,
+                                 msgs_in: list[bytes], sigs_in: list[bytes],
+                                 sig_sks: np.ndarray, templates: list[bytes],
+                                 ct_off: int, m=None, rnd=None):
+        """-> (oks (n,) bool, cts, shared_secrets, sigs list[bytes]) —
+        verify(msgs_in) + KEM encaps + sign(template with hex(ct) at
+        ``ct_off``)."""
+
+    @abc.abstractmethod
+    def decaps_verify_sign_batch(self, secret_keys: np.ndarray,
+                                 ciphertexts: np.ndarray,
+                                 peer_sig_pks: np.ndarray,
+                                 msgs_in: list[bytes], sigs_in: list[bytes],
+                                 sig_sks: np.ndarray, msgs_out: list[bytes],
+                                 rnd=None):
+        """-> (oks (n,) bool, shared_secrets, sigs list[bytes]) —
+        verify(msgs_in) + KEM decaps + sign(msgs_out)."""
+
+    def warmup(self, sizes: tuple[int, ...] = (1,), pk_off: int | None = None,
+               ct_off: int | None = None) -> None:
+        """Pre-compile the composite programs (blocking; run off-loop).
+        Offsets must match the live transcripts' — jit keys on them."""
 
 
 class SymmetricAlgorithm(CryptoAlgorithm):

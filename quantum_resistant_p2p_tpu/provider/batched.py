@@ -48,6 +48,11 @@ class QueueStats:
     fallback_ops: int = 0
     fallback_flushes: int = 0
     breaker_trips: int = 0
+    #: serial device-dispatch round trips this queue has made (one batch_fn
+    #: call through the device or warmup executor = one trip; the handshake
+    #: SLO is dispatch-trip-bound on a tunnel, so trips are counted, not
+    #: inferred — see docs/dispatch_budget.md)
+    device_trips: int = 0
     #: per-flush batch sizes, most recent last (bounded)
     batch_sizes: list[int] = field(default_factory=list)
     #: per-flush dispatch latency percentiles (utils.profiling)
@@ -69,6 +74,7 @@ class QueueStats:
             "fallback_ops": self.fallback_ops,
             "fallback_flushes": self.fallback_flushes,
             "breaker_trips": self.breaker_trips,
+            "device_trips": self.device_trips,
         }
 
 
@@ -92,9 +98,23 @@ class Breaker:
     def __init__(self, cooloff_s: float = 30.0):
         self.cooloff_s = cooloff_s
         self.trips = 0
+        #: serial device-dispatch round trips aggregated across every queue
+        #: sharing this breaker (KEM + signature + composite): the number
+        #: SecureMessaging diffs around a handshake to measure
+        #: trips-per-handshake (docs/dispatch_budget.md)
+        self.device_trips = 0
+        #: fallback flushes aggregated the same way (a fallback flush is a
+        #: serial step too — just a cpu one)
+        self.fallback_trips = 0
         self._open_until = 0.0
         self._executor = None
         self._warmup_executor = None
+        #: queues sharing this breaker, for cross-queue coalesced flushes
+        #: (weak: a hot-swapped facade's dead queues must not linger)
+        import weakref
+
+        self._queues: weakref.WeakSet = weakref.WeakSet()
+        self._coalescing = False
 
     def is_open(self) -> bool:
         return time.monotonic() < self._open_until
@@ -102,6 +122,31 @@ class Breaker:
     def trip(self) -> None:
         self.trips += 1
         self._open_until = time.monotonic() + self.cooloff_s
+
+    def register_queue(self, queue: "OpQueue") -> None:
+        self._queues.add(queue)
+
+    def coalesce(self, origin: "OpQueue") -> None:
+        """Flush every sibling queue with pending items in the SAME
+        scheduling window as ``origin``'s flush.
+
+        The queues share one device, but their dispatches run on the
+        2-thread device executor — flushing siblings now (instead of
+        letting each ride out its own max_wait timer) puts independent KEM
+        and SIG batches in flight TOGETHER, so a handshake step's unrelated
+        ops overlap instead of serialising one timer window apart.  Only
+        queues that already hold items are touched: nothing flushes
+        emptier/earlier than it would have on its own timer.
+        """
+        if self._coalescing:
+            return
+        self._coalescing = True
+        try:
+            for q in list(self._queues):
+                if q is not origin and q._items:
+                    q._flush_local()
+        finally:
+            self._coalescing = False
 
     @property
     def device_executor(self):
@@ -186,6 +231,7 @@ class OpQueue:
         #: _run_batch); generous — first compiles take minutes on a tunnel
         self.warmup_watchdog_s = 600.0
         self.breaker = breaker if breaker is not None else Breaker()
+        self.breaker.register_queue(self)
         #: pow2 sizes whose device program has completed at least once; a
         #: cold bucket's ops are served by the fallback while the compile
         #: runs in the background (never hostage to a compile)
@@ -214,6 +260,13 @@ class OpQueue:
         return await fut
 
     def _flush_soon(self) -> None:
+        """Flush this queue, then coalesce sibling queues sharing the breaker
+        into the same scheduling window (Breaker.coalesce) so independent
+        KEM/SIG batches go in flight together."""
+        self._flush_local()
+        self.breaker.coalesce(self)
+
+    def _flush_local(self) -> None:
         """Detach pending items synchronously (so late submits can't bloat a
         batch past max_batch) and dispatch them as a task."""
         if self._timer is not None:
@@ -249,13 +302,21 @@ class OpQueue:
     async def _run_fallback(self, items: list[Any]) -> list[Any]:
         self.stats.fallback_flushes += 1
         self.stats.fallback_ops += len(items)
+        self.breaker.fallback_trips += 1
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(None, self.fallback_fn, items)
+
+    def _count_trip(self) -> None:
+        """One serial device round trip (device or warmup executor): the
+        per-handshake SLO currency (docs/dispatch_budget.md)."""
+        self.stats.device_trips += 1
+        self.breaker.device_trips += 1
 
     async def _run_batch(self, items: list[Any]) -> list[Any]:
         """Device path with watchdog + breaker; falls back to cpu when slow."""
         loop = asyncio.get_running_loop()
         if self.fallback_fn is None:
+            self._count_trip()
             return await loop.run_in_executor(None, self.batch_fn, items)
         if self.breaker.is_open():
             return await self._run_fallback(items)
@@ -269,6 +330,7 @@ class OpQueue:
             # pool serialises compiles; the device takes over once warm).
             if bucket not in self._warming:
                 self._warming.add(bucket)
+                self._count_trip()
                 warm = loop.run_in_executor(self.breaker.warmup_executor,
                                             self.batch_fn, items)
 
@@ -302,6 +364,7 @@ class OpQueue:
                 loop.call_later(self.warmup_watchdog_s, _unstick)
             return await self._run_fallback(items)
         t0 = time.perf_counter()
+        self._count_trip()
         # Dedicated 2-thread device pool: an abandoned hung dispatch can never
         # starve the default executor that the cpu fallback runs on.
         device = loop.run_in_executor(self.breaker.device_executor,
@@ -467,13 +530,25 @@ class BatchedKEM:
     def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:
         """Compile the pow2 buckets a live queue will hit (blocking; run in a
         background thread).  Cold jit of the first handshake's size-1 bucket
-        otherwise races the protocol timeout (SURVEY.md §7.4 item 6)."""
+        otherwise races the protocol timeout (SURVEY.md §7.4 item 6).
+
+        Single-key encaps batches (every handshake; swarm hot peers) take
+        the operand-cache fast path — different jit programs on miss
+        (``_enc_cold``) and hit (``_enc_pre``) — so each size additionally
+        runs a same-key pair of encaps calls to compile both."""
         for n in sizes:
             # compile the shape the live bucket will use
             n2 = max(self.bucket_floor, _next_pow2(n))
             pks, sks = self.algo.generate_keypair_batch(n2)
+            # distinct keys: at n2 > 1 this compiles the mixed-key sliced
+            # program; at n2 == 1 a single row takes the same opcache path
+            # live batch-1 encaps always takes, so nothing is missed
             cts, _ = self.algo.encapsulate_batch(pks)
             self.algo.decapsulate_batch(sks, cts)
+            if getattr(self.algo, "opcache", None) is not None:
+                same = np.repeat(np.asarray(pks)[:1], n2, axis=0)
+                self.algo.encapsulate_batch(same)  # cache miss: _enc_cold
+                self.algo.encapsulate_batch(same)  # cache hit:  _enc_pre
             for q in (self._kg, self._enc, self._dec):
                 q._warm_buckets.add(n2)
 
@@ -560,15 +635,36 @@ class BatchedSignature:
         )
 
     def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:
-        """Compile keygen/sign/verify for the pow2 buckets (blocking)."""
-        pk, sk = self.algo.generate_keypair()
+        """Compile keygen/sign/verify for the pow2 buckets (blocking).
+
+        Single-key batches (a node's own long-lived sign key; a repeat
+        peer's verify key) take the operand-cache fast path, which runs
+        DIFFERENT jit programs on miss (cache-filling ``*_cold``) and hit
+        (``*_pre``) — so each size runs twice with a key fresh to the
+        cache: the first call compiles the cold program, the second the
+        hit program.  Otherwise a "warm" bucket's first cache hit cold-jits
+        inside a live device dispatch and trips the breaker."""
+        have_cache = getattr(self.algo, "opcache", None) is not None
         for n in sizes:
+            # fresh key per size: the opcache persists across sizes, and a
+            # cached key would skip the cold-program compile for this shape
+            pk, sk = self.algo.generate_keypair()
             # compile the shape the live bucket will use
             n2 = max(self.bucket_floor, _next_pow2(n))
             sks = np.stack([np.frombuffer(sk, np.uint8)] * n2)
             pks = np.stack([np.frombuffer(pk, np.uint8)] * n2)
-            sigs = self.algo.sign_batch(sks, [b"warmup"] * n2)
-            self.algo.verify_batch(pks, [b"warmup"] * n2, sigs)
+            reps = 2 if have_cache else 1
+            for _ in range(reps):
+                sigs = self.algo.sign_batch(sks, [b"warmup"] * n2)
+            for _ in range(reps):
+                self.algo.verify_batch(pks, [b"warmup"] * n2, sigs)
+            if have_cache and n2 > 1:
+                # distinct keys: compile the MIXED-key programs that the
+                # same-key stacks above divert away from (live flushes
+                # coalescing >= 2 clients' ops carry distinct keys)
+                pks_d, sks_d = self.algo.generate_keypair_batch(n2)
+                sigs_d = self.algo.sign_batch(sks_d, [b"warmup"] * n2)
+                self.algo.verify_batch(pks_d, [b"warmup"] * n2, sigs_d)
             for q in (self._sign, self._verify):
                 q._warm_buckets.add(n2)
 
@@ -582,4 +678,258 @@ class BatchedSignature:
         return {
             "sign": self._sign.stats.as_dict(),
             "verify": self._verify.stats.as_dict(),
+        }
+
+
+class BatchedFused:
+    """Async facade over a ``FusedHandshakeOps`` capability: three composite
+    queues (keygen+sign / verify+encaps+sign / verify+decaps+sign) that
+    collapse a handshake step's 2-3 serial device trips into one dispatch.
+
+    Shares the per-op facades' breaker, so composite and per-op batches
+    coalesce into one scheduling window (Breaker.coalesce) and a slow
+    tunnel discovered by either shields both.
+
+    ``pk_off``/``ct_off`` are the static byte offsets of the hex-encoded
+    device output inside the init/response transcript templates — protocol
+    facts the caller (SecureMessaging) computes from its canonical-JSON
+    layout; jit keys on them, so one facade serves one protocol layout.
+
+    Fallback (armed when BOTH cpu twins are given): the same step composed
+    from per-op cpu calls — verify, kem op, host-side hex render into the
+    template, sign — producing wire-identical bytes, so a tripped breaker
+    degrades to cpu per-op work instead of failing handshakes.  A missing
+    capability never reaches this class: registry.get_fused returns None
+    and SecureMessaging stays on the per-op queues entirely.
+
+    Attacker-controlled fields (peer signature key, incoming signature) are
+    length-checked per item and fail as ``ok=False`` — matching the verify
+    contract — while malformed LOCAL operands (own secret key, template)
+    raise, matching the per-op queues.
+    """
+
+    def __init__(self, fused, pk_off: int, ct_off: int, max_batch: int = 4096,
+                 max_wait_ms: float = 2.0, fallback_kem=None, fallback_sig=None,
+                 breaker: Breaker | None = None, cooloff_s: float | None = None,
+                 bucket_floor: int = 1, **degrade_opts):
+        self.fused = fused
+        self.name = fused.name
+        self.pk_off = pk_off
+        self.ct_off = ct_off
+        self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
+        self.breaker = _facade_breaker(breaker, cooloff_s)
+        self.fallback_kem = fallback_kem
+        self.fallback_sig = fallback_sig
+        have_fb = fallback_kem is not None and fallback_sig is not None
+        self._kg, self._enc, self._dec = (
+            OpQueue(batch_fn, max_batch, max_wait_ms,
+                    fallback_fn=(fb if have_fb else None),
+                    breaker=self.breaker, bucket_floor=self.bucket_floor,
+                    **degrade_opts)
+            for batch_fn, fb in (
+                (self._kg_batch, self._kg_fallback),
+                (self._enc_batch, self._enc_fallback),
+                (self._dec_batch, self._dec_fallback),
+            )
+        )
+
+    # -- validity (shared by device + fallback paths) -----------------------
+
+    def _kg_valid(self, it) -> bool:
+        sk, tmpl = it
+        return (
+            len(sk) == self.fused.sig.secret_key_len
+            and self.pk_off + 2 * self.fused.kem.public_key_len <= len(tmpl)
+            <= self.fused.init_template_len
+        )
+
+    def _enc_valid(self, it) -> bool:
+        peer_pk, peer_sig_pk, _msg_in, sig_in, sk, tmpl = it
+        return (
+            len(peer_pk) == self.fused.kem.public_key_len
+            and len(peer_sig_pk) == self.fused.sig.public_key_len
+            and len(sig_in) == self.fused.sig.signature_len
+            and len(sk) == self.fused.sig.secret_key_len
+            and self.ct_off + 2 * self.fused.kem.ciphertext_len <= len(tmpl)
+            <= self.fused.resp_template_len
+        )
+
+    def _dec_valid(self, it) -> bool:
+        kem_sk, ct, peer_sig_pk, _msg_in, sig_in, sk, _msg_out = it
+        return (
+            len(kem_sk) == self.fused.kem.secret_key_len
+            and len(ct) == self.fused.kem.ciphertext_len
+            and len(peer_sig_pk) == self.fused.sig.public_key_len
+            and len(sig_in) == self.fused.sig.signature_len
+            and len(sk) == self.fused.sig.secret_key_len
+        )
+
+    @staticmethod
+    def _render(tmpl: bytes, payload: bytes, off: int) -> bytes:
+        """Host-side twin of the device hex-insert (fused.mlkem_mldsa)."""
+        return tmpl[:off] + payload.hex().encode() + tmpl[off + 2 * len(payload):]
+
+    # -- device batch fns ---------------------------------------------------
+
+    def _kg_batch(self, items):
+        def dispatch(valid, tgt):
+            sks = _pad_rows(
+                np.stack([np.frombuffer(sk, np.uint8) for sk, _ in valid]), tgt
+            )
+            tmpls = [t for _, t in valid] + [valid[-1][1]] * (tgt - len(valid))
+            pks, ksks, sigs = self.fused.keygen_sign_batch(sks, tmpls, self.pk_off)
+            return list(zip((bytes(p) for p in pks), (bytes(k) for k in ksks), sigs))
+
+        return _run_valid(
+            items, self._kg_valid, dispatch,
+            lambda: ValueError("bad secret-key/template length"),
+            self.bucket_floor,
+        )
+
+    def _enc_batch(self, items):
+        def dispatch(valid, tgt):
+            pad = tgt - len(valid)
+            pks = _pad_rows(
+                np.stack([np.frombuffer(it[0], np.uint8) for it in valid]), tgt
+            )
+            spks = _pad_rows(
+                np.stack([np.frombuffer(it[1], np.uint8) for it in valid]), tgt
+            )
+            msgs = [it[2] for it in valid] + [valid[-1][2]] * pad
+            sigs_in = [it[3] for it in valid] + [valid[-1][3]] * pad
+            sks = _pad_rows(
+                np.stack([np.frombuffer(it[4], np.uint8) for it in valid]), tgt
+            )
+            tmpls = [it[5] for it in valid] + [valid[-1][5]] * pad
+            oks, cts, sss, sigs = self.fused.encaps_verify_sign_batch(
+                pks, spks, msgs, sigs_in, sks, tmpls, self.ct_off
+            )
+            return [
+                (bool(ok), bytes(ct), bytes(ss), sig)
+                for ok, ct, ss, sig in zip(oks, cts, sss, sigs)
+            ]
+
+        return _run_valid(
+            items, self._enc_valid, dispatch,
+            lambda: (False, b"", b"", b""),  # verify contract: malformed -> False
+            self.bucket_floor,
+        )
+
+    def _dec_batch(self, items):
+        def dispatch(valid, tgt):
+            pad = tgt - len(valid)
+            ksks = _pad_rows(
+                np.stack([np.frombuffer(it[0], np.uint8) for it in valid]), tgt
+            )
+            cts = _pad_rows(
+                np.stack([np.frombuffer(it[1], np.uint8) for it in valid]), tgt
+            )
+            spks = _pad_rows(
+                np.stack([np.frombuffer(it[2], np.uint8) for it in valid]), tgt
+            )
+            msgs = [it[3] for it in valid] + [valid[-1][3]] * pad
+            sigs_in = [it[4] for it in valid] + [valid[-1][4]] * pad
+            sks = _pad_rows(
+                np.stack([np.frombuffer(it[5], np.uint8) for it in valid]), tgt
+            )
+            msgs_out = [it[6] for it in valid] + [valid[-1][6]] * pad
+            oks, sss, sigs = self.fused.decaps_verify_sign_batch(
+                ksks, cts, spks, msgs, sigs_in, sks, msgs_out
+            )
+            return [
+                (bool(ok), bytes(ss), sig) for ok, ss, sig in zip(oks, sss, sigs)
+            ]
+
+        return _run_valid(
+            items, self._dec_valid, dispatch,
+            lambda: (False, b"", b""),
+            self.bucket_floor,
+        )
+
+    # -- cpu per-op fallbacks (wire-identical composition) ------------------
+
+    def _kg_fallback(self, items):
+        def dispatch(valid, _tgt):
+            out = []
+            for sk, tmpl in valid:
+                pk, ksk = self.fallback_kem.generate_keypair()
+                sig = self.fallback_sig.sign(sk, self._render(tmpl, pk, self.pk_off))
+                out.append((pk, ksk, sig))
+            return out
+
+        return _run_valid(
+            items, self._kg_valid, dispatch,
+            lambda: ValueError("bad secret-key/template length"), 1,
+        )
+
+    def _enc_fallback(self, items):
+        def dispatch(valid, _tgt):
+            out = []
+            for peer_pk, peer_sig_pk, msg_in, sig_in, sk, tmpl in valid:
+                if not self.fallback_sig.verify(peer_sig_pk, msg_in, sig_in):
+                    out.append((False, b"", b"", b""))
+                    continue
+                ct, ss = self.fallback_kem.encapsulate(peer_pk)
+                sig = self.fallback_sig.sign(sk, self._render(tmpl, ct, self.ct_off))
+                out.append((True, ct, ss, sig))
+            return out
+
+        return _run_valid(
+            items, self._enc_valid, dispatch, lambda: (False, b"", b"", b""), 1,
+        )
+
+    def _dec_fallback(self, items):
+        def dispatch(valid, _tgt):
+            out = []
+            for kem_sk, ct, peer_sig_pk, msg_in, sig_in, sk, msg_out in valid:
+                if not self.fallback_sig.verify(peer_sig_pk, msg_in, sig_in):
+                    out.append((False, b"", b""))
+                    continue
+                ss = self.fallback_kem.decapsulate(kem_sk, ct)
+                out.append((True, ss, self.fallback_sig.sign(sk, msg_out)))
+            return out
+
+        return _run_valid(
+            items, self._dec_valid, dispatch, lambda: (False, b"", b""), 1,
+        )
+
+    # -- async surface ------------------------------------------------------
+
+    async def keygen_sign(self, sig_sk: bytes, template: bytes):
+        """-> (kem_pk, kem_sk, sig) for the init step, one device trip."""
+        return await self._kg.submit((sig_sk, template))
+
+    async def encaps_verify_sign(self, peer_pk: bytes, peer_sig_pk: bytes,
+                                 msg_in: bytes, sig_in: bytes, sig_sk: bytes,
+                                 template: bytes):
+        """-> (ok, ct, shared_secret, sig) for the response step."""
+        return await self._enc.submit(
+            (peer_pk, peer_sig_pk, msg_in, sig_in, sig_sk, template)
+        )
+
+    async def decaps_verify_sign(self, kem_sk: bytes, ct: bytes,
+                                 peer_sig_pk: bytes, msg_in: bytes,
+                                 sig_in: bytes, sig_sk: bytes, msg_out: bytes):
+        """-> (ok, shared_secret, sig) for the confirm step."""
+        return await self._dec.submit(
+            (kem_sk, ct, peer_sig_pk, msg_in, sig_in, sig_sk, msg_out)
+        )
+
+    def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:
+        """Compile the composite programs at the LIVE offsets (jit keys on
+        them) for the given pow2 buckets and mark those buckets warm.
+        Sizes are raised to the facade's bucket floor FIRST — the fused
+        capability compiles exactly the shapes it is handed, and live
+        flushes pad to the floor, so compiling un-raised sizes would mark
+        buckets warm that were never compiled."""
+        buckets = sorted({max(self.bucket_floor, _next_pow2(n)) for n in sizes})
+        self.fused.warmup(tuple(buckets), pk_off=self.pk_off, ct_off=self.ct_off)
+        for q in (self._kg, self._enc, self._dec):
+            q._warm_buckets.update(buckets)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "keygen_sign": self._kg.stats.as_dict(),
+            "encaps_verify_sign": self._enc.stats.as_dict(),
+            "decaps_verify_sign": self._dec.stats.as_dict(),
         }

@@ -1,0 +1,220 @@
+"""Fused handshake capability providers (provider/base.py FusedHandshakeOps).
+
+``FusedMLKEMMLDSA`` wraps an existing (ML-KEM, ML-DSA) tpu-backend provider
+pair and exposes the three composite handshake programs from
+``fused.mlkem_mldsa`` at the numpy/bytes level the batching queue speaks.
+Host-side work mirrors the per-op providers: variable-length transcripts
+that are fully host-known are hashed to the fixed 64-byte mu with hashlib
+(public data, cheap); transcripts embedding a device output are shipped as
+templates and hashed on device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .base import FusedHandshakeOps, expect_cols, sliced_dispatch
+from .sig_providers import _m_prime, _mu
+
+#: static headroom past the hex payload for the JSON scaffolding (keys,
+#: uuid, peer ids, timestamp repr) — one compiled template shape covers
+#: every realistic transcript; longer ones fall back to the per-op path
+TEMPLATE_HEADROOM = 1024
+
+
+def init_pk_offset(kem_name: str, aead_name: str) -> int:
+    """Byte offset of the public-key hex inside the canonical init
+    transcript.  Canonical JSON sorts keys, and every key before
+    "public_key" has a fixed-length value (aead/kem names, the 36-char
+    uuid4 message_id), so the offset depends only on the algorithm names —
+    computed by probing an actual canonical dump rather than hand-counting.
+    """
+    probe = {
+        "aead": aead_name, "kem": kem_name, "message_id": "x" * 36,
+        "public_key": "", "recipient": "", "sender": "", "timestamp": 0,
+    }
+    s = json.dumps(probe, sort_keys=True, separators=(",", ":"))
+    return s.index('"public_key":"') + len('"public_key":"')
+
+
+def resp_ct_offset() -> int:
+    """Byte offset of the ciphertext hex inside the canonical response
+    transcript ("ciphertext" sorts first, so the offset is constant)."""
+    probe = {
+        "ciphertext": "", "message_id": "x" * 36,
+        "recipient": "", "sender": "", "timestamp": 0,
+    }
+    s = json.dumps(probe, sort_keys=True, separators=(",", ":"))
+    return s.index('"ciphertext":"') + len('"ciphertext":"')
+
+
+def _stack_templates(templates: list[bytes], lmax: int) -> tuple[np.ndarray, np.ndarray]:
+    """list of transcript bytes -> ((n, lmax) uint8 zero-padded, (n,) int32
+    true lengths).  Callers pre-check len <= lmax (see *_template_len)."""
+    t = np.zeros((len(templates), lmax), np.uint8)
+    lens = np.empty(len(templates), np.int32)
+    for i, b in enumerate(templates):
+        t[i, : len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(b)
+    return t, lens
+
+
+def _rand(n: int, width: int, given) -> np.ndarray:
+    if given is not None:
+        return np.stack([np.frombuffer(bytes(r), np.uint8) for r in given])
+    return np.frombuffer(os.urandom(width * n), np.uint8).reshape(n, width)
+
+
+class FusedMLKEMMLDSA(FusedHandshakeOps):
+    """Composite ML-KEM + ML-DSA handshake programs on the tpu backend."""
+
+    def __init__(self, kem, sig):
+        if getattr(kem, "backend", "") != "tpu" or getattr(sig, "backend", "") != "tpu":
+            raise ValueError(
+                f"fused ops need a tpu-backend pair, got {kem.backend}/{sig.backend}"
+            )
+        self.kem = kem
+        self.sig = sig
+        self.name = f"{kem.name}+{sig.name}"
+        self.backend = "tpu"
+        self.init_template_len = 2 * kem.public_key_len + TEMPLATE_HEADROOM
+        self.resp_template_len = 2 * kem.ciphertext_len + TEMPLATE_HEADROOM
+        from ..kem import mlkem as _jax_mlkem  # deferred: pulls in jax
+
+        self._max_dispatch = _jax_mlkem.MAX_DEVICE_BATCH
+
+    # -- jitted program access (cached per (names, offset)) -----------------
+
+    def _kg_sign(self, pk_off: int):
+        from ..fused import mlkem_mldsa as _fused
+
+        return _fused.get_keygen_sign(self.kem.name, self.sig.name, pk_off)
+
+    def _enc_vfy_sign(self, ct_off: int):
+        from ..fused import mlkem_mldsa as _fused
+
+        return _fused.get_encaps_verify_sign(self.kem.name, self.sig.name, ct_off)
+
+    def _dec_vfy_sign(self):
+        from ..fused import mlkem_mldsa as _fused
+
+        return _fused.get_decaps_verify_sign(self.kem.name, self.sig.name)
+
+    # -- host-side mu hashing (public transcripts) --------------------------
+
+    def _mus_from_peer_pks(self, peer_sig_pks: np.ndarray,
+                           msgs_in: list[bytes]) -> np.ndarray:
+        import hashlib
+
+        trs = [hashlib.shake_256(bytes(pk)).digest(64) for pk in peer_sig_pks]
+        return np.stack(
+            [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, msgs_in)]
+        )
+
+    def _mus_from_own_sks(self, sig_sks: np.ndarray,
+                          msgs_out: list[bytes]) -> np.ndarray:
+        trs = [bytes(sk[64:128]) for sk in sig_sks]
+        return np.stack(
+            [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, msgs_out)]
+        )
+
+    @staticmethod
+    def _check_done(done: np.ndarray, what: str) -> None:
+        if not np.asarray(done).all():
+            # mirrors MLDSASignature.sign_batch: an all-zero sigma must
+            # never leave the provider as if it were a signature
+            raise RuntimeError(
+                f"fused {what}: {int((~np.asarray(done)).sum())} lane(s) "
+                "exhausted the rejection-sampling budget"
+            )
+
+    # -- FusedHandshakeOps surface ------------------------------------------
+
+    def keygen_sign_batch(self, sig_sks: np.ndarray, templates: list[bytes],
+                          pk_off: int, rnd=None):
+        expect_cols(sig_sks, self.sig.secret_key_len, "secret keys", self.name)
+        n = len(templates)
+        d = np.frombuffer(os.urandom(32 * n), np.uint8).reshape(n, 32)
+        z = np.frombuffer(os.urandom(32 * n), np.uint8).reshape(n, 32)
+        rnds = _rand(n, 32, rnd)
+        tmpl, lens = _stack_templates(templates, self.init_template_len)
+        ek, dk, sigs, done = sliced_dispatch(
+            self._kg_sign(pk_off), self._max_dispatch,
+            d, z, np.asarray(sig_sks), rnds, tmpl, lens,
+        )
+        self._check_done(done, "keygen_sign")
+        return np.asarray(ek), np.asarray(dk), [bytes(s) for s in sigs]
+
+    def encaps_verify_sign_batch(self, public_keys: np.ndarray,
+                                 peer_sig_pks: np.ndarray,
+                                 msgs_in: list[bytes], sigs_in: list[bytes],
+                                 sig_sks: np.ndarray, templates: list[bytes],
+                                 ct_off: int, m=None, rnd=None):
+        expect_cols(public_keys, self.kem.public_key_len, "public keys", self.name)
+        expect_cols(sig_sks, self.sig.secret_key_len, "secret keys", self.name)
+        n = len(templates)
+        mus_in = self._mus_from_peer_pks(peer_sig_pks, msgs_in)
+        sig_arr = np.stack([np.frombuffer(bytes(s), np.uint8) for s in sigs_in])
+        ms = _rand(n, 32, m)
+        rnds = _rand(n, 32, rnd)
+        tmpl, lens = _stack_templates(templates, self.resp_template_len)
+        ok, ct, key, sigs, done = sliced_dispatch(
+            self._enc_vfy_sign(ct_off), self._max_dispatch,
+            np.asarray(public_keys), ms, np.asarray(peer_sig_pks), mus_in,
+            sig_arr, np.asarray(sig_sks), rnds, tmpl, lens,
+        )
+        self._check_done(done, "encaps_verify_sign")
+        return np.asarray(ok), np.asarray(ct), np.asarray(key), [bytes(s) for s in sigs]
+
+    def decaps_verify_sign_batch(self, secret_keys: np.ndarray,
+                                 ciphertexts: np.ndarray,
+                                 peer_sig_pks: np.ndarray,
+                                 msgs_in: list[bytes], sigs_in: list[bytes],
+                                 sig_sks: np.ndarray, msgs_out: list[bytes],
+                                 rnd=None):
+        expect_cols(secret_keys, self.kem.secret_key_len, "secret keys", self.name)
+        expect_cols(ciphertexts, self.kem.ciphertext_len, "ciphertexts", self.name)
+        n = len(msgs_out)
+        mus_in = self._mus_from_peer_pks(peer_sig_pks, msgs_in)
+        mus_out = self._mus_from_own_sks(sig_sks, msgs_out)
+        sig_arr = np.stack([np.frombuffer(bytes(s), np.uint8) for s in sigs_in])
+        rnds = _rand(n, 32, rnd)
+        ok, ss, sigs, done = sliced_dispatch(
+            self._dec_vfy_sign(), self._max_dispatch,
+            np.asarray(secret_keys), np.asarray(ciphertexts),
+            np.asarray(peer_sig_pks), mus_in, sig_arr, np.asarray(sig_sks),
+            mus_out, rnds,
+        )
+        self._check_done(done, "decaps_verify_sign")
+        return np.asarray(ok), np.asarray(ss), [bytes(s) for s in sigs]
+
+    def warmup(self, sizes: tuple[int, ...] = (1,), pk_off: int | None = None,
+               ct_off: int | None = None) -> None:
+        """Compile the composite programs for the given pow2 bucket sizes
+        (blocking; run off-loop).  Offsets default to the canonical
+        transcript layout; pass the live ones when they differ (jit keys
+        on them, so a mismatched warmup buys nothing)."""
+        from ..utils import next_pow2
+
+        if pk_off is None:
+            pk_off = init_pk_offset(self.kem.name, "AES-256-GCM")
+        if ct_off is None:
+            ct_off = resp_ct_offset()
+        spk, ssk = self.sig.generate_keypair()
+        for n in sizes:
+            n2 = next_pow2(n)
+            sks = np.stack([np.frombuffer(ssk, np.uint8)] * n2)
+            pks = np.stack([np.frombuffer(spk, np.uint8)] * n2)
+            init_t = [b"w" * (pk_off + 2 * self.kem.public_key_len + 64)] * n2
+            eks, dks, sigs = self.keygen_sign_batch(sks, init_t, pk_off)
+            resp_t = [b"w" * (ct_off + 2 * self.kem.ciphertext_len + 64)] * n2
+            _, cts, _, _ = self.encaps_verify_sign_batch(
+                eks, pks, [t for t in init_t], sigs, sks, resp_t, ct_off
+            )
+            self.decaps_verify_sign_batch(
+                dks, cts, pks, [t for t in resp_t], sigs, sks,
+                [b"w" * 128] * n2,
+            )

@@ -1,0 +1,93 @@
+"""Device-resident operand cache — stop re-uploading hot keys every dispatch.
+
+On this environment's remote-TPU tunnel every operand byte crosses a
+~MB/s link, and even on an attached chip the per-key preprocessing
+(ExpandA matrix expansion, the key-dependent NTTs) is recomputed by every
+dispatch that carries the same key.  Both costs are per-KEY, not per-op:
+a node signs every transcript with one long-lived key, verifies a given
+peer with one public key, and a swarm encapsulates repeatedly against hot
+peers.  The cache pins the precomputed per-key device state (pytrees of
+jax arrays produced by ``kem.mlkem.precompute_ek`` /
+``sig.mldsa.precompute_sk`` / ``sig.mldsa.precompute_pk``) keyed by a
+content hash of the raw key bytes, with LRU eviction so unbounded peer
+churn cannot pin unbounded device memory.
+
+Security note: cached entries derived from SECRET keys (the sign-path
+precompute) hold key-equivalent material on device for the cache's
+lifetime — the same trust boundary as the provider object itself, which
+already holds the raw secret key in host memory.  Keys are identified by
+SHA-256 of their bytes; raw key material never appears in stats or logs.
+
+Thread-safety: lookups/inserts take a lock (queues dispatch from executor
+threads); the miss-path compute runs OUTSIDE the lock because it may jit,
+so two threads racing the same cold key may both compute — the second
+insert wins, which is harmless (identical value) and cheaper than holding
+a lock across a compile.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any
+
+
+class DeviceOperandCache:
+    """Content-hash-keyed LRU of per-key device operand pytrees."""
+
+    def __init__(self, capacity: int = 8):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple[str, bytes], Any] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _key(kind: str, key_bytes: bytes) -> tuple[str, bytes]:
+        return (kind, hashlib.sha256(key_bytes).digest())
+
+    def lookup(self, kind: str, key_bytes: bytes) -> Any | None:
+        """Cached state or None.  Deliberately a lookup/put split, not a
+        compute-on-miss callback: the providers' miss path is a COMBINED
+        program (op + precompute in one dispatch, e.g. kem.mlkem.
+        encaps_cold) whose other outputs the caller needs — a callback
+        could not return those."""
+        k = self._key(kind, bytes(key_bytes))
+        with self._lock:
+            if k in self._entries:
+                self._entries.move_to_end(k)
+                self.hits += 1
+                return self._entries[k]
+            self.misses += 1
+            return None
+
+    def put(self, kind: str, key_bytes: bytes, val: Any) -> None:
+        k = self._key(kind, bytes(key_bytes))
+        with self._lock:
+            self._entries[k] = val
+            self._entries.move_to_end(k)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

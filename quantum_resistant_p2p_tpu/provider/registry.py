@@ -22,7 +22,8 @@ from __future__ import annotations
 
 from typing import Callable
 
-from .base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricAlgorithm
+from .base import (FusedHandshakeOps, KeyExchangeAlgorithm, SignatureAlgorithm,
+                   SymmetricAlgorithm)
 from .symmetric import AES256GCM, ChaCha20Poly1305
 
 # name -> (factory(backend, devices) -> algorithm, supported_backends)
@@ -32,6 +33,8 @@ _AEADS: dict[str, Callable[[], SymmetricAlgorithm]] = {
     "AES-256-GCM": AES256GCM,
     "ChaCha20-Poly1305": ChaCha20Poly1305,
 }
+# (kem name, sig name) -> factory(kem, sig) -> FusedHandshakeOps
+_FUSED: dict[tuple[str, str], Callable] = {}
 
 
 def register_kem(name: str, factory, backends: tuple[str, ...]) -> None:
@@ -40,6 +43,14 @@ def register_kem(name: str, factory, backends: tuple[str, ...]) -> None:
 
 def register_signature(name: str, factory, backends: tuple[str, ...]) -> None:
     _SIGS[name] = (factory, backends)
+
+
+def register_fused(kem_name: str, sig_name: str, factory) -> None:
+    """Register a composite-op capability for a (KEM, signature) pair.
+    ``factory(kem, sig)`` wraps EXISTING provider instances (the composite
+    programs reuse their jitted cores) and must return a
+    ``provider.base.FusedHandshakeOps``."""
+    _FUSED[(kem_name, sig_name)] = factory
 
 
 def _resolve_backend(requested: str, supported: tuple[str, ...]) -> str:
@@ -68,6 +79,19 @@ def get_signature(name: str, backend: str = "auto", devices: int = 0) -> Signatu
     return factory(_resolve_backend(backend, backends), devices)
 
 
+def get_fused(kem: KeyExchangeAlgorithm,
+              sig: SignatureAlgorithm) -> FusedHandshakeOps | None:
+    """Composite-op capability for an existing provider pair, or ``None``
+    when absent (unregistered pair, or either side not tpu-backed) — the
+    caller then stays on the per-op path.  Never raises on lookup."""
+    if getattr(kem, "backend", "") != "tpu" or getattr(sig, "backend", "") != "tpu":
+        return None
+    factory = _FUSED.get((getattr(kem, "name", None), getattr(sig, "name", None)))
+    if factory is None:
+        return None
+    return factory(kem, sig)
+
+
 def get_symmetric(name: str) -> SymmetricAlgorithm:
     if name not in _AEADS:
         raise KeyError(f"unknown AEAD {name!r}; known: {sorted(_AEADS)}")
@@ -86,9 +110,14 @@ def list_symmetrics() -> list[str]:
     return sorted(_AEADS)
 
 
+def list_fused() -> list[tuple[str, str]]:
+    return sorted(_FUSED)
+
+
 # -- default registrations ---------------------------------------------------
 
 def _register_defaults() -> None:
+    from .fused_providers import FusedMLKEMMLDSA
     from .kem_providers import FrodoKEMKeyExchange, HQCKeyExchange, MLKEMKeyExchange
     from .sig_providers import MLDSASignature, SPHINCSSignature
 
@@ -133,6 +162,15 @@ def _register_defaults() -> None:
                     _level, backend, fast=_fast, devices=devices
                 ),
                 ("cpu", "tpu"),
+            )
+    # Composite handshake capability: every ML-KEM x ML-DSA pair shares the
+    # same fused program shapes (fused/mlkem_mldsa.py), parameterized by the
+    # pair's parameter sets.
+    for kem_name in ("ML-KEM-512", "ML-KEM-768", "ML-KEM-1024"):
+        for sig_name in ("ML-DSA-44", "ML-DSA-65", "ML-DSA-87"):
+            register_fused(
+                kem_name, sig_name,
+                lambda kem, sig: FusedMLKEMMLDSA(kem, sig),
             )
 
 

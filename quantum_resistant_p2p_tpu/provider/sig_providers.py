@@ -64,7 +64,8 @@ class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
     """ML-DSA (FIPS 204) at NIST level 2, 3 or 5."""
 
     def __init__(self, security_level: int = 3, backend: str = "cpu",
-                 devices: int = 0, compact_sign: bool = False):
+                 devices: int = 0, compact_sign: bool = False,
+                 opcache_size: int = 8):
         if security_level not in _LEVEL_TO_MLDSA:
             raise ValueError(f"ML-DSA level must be 2/3/5, got {security_level}")
         self.params = _LEVEL_TO_MLDSA[security_level]
@@ -81,10 +82,21 @@ class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
         self.public_key_len = self.params.pk_len
         self.secret_key_len = self.params.sk_len
         self.signature_len = self.params.sig_len
+        #: device-resident per-key operand cache (tpu only): a node signs
+        #: every transcript with ONE long-lived key and verifies a peer with
+        #: one public key, so the key-dependent ExpandA + NTTs are per-KEY
+        #: work recomputed by every dispatch without this.  0 disables.
+        self.opcache = None
         if backend == "tpu":
             from ..sig import mldsa as _jax_mldsa  # deferred: pulls in jax
 
             self._kg, self._sign_mu, self._verify_mu = _jax_mldsa.get(self.params.name)
+            (self._sign_cold, self._sign_pre,
+             self._verify_cold, self._verify_pre) = _jax_mldsa.get_pre(self.params.name)
+            if opcache_size > 0:
+                from .opcache import DeviceOperandCache
+
+                self.opcache = DeviceOperandCache(opcache_size)
         self._mesh = make_provider_mesh(devices, backend)
         self._native = None
         if backend == "cpu":
@@ -155,6 +167,7 @@ class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
             [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, messages)]
         )
         rnds = np.stack([np.frombuffer(r, np.uint8) for r in rnd])
+        sks = np.asarray(secret_keys)
         if self.compact_sign and self._mesh is None:
             # Opt-in compact-and-refill driver: unfinished lanes gather into
             # shrinking pow2 buckets between dispatches instead of every
@@ -163,11 +176,24 @@ class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
             from ..sig import mldsa as _jax_mldsa
 
             sigs, done = _jax_mldsa.sign_mu_compact(
-                self.params.name, np.asarray(secret_keys), mus, rnds
+                self.params.name, sks, mus, rnds
             )
+        elif (self.opcache is not None and self._mesh is None
+              and (n == 1 or (sks[0] == sks).all())):
+            # Single-key batch — the steady state (one node, one long-lived
+            # sig key): a hit skips the sk upload + ExpandA + key NTTs; a
+            # miss runs the cache-filling combined program.  One dispatch
+            # either way, bit-identical output (pure hoist).
+            skb = sks[0].tobytes()
+            pre = self.opcache.lookup("sk", skb)
+            if pre is None:
+                pre, sigs, done = self._sign_cold(sks[0], mus, rnds)
+                self.opcache.put("sk", skb, pre)
+            else:
+                sigs, done = self._sign_pre(pre, mus, rnds)
+            sigs, done = np.asarray(sigs), np.asarray(done)
         else:
-            sigs, done = self._dispatch(self._sign_mu,
-                                        np.asarray(secret_keys), mus, rnds)
+            sigs, done = self._dispatch(self._sign_mu, sks, mus, rnds)
         if not done.all():
             # P < 1e-12 per lane; an all-zero sigma must never leave the
             # provider as if it were a signature (ADVICE r1).
@@ -186,7 +212,20 @@ class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
             [np.frombuffer(_mu(tr, m), np.uint8) for tr, m in zip(trs, messages)]
         )
         sigs = np.stack([np.frombuffer(bytes(s), np.uint8) for s in signatures])
-        return self._dispatch(self._verify_mu, np.asarray(public_keys), mus, sigs)
+        pks = np.asarray(public_keys)
+        if (self.opcache is not None and self._mesh is None
+                and (pks.shape[0] == 1 or (pks[0] == pks).all())):
+            # Single-key batch (a peer's long-lived sig key): cached
+            # ExpandA + NTT(t1<<D); see sign_batch.
+            pkb = pks[0].tobytes()
+            pre = self.opcache.lookup("pk", pkb)
+            if pre is None:
+                pre, oks = self._verify_cold(pks[0], mus, sigs)
+                self.opcache.put("pk", pkb, pre)
+            else:
+                oks = self._verify_pre(pre, mus, sigs)
+            return np.asarray(oks)
+        return self._dispatch(self._verify_mu, pks, mus, sigs)
 
 
 # Per-set sign dispatch caps: the s-set values are the measured hard compile

@@ -13,14 +13,24 @@ from __future__ import annotations
 
 import os
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers import aead as _aead
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers import aead as _aead
+except ImportError:  # pragma: no cover - exercised only on minimal images
+    # Gate, don't crash: the provider package (registry, batch queues, KEM/
+    # signature providers) is fully usable without host AEAD — only actual
+    # encrypt/decrypt needs OpenSSL.  Minimal accelerator images without
+    # the wheel can still run the PQC layers and their tests.
+    class InvalidTag(Exception):  # placeholder: never raised without OpenSSL
+        pass
+
+    _aead = None
 
 from .base import SymmetricAlgorithm
 
 
 class _AEADBase(SymmetricAlgorithm):
-    _impl = None  # cryptography AEAD class
+    _impl = ""  # cryptography AEAD class name (resolved lazily by _cipher)
 
     key_size = 32
     nonce_size = 12
@@ -28,11 +38,19 @@ class _AEADBase(SymmetricAlgorithm):
     def generate_key(self) -> bytes:
         return os.urandom(self.key_size)
 
+    @property
+    def _cipher(self):
+        if _aead is None:
+            raise RuntimeError(
+                f"{self.name} needs the 'cryptography' package for host AEAD"
+            )
+        return getattr(_aead, self._impl)
+
     def encrypt(self, key: bytes, plaintext: bytes, associated_data: bytes | None = None) -> bytes:
         if len(key) != self.key_size:
             raise ValueError(f"{self.name} requires a {self.key_size}-byte key")
         nonce = os.urandom(self.nonce_size)
-        return nonce + self._impl(key).encrypt(nonce, plaintext, associated_data)
+        return nonce + self._cipher(key).encrypt(nonce, plaintext, associated_data)
 
     def decrypt(self, key: bytes, data: bytes, associated_data: bytes | None = None) -> bytes:
         if len(key) != self.key_size:
@@ -41,13 +59,13 @@ class _AEADBase(SymmetricAlgorithm):
             raise ValueError("ciphertext too short")
         nonce, ct = data[: self.nonce_size], data[self.nonce_size :]
         try:
-            return self._impl(key).decrypt(nonce, ct, associated_data)
+            return self._cipher(key).decrypt(nonce, ct, associated_data)
         except InvalidTag as e:
             raise ValueError("authentication failed") from e
 
 
 class AES256GCM(_AEADBase):
-    _impl = _aead.AESGCM
+    _impl = "AESGCM"
     name = "AES-256-GCM"
     display_name = "AES-256-GCM"
     description = "AES in Galois/Counter Mode with 256-bit keys (NIST SP 800-38D)"
@@ -56,7 +74,7 @@ class AES256GCM(_AEADBase):
 
 
 class ChaCha20Poly1305(_AEADBase):
-    _impl = _aead.ChaCha20Poly1305
+    _impl = "ChaCha20Poly1305"
     name = "ChaCha20-Poly1305"
     display_name = "ChaCha20-Poly1305"
     description = "RFC 8439 ChaCha20-Poly1305 AEAD"

@@ -562,6 +562,27 @@ def _inf_norm(x: jax.Array, axes) -> jax.Array:
     return jnp.max(jnp.abs(_center(x)), axis=axes)
 
 
+def precompute_sk(p: MLDSAParams, sk: jax.Array) -> dict[str, jax.Array]:
+    """Per-key device state the sign loop reuses across every dispatch.
+
+    ExpandA and the key-dependent NTTs (s1, s2, t0) depend only on the
+    secret key — hoisting them out of ``sign_mu`` lets the operand cache
+    (provider/opcache.py) compute them ONCE per key and keep them
+    device-resident, so repeat sign dispatches against the same key skip
+    both the sk re-upload and the ExpandA work.  The returned pytree may be
+    unbatched (one key) and broadcasts against any mu/rnd batch.
+    """
+    rho, cap_k, tr, s1, s2, t0 = _unpack_sk(p, jnp.asarray(sk, jnp.uint8))
+    del tr
+    return {
+        "cap_k": cap_k,
+        "a_hat": expand_a(p, rho),
+        "s1_hat": ntt(s1),
+        "s2_hat": ntt(s2),
+        "t0_hat": ntt(t0),
+    }
+
+
 def sign_mu_rounds(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array,
                    kappa0: jax.Array, n_iters: int, unroll: int = 1):
     """At most ``n_iters`` rejection-loop iterations from per-lane ``kappa0``.
@@ -583,17 +604,23 @@ def sign_mu_rounds(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array,
     stage timings are flattered by cross-dispatch overlap in the timing
     harness, and the serial in-context chain is the true cost.  Default 1.
     """
+    return _sign_mu_core(p, precompute_sk(p, sk), mu, rnd, kappa0, n_iters,
+                         unroll)
+
+
+def _sign_mu_core(p: MLDSAParams, pre: dict[str, jax.Array], mu: jax.Array,
+                  rnd: jax.Array, kappa0: jax.Array, n_iters: int,
+                  unroll: int = 1):
+    """Rejection loop over precomputed key state (see ``precompute_sk``)."""
     if unroll < 1 or n_iters % unroll:
         raise ValueError(f"n_iters ({n_iters}) must be a positive multiple "
                          f"of unroll ({unroll})")
-    sk = jnp.asarray(sk, jnp.uint8)
     mu = jnp.asarray(mu, jnp.uint8)
     rnd = jnp.asarray(rnd, jnp.uint8)
     batch = mu.shape[:-1]
-    rho, cap_k, tr, s1, s2, t0 = _unpack_sk(p, sk)
-    del tr
-    a_hat = expand_a(p, rho)
-    s1_hat, s2_hat, t0_hat = ntt(s1), ntt(s2), ntt(t0)
+    a_hat = pre["a_hat"]
+    s1_hat, s2_hat, t0_hat = pre["s1_hat"], pre["s2_hat"], pre["t0_hat"]
+    cap_k = jnp.broadcast_to(pre["cap_k"], batch + (32,))
     rhopp = keccak.shake256(jnp.concatenate([cap_k, rnd, mu], axis=-1), 64)
 
     zb = 32 * p.z_bits
@@ -667,6 +694,14 @@ def sign_mu(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array):
     all-zero and must not be emitted — callers check host-side and raise.
     """
     sig, done, _ = sign_mu_rounds(p, sk, mu, rnd, jnp.int32(0), MAX_SIGN_ITERS)
+    return sig, done
+
+
+def sign_mu_pre(p: MLDSAParams, pre: dict[str, jax.Array], mu: jax.Array,
+                rnd: jax.Array):
+    """``sign_mu`` over a ``precompute_sk`` pytree — bit-identical output
+    (the precompute is a pure hoist of the key-dependent prefix)."""
+    sig, done, _ = _sign_mu_core(p, pre, mu, rnd, jnp.int32(0), MAX_SIGN_ITERS)
     return sig, done
 
 
@@ -754,17 +789,32 @@ def sign_mu_compact(name: str, sk, mu, rnd, *,
 # --------------------------------------------------------------------------
 
 
+def precompute_pk(p: MLDSAParams, pk: jax.Array) -> dict[str, jax.Array]:
+    """Per-key device state the verify path reuses across dispatches:
+    ExpandA(rho) and NTT(t1 << D) depend only on the public key (same
+    rationale as ``precompute_sk``; consumed by the operand cache).  May be
+    unbatched and broadcasts against any mu/sigma batch."""
+    pk = jnp.asarray(pk, jnp.uint8)
+    rho = pk[..., :32]
+    t1 = simple_bit_unpack(
+        pk[..., 32:].reshape(pk.shape[:-1] + (p.k, 32 * (23 - D))), 23 - D
+    )
+    t1_shift = (t1.astype(jnp.int32) << D) % Q
+    return {"a_hat": expand_a(p, rho), "t1_hat": ntt(t1_shift)}
+
+
 def verify_mu(p: MLDSAParams, pk: jax.Array, mu: jax.Array, sigma: jax.Array) -> jax.Array:
     """Core of Algorithm 8 given mu. pk (..., pk_len), mu (..., 64),
     sigma (..., sig_len) -> bool (...,)."""
-    pk = jnp.asarray(pk, jnp.uint8)
+    return verify_mu_pre(p, precompute_pk(p, pk), mu, sigma)
+
+
+def verify_mu_pre(p: MLDSAParams, pre: dict[str, jax.Array], mu: jax.Array,
+                  sigma: jax.Array) -> jax.Array:
+    """``verify_mu`` over a ``precompute_pk`` pytree (pure hoist)."""
     mu = jnp.asarray(mu, jnp.uint8)
     sigma = jnp.asarray(sigma, jnp.uint8)
     batch = mu.shape[:-1]
-    rho = pk[..., :32]
-    t1 = simple_bit_unpack(
-        pk[..., 32:].reshape(batch + (p.k, 32 * (23 - D))), 23 - D
-    )
     ctilde = sigma[..., : p.ctilde_len]
     zb = 32 * p.z_bits
     off = p.ctilde_len
@@ -773,11 +823,9 @@ def verify_mu(p: MLDSAParams, pk: jax.Array, mu: jax.Array, sigma: jax.Array) ->
     )
     h, ok = hint_bit_unpack(p, sigma[..., off + p.l * zb :])
     ok &= _inf_norm(z, (-1, -2)) < p.gamma1 - p.beta
-    a_hat = expand_a(p, rho)
     c_hat = ntt(sample_in_ball(p, ctilde))
-    az = _matvec(a_hat, ntt(z))
-    t1_shift = (t1.astype(jnp.int32) << D) % Q
-    ct1 = pw_mul(c_hat[..., None, :], ntt(t1_shift))
+    az = _matvec(pre["a_hat"], ntt(z))
+    ct1 = pw_mul(c_hat[..., None, :], pre["t1_hat"])
     w_approx = ntt_inv((az - ct1) % Q)
     w1 = use_hint(p, h, w_approx)
     w1_enc = simple_bit_pack(w1, p.w1_bits).reshape(batch + (-1,))
@@ -799,4 +847,34 @@ def get(name: str):
         jax.jit(functools.partial(keygen, p)),
         jax.jit(functools.partial(sign_mu, p)),
         jax.jit(functools.partial(verify_mu, p)),
+    )
+
+
+def sign_mu_cold(p: MLDSAParams, sk: jax.Array, mu: jax.Array, rnd: jax.Array):
+    """Cache-filling sign: ONE dispatch returning the per-key device state
+    (ExpandA + key NTTs) alongside the signatures, so a cache miss costs no
+    extra round trip over the uncached path (see kem.mlkem.encaps_cold)."""
+    pre = precompute_sk(p, sk)
+    sig, done = sign_mu_pre(p, pre, mu, rnd)
+    return pre, sig, done
+
+
+def verify_mu_cold(p: MLDSAParams, pk: jax.Array, mu: jax.Array, sigma: jax.Array):
+    """Cache-filling verify (see ``sign_mu_cold``)."""
+    pre = precompute_pk(p, pk)
+    return pre, verify_mu_pre(p, pre, mu, sigma)
+
+
+@functools.cache
+def get_pre(name: str):
+    """Jitted (sign_mu_cold, sign_mu_pre, verify_mu_cold, verify_mu_pre)
+    for the device operand cache (provider/opcache.py): the cold variants
+    fill the cache in one dispatch; the pre variants run over a cached
+    pytree, skipping the key upload and ExpandA."""
+    p = PARAMS[name]
+    return (
+        jax.jit(functools.partial(sign_mu_cold, p)),
+        jax.jit(functools.partial(sign_mu_pre, p)),
+        jax.jit(functools.partial(verify_mu_cold, p)),
+        jax.jit(functools.partial(verify_mu_pre, p)),
     )

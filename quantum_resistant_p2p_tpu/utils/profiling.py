@@ -44,11 +44,16 @@ class LatencyHistogram:
         self._window: collections.deque[float] = collections.deque(maxlen=cap)
         self.count = 0
         self.total = 0.0
+        #: most recent sample (None before the first record): metrics
+        #: surfaces like "trips in the last handshake" want the latest
+        #: observation, not a percentile of the window
+        self.last: float | None = None
 
     def record(self, seconds: float) -> None:
         self.count += 1
         self.total += seconds
         self._window.append(seconds)
+        self.last = seconds
 
     @contextlib.contextmanager
     def time(self):
@@ -68,6 +73,7 @@ class LatencyHistogram:
         return {
             "count": self.count,
             "mean_s": self.total / self.count if self.count else None,
+            "last_s": self.last,
             "p50_s": self.percentile(50),
             "p95_s": self.percentile(95),
             "p99_s": self.percentile(99),

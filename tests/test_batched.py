@@ -205,3 +205,226 @@ def test_opqueue_cold_bucket_serves_fallback_and_warms_in_background():
 
     st = asyncio.run(run())
     assert st.fallback_ops == 1 and st.breaker_trips == 0
+
+
+def test_opqueue_fallback_while_open_never_touches_device():
+    """Every flush while the breaker is open runs on the fallback: the
+    device fn is never called, and the breaker's aggregate fallback-trip
+    counter advances once per flush."""
+    device_calls, fb_calls = [], []
+
+    def device(items):
+        device_calls.append(len(items))
+        return [("dev", x) for x in items]
+
+    async def run():
+        br = Breaker(cooloff_s=60.0)
+        q = OpQueue(device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: (
+                        fb_calls.append(len(items)) or [("cpu", x) for x in items]
+                    ),
+                    breaker=br)
+        q._warm_buckets.add(1)
+        br.trip()  # device declared slow by a sibling queue
+        outs = [await q.submit(i) for i in range(3)]
+        return outs, q.stats, br
+
+    outs, st, br = asyncio.run(run())
+    assert outs == [("cpu", i) for i in range(3)]
+    assert device_calls == [] and fb_calls == [1, 1, 1]
+    assert st.fallback_flushes == 3 and st.device_trips == 0
+    assert br.fallback_trips == 3 and br.device_trips == 0
+
+
+def test_opqueue_warmup_failure_keeps_fallback_then_recovers():
+    """A failed cold-compile must not poison the queue: the bucket stays
+    cold (ops keep flowing through the fallback), and a later flush retries
+    the warm-up on the warmup executor until it succeeds."""
+    attempts = []
+
+    def flaky_device(items):
+        attempts.append(len(items))
+        if len(attempts) == 1:
+            raise RuntimeError("compile OOM")  # first warm-up dies
+        return [("dev", x) for x in items]
+
+    async def run():
+        q = OpQueue(flaky_device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: [("cpu", x) for x in items],
+                    breaker=Breaker(cooloff_s=60.0))
+        a = await q.submit(1)           # cold: fallback; warm-up #1 fails
+        for _ in range(100):
+            if len(attempts) >= 1 and not q._warming:
+                break
+            await asyncio.sleep(0.02)
+        assert 1 not in q._warm_buckets  # failure did NOT mark the bucket warm
+        b = await q.submit(2)           # still cold: fallback; warm-up #2 runs
+        for _ in range(100):
+            if 1 in q._warm_buckets:
+                break
+            await asyncio.sleep(0.02)
+        assert 1 in q._warm_buckets
+        c = await q.submit(3)           # warm now: device
+        return a, b, c, q.stats
+
+    a, b, c, st = asyncio.run(run())
+    assert a == ("cpu", 1) and b == ("cpu", 2) and c == ("dev", 3)
+    assert len(attempts) == 3           # 2 warm-ups + 1 live device flush
+    assert st.breaker_trips == 0        # cold-compile is not a degradation
+
+
+def test_opqueue_warmup_watchdog_unsticks_hung_compile():
+    """A hung warm-up must not pin the bucket in _warming forever: after the
+    watchdog fires, a later flush retries the warm-up."""
+    import threading
+
+    release = threading.Event()
+    attempts = []
+
+    def device(items):
+        attempts.append(len(items))
+        if len(attempts) == 1:
+            release.wait(10.0)  # first warm-up hangs
+        return [("dev", x) for x in items]
+
+    async def run():
+        q = OpQueue(device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: [("cpu", x) for x in items],
+                    breaker=Breaker(cooloff_s=60.0))
+        q.warmup_watchdog_s = 0.05
+        a = await q.submit(1)            # cold: fallback; warm-up #1 hangs
+        await asyncio.sleep(0.3)         # watchdog clears the _warming flag
+        assert not q._warming
+        b = await q.submit(2)            # retries the warm-up (queued behind
+        release.set()                    # the hung one on the 1-thread pool)
+        for _ in range(200):
+            if 1 in q._warm_buckets:
+                break
+            await asyncio.sleep(0.02)
+        assert 1 in q._warm_buckets
+        c = await q.submit(3)            # device
+        return a, b, c
+
+    a, b, c = asyncio.run(run())
+    assert a == ("cpu", 1) and b == ("cpu", 2) and c == ("dev", 3)
+
+
+def test_breaker_coalesces_sibling_queues_into_one_window():
+    """Queues sharing a breaker flush together: when one queue flushes, a
+    sibling's pending items go in flight in the same scheduling window
+    instead of riding out their own max_wait timer."""
+
+    async def run():
+        br = Breaker(cooloff_s=60.0)
+        qa = OpQueue(lambda items: [("a", x) for x in items],
+                     max_batch=2, max_wait_ms=10_000.0, breaker=br)
+        qb = OpQueue(lambda items: [("b", x) for x in items],
+                     max_batch=64, max_wait_ms=10_000.0, breaker=br)
+        fb = asyncio.ensure_future(qb.submit(7))   # pending, timer far out
+        await asyncio.sleep(0)
+        # filling qa to max_batch flushes it AND coalesces qb's pending item
+        outs = await asyncio.gather(qa.submit(1), qa.submit(2))
+        got_b = await asyncio.wait_for(fb, timeout=1.0)
+        return outs, got_b, qa.stats, qb.stats
+
+    outs, got_b, sta, stb = asyncio.run(run())
+    assert outs == [("a", 1), ("a", 2)] and got_b == ("b", 7)
+    assert sta.flushes == 1 and stb.flushes == 1
+    assert stb.total_wait_s < 5.0  # did not ride out its 10s timer
+
+
+def test_trip_counters_aggregate_across_shared_breaker():
+    """device_trips/fallback_trips are the handshake SLO currency: each
+    queue counts its own, and the shared breaker aggregates both so
+    SecureMessaging can diff one number around a handshake."""
+
+    async def run():
+        br = Breaker(cooloff_s=60.0)
+        qa = OpQueue(lambda items: list(items), max_batch=4, max_wait_ms=1.0,
+                     breaker=br)
+        qb = OpQueue(lambda items: list(items), max_batch=4, max_wait_ms=1.0,
+                     fallback_fn=lambda items: list(items), breaker=br)
+        qa._warm_buckets.add(1)
+        qb._warm_buckets.add(1)
+        await qa.submit(1)      # no-fallback queue: plain device trip
+        await qb.submit(2)      # armed queue, warm: device trip
+        br.trip()
+        await qb.submit(3)      # open: fallback trip
+        return qa.stats, qb.stats, br
+
+    sta, stb, br = asyncio.run(run())
+    assert sta.device_trips == 1 and stb.device_trips == 1
+    assert stb.fallback_ops == 1
+    assert br.device_trips == 2 and br.fallback_trips == 1
+    assert sta.as_dict()["device_trips"] == 1
+
+
+def test_batched_fused_composite_falls_back_to_per_op_cpu():
+    """The composite queue degrades to per-op cpu work that is
+    wire-identical: with the breaker open, keygen_sign / encaps_verify_sign
+    / decaps_verify_sign compose the cpu twins and their outputs
+    interoperate with plain per-op providers."""
+    import json
+
+    from quantum_resistant_p2p_tpu.provider import get_fused
+    from quantum_resistant_p2p_tpu.provider.batched import BatchedFused
+    from quantum_resistant_p2p_tpu.provider.fused_providers import (
+        init_pk_offset, resp_ct_offset)
+
+    tpu_kem = get_kem("ML-KEM-512", backend="tpu")
+    tpu_sig = get_signature("ML-DSA-44", backend="tpu")
+    cpu_kem = get_kem("ML-KEM-512", backend="cpu")
+    cpu_sig = get_signature("ML-DSA-44", backend="cpu")
+    fused = get_fused(tpu_kem, tpu_sig)
+    assert fused is not None
+    # cpu pairs advertise no capability -> callers stay entirely per-op
+    assert get_fused(cpu_kem, cpu_sig) is None
+
+    pk_off = init_pk_offset("ML-KEM-512", "AES-256-GCM")
+    ct_off = resp_ct_offset()
+    bf = BatchedFused(fused, pk_off=pk_off, ct_off=ct_off, max_batch=4,
+                      max_wait_ms=1.0, fallback_kem=cpu_kem,
+                      fallback_sig=cpu_sig, breaker=Breaker(cooloff_s=60.0))
+    bf.breaker.trip()  # force every composite flush onto the cpu fallback
+
+    spk, ssk = cpu_sig.generate_keypair()
+    init = {"aead": "AES-256-GCM", "kem": "ML-KEM-512",
+            "message_id": "x" * 36, "public_key": "0" * (2 * tpu_kem.public_key_len),
+            "recipient": "bob", "sender": "alice", "timestamp": 1.5}
+    tmpl = json.dumps(init, sort_keys=True, separators=(",", ":")).encode()
+
+    async def run():
+        pk, sk, sig = await bf.keygen_sign(ssk, tmpl)
+        rendered = tmpl[:pk_off] + pk.hex().encode() + \
+            tmpl[pk_off + 2 * len(pk):]
+        assert cpu_sig.verify(spk, rendered, sig)  # per-op interop
+
+        resp = {"ciphertext": "0" * (2 * tpu_kem.ciphertext_len),
+                "message_id": "x" * 36, "recipient": "alice",
+                "sender": "bob", "timestamp": 1.5}
+        rtmpl = json.dumps(resp, sort_keys=True, separators=(",", ":")).encode()
+        ok, ct, ss, rsig = await bf.encaps_verify_sign(
+            pk, spk, rendered, sig, ssk, rtmpl)
+        assert ok
+        rrend = rtmpl[:ct_off] + ct.hex().encode() + \
+            rtmpl[ct_off + 2 * len(ct):]
+        assert cpu_sig.verify(spk, rrend, rsig)
+        assert cpu_kem.decapsulate(sk, ct) == ss  # per-op decaps interop
+
+        confirm = b'{"message_id":"y","recipient":"b","sender":"a","timestamp":2}'
+        ok2, ss2, csig = await bf.decaps_verify_sign(
+            sk, ct, spk, rrend, rsig, ssk, confirm)
+        assert ok2 and ss2 == ss
+        assert cpu_sig.verify(spk, confirm, csig)
+
+        # a tampered peer signature fails as ok=False, not an exception
+        bad = bytes([sig[0] ^ 1]) + sig[1:]
+        ok3, _, _, _ = await bf.encaps_verify_sign(
+            pk, spk, rendered, bad, ssk, rtmpl)
+        assert not ok3
+        return bf.stats()
+
+    st = asyncio.run(run())
+    for qname in ("keygen_sign", "encaps_verify_sign", "decaps_verify_sign"):
+        assert st[qname]["fallback_flushes"] >= 1
+        assert st[qname]["device_trips"] == 0

@@ -1,0 +1,245 @@
+"""Fused composite handshake programs: bit-exactness against the separate-op
+path, transcript-offset probing, the device operand cache, and the
+double-buffered slicer.
+
+The wire-compatibility claim (fused and unfused stacks are indistinguishable
+to a peer) reduces to: under the same injected seeds, the fused programs'
+outputs are byte-identical to the separate-op providers'.  Small batches run
+in tier-1 on the cpu platform; the batch-256 shape rides nightly (`slow`).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.fused import mlkem_mldsa as fused_ops
+from quantum_resistant_p2p_tpu.kem import mlkem as jax_mlkem
+from quantum_resistant_p2p_tpu.provider.base import sliced_dispatch
+from quantum_resistant_p2p_tpu.provider.fused_providers import (
+    FusedMLKEMMLDSA, init_pk_offset, resp_ct_offset)
+from quantum_resistant_p2p_tpu.provider.kem_providers import MLKEMKeyExchange
+from quantum_resistant_p2p_tpu.provider.opcache import DeviceOperandCache
+from quantum_resistant_p2p_tpu.provider.sig_providers import MLDSASignature
+
+KEM_NAME, SIG_NAME, LEVEL = "ML-KEM-512", "ML-DSA-44", 1
+AEAD = "AES-256-GCM"
+
+
+@pytest.fixture(scope="module")
+def pair():
+    kem = MLKEMKeyExchange(security_level=LEVEL, backend="tpu")
+    sig = MLDSASignature(security_level=2, backend="tpu")
+    return kem, sig, FusedMLKEMMLDSA(kem, sig)
+
+
+def _init_template(kem) -> bytes:
+    d = {"aead": AEAD, "kem": kem.name, "message_id": "x" * 36,
+         "public_key": "0" * (2 * kem.public_key_len),
+         "recipient": "bob", "sender": "alice", "timestamp": 1234.5}
+    return json.dumps(d, sort_keys=True, separators=(",", ":")).encode()
+
+
+def _resp_template(kem) -> bytes:
+    d = {"ciphertext": "0" * (2 * kem.ciphertext_len), "message_id": "x" * 36,
+         "recipient": "alice", "sender": "bob", "timestamp": 1234.5}
+    return json.dumps(d, sort_keys=True, separators=(",", ":")).encode()
+
+
+def test_offsets_match_canonical_json_layout():
+    """The probed offsets point exactly at the hex payload gap."""
+    kem = MLKEMKeyExchange(security_level=LEVEL, backend="cpu")
+    t = _init_template(kem)
+    off = init_pk_offset(kem.name, AEAD)
+    assert t[off: off + 2 * kem.public_key_len] == b"0" * (2 * kem.public_key_len)
+    assert t[off - len('"public_key":"'): off] == b'"public_key":"'
+    r = _resp_template(kem)
+    coff = resp_ct_offset()
+    assert r[coff: coff + 2 * kem.ciphertext_len] == b"0" * (2 * kem.ciphertext_len)
+
+
+def test_encode_hex_matches_bytes_hex():
+    data = np.frombuffer(bytes(range(256)), np.uint8)
+    out = np.asarray(fused_ops.encode_hex(data))
+    assert bytes(out) == bytes(range(256)).hex().encode()
+
+
+def _roundtrip(pair, n):
+    """Drive all three composite programs and cross-check every output
+    against the separate-op providers under the same injected seeds."""
+    kem, sig, fused = pair
+    pk_off, ct_off = init_pk_offset(kem.name, AEAD), resp_ct_offset()
+    spk, ssk = sig.generate_keypair()
+    sks = np.stack([np.frombuffer(ssk, np.uint8)] * n)
+    spks = np.stack([np.frombuffer(spk, np.uint8)] * n)
+    rnd = [bytes([i] * 32) for i in range(n)]
+    m = [bytes([0x40 | i] * 32) for i in range(n)]
+
+    # -- ke_init: keygen + sign --------------------------------------------
+    tmpl = _init_template(kem)
+    eks, dks, sigs = fused.keygen_sign_batch(sks, [tmpl] * n, pk_off, rnd=rnd)
+    rendered = [
+        tmpl[:pk_off] + bytes(ek).hex().encode()
+        + tmpl[pk_off + 2 * kem.public_key_len:]
+        for ek in eks
+    ]
+    # byte-identical to the per-op signature over the rendered transcript
+    per_op = sig.sign_batch(sks, rendered, rnd=rnd)
+    assert [bytes(s) for s in per_op] == [bytes(s) for s in sigs]
+    assert sig.verify(spk, rendered[0], sigs[0])
+
+    # -- ke_response: verify + encaps + sign -------------------------------
+    rtmpl = _resp_template(kem)
+    oks, cts, sss, rsigs = fused.encaps_verify_sign_batch(
+        eks, spks, rendered, sigs, sks, [rtmpl] * n, ct_off, m=m, rnd=rnd)
+    assert oks.all()
+    # encaps bit-exact vs the separate-op jitted program with the same m
+    keys2, cts2 = jax_mlkem.get(kem.params.name)[1](
+        np.asarray(eks), np.stack([np.frombuffer(x, np.uint8) for x in m]))
+    assert (np.asarray(cts) == np.asarray(cts2)).all()
+    assert (np.asarray(sss) == np.asarray(keys2)).all()
+    rrend = [
+        rtmpl[:ct_off] + bytes(ct).hex().encode()
+        + rtmpl[ct_off + 2 * kem.ciphertext_len:]
+        for ct in cts
+    ]
+    assert sig.verify(spk, rrend[0], rsigs[0])
+
+    # -- ke_confirm: verify + decaps + sign --------------------------------
+    confirm = b'{"message_id":"y","recipient":"b","sender":"a","timestamp":2}'
+    oks2, sss2, csigs = fused.decaps_verify_sign_batch(
+        dks, np.asarray(cts), spks, rrend, rsigs, sks, [confirm] * n, rnd=rnd)
+    assert oks2.all()
+    assert (np.asarray(sss2) == np.asarray(sss)).all()  # decaps inverts encaps
+    per_op_ss = kem.decapsulate_batch(np.asarray(dks), np.asarray(cts))
+    assert (np.asarray(sss2) == np.asarray(per_op_ss)).all()
+    assert sig.verify(spk, confirm, csigs[0])
+
+    # -- negative: tampered inputs fail closed ------------------------------
+    bad_sig = bytes([sigs[0][0] ^ 1]) + bytes(sigs[0][1:])
+    oks3, _, _, _ = fused.encaps_verify_sign_batch(
+        eks, spks, rendered, [bad_sig] * n, sks, [rtmpl] * n, ct_off,
+        m=m, rnd=rnd)
+    assert not oks3.any()
+    bad_ct = np.array(cts, copy=True)
+    bad_ct[:, 0] ^= 1
+    _, sss4, _ = fused.decaps_verify_sign_batch(
+        dks, bad_ct, spks, rrend, rsigs, sks, [confirm] * n, rnd=rnd)
+    # implicit rejection: wrong ct yields a DIFFERENT (pseudorandom) secret
+    assert not (np.asarray(sss4) == np.asarray(sss)).any(axis=1).all()
+
+
+def test_fused_bit_exact_vs_separate_ops_small(pair):
+    _roundtrip(pair, 2)
+
+
+@pytest.mark.slow
+def test_fused_bit_exact_vs_separate_ops_batch256(pair):
+    """Acceptance shape: composite == separate at batch >= 256."""
+    _roundtrip(pair, 256)
+
+
+# ---------------------------------------------------------------- opcache
+
+
+def test_opcache_lru_and_stats():
+    c = DeviceOperandCache(capacity=2)
+    assert c.lookup("k", b"a") is None
+    c.put("k", b"a", 1)
+    c.put("k", b"b", 2)
+    assert c.lookup("k", b"a") == 1     # refreshes 'a'
+    c.put("k", b"c", 3)                 # evicts 'b' (LRU)
+    assert c.lookup("k", b"b") is None
+    assert c.lookup("k", b"a") == 1 and c.lookup("k", b"c") == 3
+    assert len(c) == 2
+    st = c.stats()
+    assert st["hits"] == 3 and st["misses"] == 2 and st["evictions"] == 1
+    # kind partitions the key space: same key bytes, different entry —
+    # inserting it evicts the LRU entry ("k", a), not ("k", c)
+    c.put("other", b"a", 9)
+    assert c.lookup("other", b"a") == 9 and c.lookup("k", b"c") == 3
+    assert c.lookup("k", b"a") is None
+
+
+def test_mlkem_encaps_opcache_hit_is_bit_identical():
+    """Cold (cache-filling) and warm (precomputed-operand) encaps programs
+    produce identical ct/ss for the same key and message randomness."""
+    kem = MLKEMKeyExchange(security_level=LEVEL, backend="tpu", opcache_size=4)
+    assert kem.opcache is not None
+    pk, sk = kem.generate_keypair()
+    pks = np.stack([np.frombuffer(pk, np.uint8)] * 2)
+
+    np.random.seed(7)
+    import os
+    real_urandom = os.urandom
+    try:
+        os.urandom = lambda n: b"\x05" * n  # pin the encaps message
+        cts_cold, sss_cold = kem.encapsulate_batch(pks)   # miss: fills cache
+        assert kem.opcache.stats()["misses"] >= 1
+        cts_warm, sss_warm = kem.encapsulate_batch(pks)   # hit: pre path
+        assert kem.opcache.stats()["hits"] >= 1
+    finally:
+        os.urandom = real_urandom
+    assert (np.asarray(cts_cold) == np.asarray(cts_warm)).all()
+    assert (np.asarray(sss_cold) == np.asarray(sss_warm)).all()
+    # and the outputs decapsulate correctly through the normal path
+    ss = kem.decapsulate_batch(
+        np.stack([np.frombuffer(sk, np.uint8)] * 2), np.asarray(cts_cold))
+    assert (np.asarray(ss) == np.asarray(sss_cold)).all()
+
+
+def test_mldsa_sign_verify_opcache_hit_is_bit_identical():
+    sig = MLDSASignature(security_level=2, backend="tpu", opcache_size=4)
+    assert sig.opcache is not None
+    pk, sk = sig.generate_keypair()
+    sks = np.stack([np.frombuffer(sk, np.uint8)] * 2)
+    msgs = [b"alpha", b"beta"]
+    rnd = [b"\x01" * 32, b"\x02" * 32]
+    s_cold = sig.sign_batch(sks, msgs, rnd=rnd)     # miss: fills "sk" cache
+    s_warm = sig.sign_batch(sks, msgs, rnd=rnd)     # hit: precomputed path
+    assert [bytes(s) for s in s_cold] == [bytes(s) for s in s_warm]
+    pks = np.stack([np.frombuffer(pk, np.uint8)] * 2)
+    ok_cold = sig.verify_batch(pks, msgs, s_cold)   # miss: fills "pk" cache
+    ok_warm = sig.verify_batch(pks, msgs, s_warm)   # hit
+    assert ok_cold.all() and ok_warm.all()
+    st = sig.opcache.stats()
+    assert st["hits"] >= 2 and st["misses"] >= 2
+    # tampered signature still rejects through the cached-verify path
+    bad = [bytes([s_cold[0][0] ^ 1]) + bytes(s_cold[0][1:]), bytes(s_cold[1])]
+    oks = sig.verify_batch(pks, msgs, bad)
+    assert not oks[0] and oks[1]
+
+
+def test_mixed_key_batch_bypasses_opcache():
+    """The single-key fast path must not fire for mixed-key batches."""
+    kem = MLKEMKeyExchange(security_level=LEVEL, backend="tpu", opcache_size=4)
+    pk1, _ = kem.generate_keypair()
+    pk2, _ = kem.generate_keypair()
+    pks = np.stack([np.frombuffer(pk1, np.uint8), np.frombuffer(pk2, np.uint8)])
+    before = dict(kem.opcache.stats())
+    cts, sss = kem.encapsulate_batch(pks)
+    after = kem.opcache.stats()
+    assert after["hits"] == before["hits"] and after["misses"] == before["misses"]
+    assert np.asarray(cts).shape[0] == 2 and np.asarray(sss).shape[0] == 2
+
+
+# ---------------------------------------------------- double-buffered slicer
+
+
+def test_sliced_dispatch_double_buffered_matches_naive():
+    """Pipelined slicing returns exactly what per-slice application would."""
+    calls = []
+
+    def fn(a, b):
+        calls.append(a.shape[0])
+        return a * 2, a + b
+
+    a = np.arange(10, dtype=np.int64).reshape(10, 1)
+    b = np.ones((10, 1), dtype=np.int64)
+    x, y = sliced_dispatch(fn, 4, a, b)
+    assert calls == [4, 4, 4]  # padded full slices
+    assert x.shape == (10, 1) and (x == a * 2).all() and (y == a + 1).all()
+
+    # single-output fn, exact multiple of step
+    out = sliced_dispatch(lambda v: v - 1, 5, np.arange(10).reshape(10, 1))
+    assert (out == np.arange(10).reshape(10, 1) - 1).all()

@@ -53,3 +53,33 @@ def test_nested_batch_shape():
     for i in range(2):
         for j in range(3):
             assert bytes(got[i, j]) == hashlib.shake_256(msgs[i, j].tobytes()).digest(64)
+
+
+def test_shake256_varlen_sweeps_block_boundaries():
+    """sponge_varlen matches hashlib for every length across the rate
+    boundaries (ds byte mid-block, at block end, first byte of next block)
+    with garbage past the true length."""
+    rate = 136
+    lmax = 2 * rate + 5
+    rng = np.random.default_rng(9)
+    lengths = sorted({0, 1, rate - 2, rate - 1, rate, rate + 1,
+                      2 * rate - 1, 2 * rate, 2 * rate + 1, lmax})
+    buf = rng.integers(0, 256, size=(len(lengths), lmax), dtype=np.uint8)
+    lens = np.asarray(lengths, np.int32)
+    got = np.asarray(keccak.shake256_varlen(buf, lens, 64))
+    for i, n in enumerate(lengths):
+        want = hashlib.shake_256(buf[i, :n].tobytes()).digest(64)
+        assert bytes(got[i]) == want, f"varlen mismatch at length {n}"
+
+
+def test_shake256_varlen_masks_garbage_tail():
+    """Bytes past the true length must not influence the digest."""
+    msg = b"fused transcript"
+    a = np.zeros((1, 300), np.uint8)
+    a[0, : len(msg)] = np.frombuffer(msg, np.uint8)
+    b = np.full((1, 300), 0xAB, np.uint8)
+    b[0, : len(msg)] = np.frombuffer(msg, np.uint8)
+    lens = np.asarray([len(msg)], np.int32)
+    da = bytes(np.asarray(keccak.shake256_varlen(a, lens, 32))[0])
+    db = bytes(np.asarray(keccak.shake256_varlen(b, lens, 32))[0])
+    assert da == db == hashlib.shake_256(msg).digest(32)

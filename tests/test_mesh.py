@@ -145,6 +145,7 @@ def test_sphincs_provider_mesh_verify_bit_exact():
 
 def test_messaging_constructs_with_mesh_devices(tmp_path):
     """Config knob reaches the providers through SecureMessaging."""
+    pytest.importorskip("cryptography")  # messaging pulls host HKDF/AEAD
     from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging
     from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode
 

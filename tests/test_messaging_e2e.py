@@ -199,11 +199,24 @@ def test_handshake_with_batched_tpu_provider(run, tmp_path):
                 break
             await asyncio.sleep(0.02)
         assert any(m.content == b"batched hello" for _, m in b.inbox)
-        # the queue actually coalesced device work
-        st = a.messaging._bkem.stats()
-        assert st["keygen"]["ops"] >= 1
+        # ML-KEM-768 + ML-DSA-65 advertises the fused capability, so the
+        # handshake crypto rides the composite queues: keygen+sign on the
+        # initiator, verify+encaps+sign on the responder, verify+decaps+sign
+        # back on the initiator — NOT the per-op kem/sig queues.
+        assert a.messaging._bfused is not None
+        fa, fb = a.messaging._bfused.stats(), b.messaging._bfused.stats()
+        assert fa["keygen_sign"]["ops"] >= 1
+        assert fa["decaps_verify_sign"]["ops"] >= 1
+        assert fb["encaps_verify_sign"]["ops"] >= 1
+        assert a.messaging._bkem.stats()["keygen"]["ops"] == 0
+        # the secure message itself still signs through the per-op queue
         sig_st = a.messaging._bsig.stats()
-        assert sig_st["sign"]["ops"] >= 2  # ke_init + confirm + message
+        assert sig_st["sign"]["ops"] >= 1
+        # the tentpole claim, measured: the initiator's handshake spent
+        # <= 4 serial dispatch trips (2 fused on its own breaker)
+        trips = a.messaging.metrics()["handshake_trips"]
+        assert trips["count"] == 1
+        assert trips["last"] is not None and trips["last"] <= 4
         await a.stop()
         await b.stop()
 

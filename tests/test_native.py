@@ -153,6 +153,7 @@ def test_slhdsa_provider_native_cpu_interop():
 def test_aes128_matches_fips197_and_openssl():
     import ctypes
 
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
 
     lib = native.load()
@@ -184,6 +185,8 @@ def test_aes128_matches_fips197_and_openssl():
 def test_frodo_matches_pyref(name):
     from quantum_resistant_p2p_tpu.pyref import frodo_ref
 
+    if "AES" in name:
+        pytest.importorskip("cryptography")  # pyref AES matrix expansion
     p = frodo_ref.PARAMS[name]
     nf = native.NativeFrodoKEM(name)
     s, se, z, mu = (

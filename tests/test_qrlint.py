@@ -352,6 +352,98 @@ def test_provider_contract_flags_missing_and_renamed(tmp_path):
     assert "GoodKEM" not in messages
 
 
+_FUSED_BASE = """
+import abc
+
+
+class FusedHandshakeOps(abc.ABC):
+    @abc.abstractmethod
+    def keygen_sign_batch(self, sig_sks, templates, pk_off, rnd=None):
+        ...
+
+    @abc.abstractmethod
+    def decaps_verify_sign_batch(self, secret_keys, ciphertexts,
+                                 peer_sig_pks, msgs_in, sigs_in, sig_sks,
+                                 msgs_out, rnd=None):
+        ...
+
+    def warmup(self, sizes=(1,), pk_off=None, ct_off=None):
+        pass
+"""
+
+_FUSED_REGISTRY = """
+from .impls import DriftedFused, GoodFused, SuppressedFused
+
+
+def register_fused(kem_name, sig_name, factory):
+    pass
+
+
+register_fused("ML-KEM-768", "ML-DSA-65", lambda kem, sig: GoodFused(kem, sig))
+register_fused("ML-KEM-512", "ML-DSA-44", lambda kem, sig: DriftedFused(kem, sig))
+register_fused("ML-KEM-1024", "ML-DSA-87",
+               lambda kem, sig: SuppressedFused(kem, sig))
+"""
+
+_FUSED_IMPLS = """
+from .base import FusedHandshakeOps
+
+
+class GoodFused(FusedHandshakeOps):
+    def __init__(self, kem, sig):
+        pass
+
+    def keygen_sign_batch(self, sig_sks, templates, pk_off, rnd=None):
+        return None
+
+    def decaps_verify_sign_batch(self, secret_keys, ciphertexts,
+                                 peer_sig_pks, msgs_in, sigs_in, sig_sks,
+                                 msgs_out, rnd=None):
+        return None
+
+
+class DriftedFused(FusedHandshakeOps):
+    def __init__(self, kem, sig):
+        pass
+
+    # positional drift: the composite queue forwards these positionally
+    def keygen_sign_batch(self, sks, tmpls, offset, rnd=None):
+        return None
+
+
+class SuppressedFused(FusedHandshakeOps):  # qrlint: disable=provider-contract  — capability implemented in a C extension shim
+    def __init__(self, kem, sig):
+        pass
+
+    def keygen_sign_batch(self, sig_sks, templates, pk_off, rnd=None):
+        return None
+"""
+
+
+def test_provider_contract_covers_fused_capability(tmp_path):
+    """register_fused binds implementations to the FusedHandshakeOps
+    capability surface: missing composite ops and positional drift are
+    flagged, a conforming class is clean, inline suppression holds."""
+    pkg = tmp_path / "provider"
+    pkg.mkdir()
+    (pkg / "base.py").write_text(_FUSED_BASE)
+    (pkg / "registry.py").write_text(_FUSED_REGISTRY)
+    (pkg / "impls.py").write_text(_FUSED_IMPLS)
+    findings, suppressed = Engine(default_rules()).lint_paths([pkg])
+    contract = [f for f in findings if f.rule == "provider-contract"]
+    messages = "\n".join(f.message for f in contract)
+    # trigger: DriftedFused misses one abstract op and renames positionals
+    assert "DriftedFused" in messages
+    assert "decaps_verify_sign_batch()" in messages
+    assert "keygen_sign_batch(sks, tmpls, offset, rnd)" in messages
+    # clean: the conforming implementation draws no findings
+    assert "GoodFused" not in messages
+    # suppressed: the annotated class is reported as suppressed, not live
+    assert "SuppressedFused" not in messages
+    assert any(s.rule == "provider-contract" and "SuppressedFused" in s.message
+               for s in suppressed)
+
+
 # -- engine mechanics ---------------------------------------------------------
 
 

@@ -8,9 +8,13 @@ accept the exact positional shape it forwards.  A registered class missing a
 method, or overriding a batch method with renamed/reordered parameters, only
 fails at runtime — mid-handshake.  This rule proves the contract statically:
 
-* every class reachable from a ``register_kem``/``register_signature`` call
-  (or listed in the AEAD table) implements each ``@abc.abstractmethod`` of
-  its base-interface, directly or via a project base class;
+* every class reachable from a ``register_kem``/``register_signature``/
+  ``register_fused`` call (or listed in the AEAD table) implements each
+  ``@abc.abstractmethod`` of its base-interface, directly or via a project
+  base class — for ``register_fused`` that interface is the optional
+  composite-op capability surface (``FusedHandshakeOps``), so a fused
+  provider whose batch programs drift from the capability contract fails
+  the lint, not a live handshake;
 * every override of a base-class method keeps the base's positional
   parameter names in order (extra trailing parameters must have defaults).
 """
@@ -29,6 +33,7 @@ _INTERFACES = {
     "KeyExchangeAlgorithm": "register_kem",
     "SignatureAlgorithm": "register_signature",
     "SymmetricAlgorithm": "_AEADS",
+    "FusedHandshakeOps": "register_fused",
 }
 
 

@@ -32,7 +32,13 @@ from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode  # noqa: E402
 async def run_swarm(n_peers: int, backend: str, use_batching: bool,
                     max_batch: int, max_wait_ms: float, concurrency: int,
                     warmup: int = 0, ke_timeout: float = 180.0,
-                    batch_floor: int = 1, prewarm: bool = False) -> dict:
+                    batch_floor: int = 1, prewarm: bool = False,
+                    slo: bool = False) -> dict:
+    """``slo=True`` turns the swarm into the single-handshake SLO probe:
+    handshakes only (no AEAD message rides in the measured window, so the
+    breaker-delta trip accounting below is handshake-pure) and per-handshake
+    dispatch-trip stats in the output.  Meaningful at concurrency 1 —
+    overlapping handshakes share the breaker counters."""
     # Cold-compile of each batch-size bucket can take tens of seconds on a
     # fresh machine; a generous protocol timeout plus an untimed warmup round
     # keeps compiles out of the measured numbers.
@@ -93,7 +99,9 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
             b *= 2
         t0 = time.perf_counter()
         loop = asyncio.get_running_loop()
-        for facade in (proto._bkem, proto._bsig, hub._bkem, hub._bsig):
+        facades = [proto._bkem, proto._bsig, hub._bkem, hub._bsig]
+        facades += [f for f in (proto._bfused, hub._bfused) if f is not None]
+        for facade in facades:
             await loop.run_in_executor(None, facade.warmup, tuple(sizes))
         prewarm_s = time.perf_counter() - t0
         print(f"prewarm: buckets {sizes} on 4 facades in {prewarm_s:.1f}s",
@@ -122,6 +130,7 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
                              sig_keypair=(bytes(kp_pks[j]), bytes(kp_sks[j])))
         # share the batch queues so all clients coalesce into the same batches
         sm._bkem, sm._bsig = proto._bkem, proto._bsig
+        sm._bfused = proto._bfused
         sm.use_batching = use_batching
         clients.append(sm)
         return sm
@@ -134,7 +143,8 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
             latencies.append(time.perf_counter() - t0)
             if not ok:
                 raise RuntimeError(f"handshake {i} failed")
-            await sm.send_message("hub", b"hello from peer %d" % i)
+            if not slo:
+                await sm.send_message("hub", b"hello from peer %d" % i)
 
     async def one_client(i: int) -> None:
         await drive_client(i, make_client(i))
@@ -148,13 +158,21 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
         latencies.clear()
         received = 0
         got_all.clear()
+        # the warmup clients stay in `clients`; drop their trip samples so
+        # initiator_trips_* describes only the measured (warm) window
+        from quantum_resistant_p2p_tpu.utils.profiling import LatencyHistogram
+
+        for sm in clients:
+            sm._handshake_trips = LatencyHistogram()
         # QueueStats are cumulative; reset so device_served_pct and the
         # dispatch histograms describe ONLY the measured window (warmup
         # ops land on cold buckets / the fallback by design)
         if use_batching and hub._bkem is not None:
             from quantum_resistant_p2p_tpu.provider.batched import QueueStats
 
-            for facade in (hub._bkem, hub._bsig, proto._bkem, proto._bsig):
+            facades = [hub._bkem, hub._bsig, proto._bkem, proto._bsig]
+            facades += [f for f in (hub._bfused, proto._bfused) if f is not None]
+            for facade in facades:
                 for q in (facade.__dict__.get("_kg"), facade.__dict__.get("_enc"),
                           facade.__dict__.get("_dec"), facade.__dict__.get("_sign"),
                           facade.__dict__.get("_verify")):
@@ -163,16 +181,26 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
 
     # pre-build every client stack, then start the measured window
     pre = [make_client(i) for i in range(n_peers)]
+
+    def _breaker_trips() -> int:
+        # serial dispatch steps (device + cpu fallback) across BOTH sides'
+        # breakers — the per-handshake SLO currency (docs/dispatch_budget.md),
+        # through the one definition SecureMessaging uses
+        return proto._trips_now() + hub._trips_now()
+
+    trips0 = _breaker_trips()
     t_start = time.perf_counter()
     results = await asyncio.gather(*(drive_client(i, sm)
                                      for i, sm in enumerate(pre)),
                                    return_exceptions=True)
     failures = [r for r in results if isinstance(r, Exception)]
-    try:
-        await asyncio.wait_for(got_all.wait(), 60)
-    except asyncio.TimeoutError:
-        pass
+    if not slo:
+        try:
+            await asyncio.wait_for(got_all.wait(), 60)
+        except asyncio.TimeoutError:
+            pass
     elapsed = time.perf_counter() - t_start
+    trips_delta = _breaker_trips() - trips0
 
     for sm in clients:
         await sm.node.stop()
@@ -199,6 +227,10 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
         stats["hub_queue"] = {"kem": hub._bkem.stats(), "sig": hub._bsig.stats()}
         stats["client_queue"] = {"kem": proto._bkem.stats(),
                                  "sig": proto._bsig.stats()}
+        if hub._bfused is not None:
+            stats["hub_queue"]["fused"] = hub._bfused.stats()
+        if proto._bfused is not None:
+            stats["client_queue"]["fused"] = proto._bfused.stats()
         total_ops = fb_ops = 0
         for side in ("hub_queue", "client_queue"):
             for fam in stats[side].values():
@@ -207,6 +239,22 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
                     fb_ops += q["fallback_ops"]
         stats["device_served_pct"] = round(
             100.0 * (total_ops - fb_ops) / total_ops, 1) if total_ops else None
+        # Measured dispatch trips (never inferred): breaker delta over the
+        # measured window across both sides.  In slo mode the window holds
+        # ONLY handshakes, so the per-handshake quotient is exact at
+        # concurrency 1; the client-side histogram (initiator trips between
+        # initiate and completion) rides along from the client stacks.
+        stats["dispatch_trips"] = trips_delta
+        if latencies:
+            stats["trips_per_handshake"] = round(trips_delta / len(latencies), 2)
+        client_trips = [
+            int(sm._handshake_trips.last) for sm in clients
+            if sm._handshake_trips.count and sm._handshake_trips.last is not None
+        ]
+        if client_trips:
+            srt = sorted(client_trips)
+            stats["initiator_trips_p50"] = srt[len(srt) // 2]
+            stats["initiator_trips_max"] = srt[-1]
     return stats
 
 
@@ -229,11 +277,17 @@ def main(argv=None) -> int:
     ap.add_argument("--prewarm", action="store_true",
                     help="compile every reachable flush bucket on hub+client "
                          "facades before the measured window")
+    ap.add_argument("--slo", action="store_true",
+                    help="single-handshake SLO probe: sequential handshakes "
+                         "only, with per-handshake dispatch-trip accounting "
+                         "(forces --concurrency 1)")
     args = ap.parse_args(argv)
+    if args.slo:
+        args.concurrency = 1
     stats = asyncio.run(
         run_swarm(args.peers, args.backend, args.batch, args.max_batch,
                   args.max_wait_ms, args.concurrency, args.warmup,
-                  args.ke_timeout, args.batch_floor, args.prewarm)
+                  args.ke_timeout, args.batch_floor, args.prewarm, args.slo)
     )
     print(json.dumps(stats))
     return 0 if stats["failures"] == 0 else 1
