@@ -46,10 +46,22 @@ SLO_BASELINE_P50_S = 1.5412
 SLO_PEERS = 32
 
 
+#: the --slo run FAILS (non-zero exit) when less than this fraction of the
+#: warm window's ops rode the device path — the round-3 "silent CPU swarm"
+#: regression (breaker open, fleet quietly degraded) is a tooling error now
+SLO_MIN_DEVICE_SERVED = 0.9
+
+
 def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
-             warmup: int = 4) -> None:
-    """Single-handshake SLO probe as a first-class bench output."""
+             warmup: int = 4) -> int:
+    """Single-handshake SLO probe as a first-class bench output.
+
+    Exit status gates CI: non-zero when any handshake failed OR when the
+    warm run was < ``SLO_MIN_DEVICE_SERVED`` device-served (i.e. the "TPU"
+    pipeline was actually the cpu fallback).
+    """
     import asyncio
+    import sys
 
     from tools.swarm_bench import run_swarm
 
@@ -59,6 +71,7 @@ def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
                   prewarm=True, slo=True)
     )
     p50 = stats.get("p50_handshake_s")
+    fraction = stats.get("device_served_fraction")
     out = {
         "metric": f"single_handshake_warm_p50_seq{peers}",
         "value": p50,
@@ -70,6 +83,8 @@ def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
         "initiator_trips_p50": stats.get("initiator_trips_p50"),
         "initiator_trips_max": stats.get("initiator_trips_max"),
         "device_served_pct": stats.get("device_served_pct"),
+        "device_served_fraction": fraction,
+        "min_device_served_fraction": SLO_MIN_DEVICE_SERVED,
         "failures": stats.get("failures"),
         "detail": stats,
     }
@@ -78,6 +93,19 @@ def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
     if out_path:
         with open(out_path, "w") as f:
             f.write(line + "\n")
+    if stats.get("failures"):
+        print(f"SLO FAIL: {stats['failures']} handshake failure(s)",
+              file=sys.stderr)
+        return 1
+    if fraction is not None and fraction < SLO_MIN_DEVICE_SERVED:
+        print(
+            f"SLO FAIL: warm run only {fraction:.1%} device-served "
+            f"(< {SLO_MIN_DEVICE_SERVED:.0%}): the device path is degraded "
+            "(breaker state "
+            f"{stats.get('breaker_state')!r}) — the 'TPU' numbers above "
+            "measure the cpu fallback", file=sys.stderr)
+        return 1
+    return 0
 
 
 def main() -> None:
@@ -142,6 +170,9 @@ def main() -> None:
                 "dispatch_rows": plateau_step,
                 "value_at_provider_dispatch": round(at_provider, 1),
                 "provider_dispatch_rows": provider_step,
+                "vs_baseline_at_provider_dispatch": round(
+                    at_provider / BASELINE_OPS_PER_S, 3
+                ),
             }
         )
     )
@@ -160,6 +191,5 @@ if __name__ == "__main__":
                     help="untimed warmup handshakes in the slo probe")
     args = ap.parse_args()
     if args.slo:
-        slo_main(args.out, args.peers, args.warmup)
-    else:
-        main()
+        raise SystemExit(slo_main(args.out, args.peers, args.warmup))
+    main()

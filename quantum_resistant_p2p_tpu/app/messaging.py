@@ -24,15 +24,14 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import hashlib
+import hmac
 import json
 import logging
 import time
 import uuid
 from pathlib import Path
 from typing import Any, Callable
-
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
 from ..net.p2p_node import P2PNode
 from ..provider import get_fused, get_kem, get_signature, get_symmetric
@@ -44,6 +43,26 @@ logger = logging.getLogger(__name__)
 REPLAY_WINDOW = 300.0  # seconds, matching the reference's timestamp check
 KEY_EXCHANGE_TIMEOUT = 20.0
 DEDUP_CAPACITY = 1000
+#: bounded retry for initiate_key_exchange: a single dropped datagram (or a
+#: transiently corrupted handshake message) no longer needs a caller-driven
+#: retry.  Retries cover timeouts and invalid_signature rejections only —
+#: structural failures (algorithm mismatch, disconnect) fail fast.
+KE_RETRY_ATTEMPTS = 2
+KE_RETRY_BACKOFF_S = 0.25
+#: session healing: a mid-session disconnect triggers reconnection (with
+#: backoff) then an automatic re-handshake; outbound messages sent during
+#: the outage are queued (bounded) and flushed after re-establishment
+HEAL_ATTEMPTS = 3
+HEAL_BACKOFF_S = 0.25
+OUTBOX_CAPACITY = 32
+#: consecutive AEAD decrypt failures from one peer before the session key is
+#: declared desynchronised/tampered and dropped for an automatic re-key (a
+#: corrupted ciphertext mid-session must trigger a rekey, never plaintext)
+REKEY_AFTER_AEAD_FAILURES = 1
+#: minimum spacing between automatic re-keys per peer: old-key messages
+#: legitimately in flight across a rekey (and attacker-sent garbage) must
+#: not force handshake churn — at most one forced handshake per window
+REKEY_COOLDOWN_S = 5.0
 #: pow2 flush buckets precompiled by the background warmup: bucket 1 (the
 #: sequential-handshake case) plus the first pow-2 buckets a small burst of
 #: concurrent handshakes coalesces into — warming ONLY size 1 (the old
@@ -80,6 +99,35 @@ def _canonical(data: dict) -> bytes:
 _HANDLED = object()
 
 
+class KeyExchangeFailed(RuntimeError):
+    """A handshake attempt failed with a typed ``reason`` (a RejectReason
+    value or a local failure tag) — carried as an attribute so the retry
+    classifier never parses message text."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"key exchange failed: {reason}")
+        self.reason = reason
+
+
+def _hkdf_sha256(ikm: bytes, salt: bytes, info: bytes, length: int = 32) -> bytes:
+    """RFC 5869 HKDF-SHA256 (extract + expand) on the stdlib.
+
+    Bit-identical to ``cryptography``'s HKDF (tests/test_faults.py pins the
+    RFC 5869 A.1 vector) but with no OpenSSL wheel dependency, so the
+    protocol engine imports and runs on minimal accelerator images — the
+    same gating provider/symmetric.py applies to the AEADs.
+    """
+    prk = hmac.new(salt or bytes(32), ikm, hashlib.sha256).digest()
+    okm = b""
+    t = b""
+    i = 1
+    while len(okm) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
 def derive_message_key(shared_secret: bytes, id_a: str, id_b: str, aead_name: str) -> bytes:
     """HKDF-SHA256 over the raw KEM secret, salted by the sorted peer ids.
 
@@ -88,12 +136,11 @@ def derive_message_key(shared_secret: bytes, id_a: str, id_b: str, aead_name: st
     re-derive a distinct key from the same secret (reference: :1797-1810).
     """
     ids = "|".join(sorted([id_a, id_b]))
-    return HKDF(
-        algorithm=hashes.SHA256(),
-        length=32,
+    return _hkdf_sha256(
+        shared_secret,
         salt=ids.encode(),
         info=b"qrp2p-tpu/msgkey/" + aead_name.encode(),
-    ).derive(shared_secret)
+    )
 
 
 class SecureMessaging:
@@ -114,6 +161,8 @@ class SecureMessaging:
         batch_floor: int = 1,
         mesh_devices: int = 0,
         sig_keypair: tuple[bytes, bytes] | None = None,
+        breaker_cooloff_s: float = 30.0,
+        auto_heal: bool = True,
     ):
         self.node = node
         self.key_storage = key_storage
@@ -150,7 +199,7 @@ class SecureMessaging:
 
             # one breaker across KEM and signature queues: they share the
             # device, so either discovering slowness shields both
-            self._queue_breaker = Breaker()
+            self._queue_breaker = Breaker(cooloff_s=breaker_cooloff_s)
             self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms,
                                     fallback=self._cpu_fallback_kem(),
                                     breaker=self._queue_breaker,
@@ -174,6 +223,14 @@ class SecureMessaging:
         self._fused_confirm: dict[str, dict] = {}
         self._processed_ids: dict[str, float] = {}
         self._listeners: list[Callable[[str, Message], None]] = []
+        #: session resilience (docs/robustness.md): peers currently being
+        #: healed, per-peer queued outbound messages, and consecutive AEAD
+        #: failure counters driving the automatic re-key
+        self.auto_heal = auto_heal
+        self._healing: set[str] = set()
+        self._outbox: dict[str, list[Message]] = {}
+        self._aead_failures: dict[str, int] = {}
+        self._last_rekey: dict[str, float] = {}
         #: strong refs to fire-and-forget tasks — the event loop only keeps
         #: weak ones, so an unreferenced task can be GC'd mid-flight
         self._bg_tasks: set[asyncio.Task] = set()
@@ -327,6 +384,165 @@ class SecureMessaging:
             self._spawn(self.request_peer_settings(peer_id), "settings gossip")
         elif event == "disconnect":
             self.ke_state[peer_id] = KeyExchangeState.NONE
+            if (
+                self.auto_heal
+                and peer_id not in self._healing
+                and self.node.should_heal(peer_id)
+            ):
+                # Mid-session drop of a peer WE dialed: reconnect with
+                # backoff, re-handshake, then flush queued outbound —
+                # instead of the old permanent dead peer.
+                self._healing.add(peer_id)
+                self._spawn(self._heal_session(peer_id), "session heal")
+
+    async def _heal_session(self, peer_id: str) -> None:
+        """Reconnect -> automatic re-handshake -> flush the outbox.
+
+        Bounded: HEAL_ATTEMPTS redials with exponential backoff (each redial
+        itself uses P2PNode.connect_to_peer's transient-failure retry); on
+        exhaustion the outbox is dropped with a loud warning — messages are
+        never silently black-holed, and never sent unencrypted.
+        """
+        try:
+            delay = HEAL_BACKOFF_S
+            for _attempt in range(HEAL_ATTEMPTS):
+                if not self.node.should_heal(peer_id):
+                    # the disconnect became intentional (stop(), explicit
+                    # API) mid-heal: the outbox must not strand silently
+                    dropped = len(self._outbox.pop(peer_id, []))
+                    if dropped:
+                        logger.warning(
+                            "session heal for %s abandoned (no longer "
+                            "healable); %d queued message(s) dropped",
+                            peer_id[:8], dropped,
+                        )
+                    return
+                await asyncio.sleep(delay)
+                delay *= 2
+                if await self.node.reconnect(peer_id):
+                    break
+            else:
+                dropped = len(self._outbox.pop(peer_id, []))
+                logger.warning(
+                    "session heal: %s unreachable after %d redials; giving up"
+                    " (%d queued message(s) dropped)",
+                    peer_id[:8], HEAL_ATTEMPTS, dropped,
+                )
+                self._log("session_heal", peer=peer_id, success=False)
+                return
+            # reconnect fired the "connect" event, which reset the session
+            # state; establish a fresh key before flushing anything
+            ok = await self.initiate_key_exchange(peer_id)
+            if not ok:
+                # a concurrent initiator (an app send, the AEAD rekey) may
+                # own the handshake ("already_in_flight"): give it a bounded
+                # moment before declaring the heal failed
+                for _ in range(40):
+                    if self.verify_key_exchange_state(peer_id):
+                        ok = True
+                        break
+                    if not self.node.is_connected(peer_id):
+                        break
+                    await asyncio.sleep(0.05)
+            if ok:
+                logger.warning(
+                    "session heal: %s reconnected and re-keyed; flushing %d "
+                    "queued message(s)",
+                    peer_id[:8], len(self._outbox.get(peer_id, [])),
+                )
+                self._log("session_heal", peer=peer_id, success=True)
+                await self._flush_outbox(peer_id)
+            else:
+                # reconnected but could not re-key: the outbox must not
+                # strand silently — drop it loudly, exactly like the
+                # unreachable case above
+                dropped = len(self._outbox.pop(peer_id, []))
+                logger.warning(
+                    "session heal: %s reconnected but re-handshake failed; "
+                    "giving up (%d queued message(s) dropped)",
+                    peer_id[:8], dropped,
+                )
+                self._log("session_heal", peer=peer_id, success=False)
+        finally:
+            self._healing.discard(peer_id)
+            # a message queued in the window between the flush completing
+            # and _healing clearing would otherwise sit until the next
+            # outage: flush the tail now that the session is live
+            if (
+                self._outbox.get(peer_id)
+                and self.verify_key_exchange_state(peer_id)
+            ):
+                self._spawn(self._flush_outbox(peer_id), "outbox tail flush")
+
+    def _queue_outbound(self, peer_id: str, content: bytes, is_file: bool,
+                        filename: str | None) -> Message | None:
+        """Park an outbound message while its session heals (bounded)."""
+        box = self._outbox.setdefault(peer_id, [])
+        if len(box) >= OUTBOX_CAPACITY:
+            logger.warning("outbox for %s full; dropping message", peer_id[:8])
+            return None
+        message = Message(
+            content=content,
+            sender_id=self.node_id,
+            recipient_id=peer_id,
+            is_file=is_file,
+            filename=filename,
+            key_exchange_algo=self.kem.name,
+            symmetric_algo=self.symmetric.name,
+            signature_algo=self.signature.name,
+        )
+        box.append(message)
+        return message
+
+    async def _flush_outbox(self, peer_id: str) -> None:
+        queued = self._outbox.pop(peer_id, [])
+        for i, message in enumerate(queued):
+            try:
+                sent = await self._encrypt_and_send(peer_id, message)
+            except Exception:
+                logger.exception("outbox flush to %s failed", peer_id[:8])
+                sent = False
+            if not sent:
+                # re-queue the unsent remainder: a send failure mid-flush
+                # (connection flapped again) re-enters the heal cycle with
+                # these messages still parked, not silently dropped
+                remainder = queued[i:]
+                self._outbox[peer_id] = remainder + self._outbox.pop(peer_id, [])
+                logger.warning(
+                    "outbox flush to %s failed; %d message(s) re-queued",
+                    peer_id[:8], len(remainder),
+                )
+                # the eviction's disconnect event fired while peer_id was
+                # still in _healing, so no new heal was spawned for it —
+                # re-enter the cycle ourselves once the current heal exits
+                # (bounded in practice: every cycle needs a successful
+                # reconnect + re-handshake to reach this line again, pays
+                # the full redial backoff, and logs loudly)
+                if self.auto_heal and self.node.should_heal(peer_id):
+                    self._spawn(self._reheal(peer_id), "session re-heal")
+                else:
+                    # no further heal possible (intentional disconnect,
+                    # node stopping): never strand silently
+                    dropped = len(self._outbox.pop(peer_id, []))
+                    logger.warning(
+                        "outbox for %s not healable; %d queued message(s) "
+                        "dropped", peer_id[:8], dropped,
+                    )
+                return
+
+    async def _reheal(self, peer_id: str) -> None:
+        """Re-enter the heal cycle after a mid-flush connection flap (the
+        flap's disconnect event was suppressed by the in-progress heal)."""
+        while peer_id in self._healing:
+            await asyncio.sleep(0.05)
+        if (
+            self.auto_heal
+            and self._outbox.get(peer_id)
+            and not self.node.is_connected(peer_id)
+            and self.node.should_heal(peer_id)
+        ):
+            self._healing.add(peer_id)
+            await self._heal_session(peer_id)
 
     # ----------------------------------------------------------- key exchange
 
@@ -339,11 +555,35 @@ class SecureMessaging:
             and self.node.is_connected(peer_id)
         )
 
-    async def initiate_key_exchange(self, peer_id: str) -> bool:
-        """Initiator side of the 5-message handshake (reference: :546-693)."""
+    async def initiate_key_exchange(self, peer_id: str,
+                                    retries: int = KE_RETRY_ATTEMPTS) -> bool:
+        """Initiator side of the 5-message handshake (reference: :546-693),
+        with bounded retry-with-backoff on TRANSIENT failures (a timed-out
+        exchange — e.g. one dropped datagram — or an invalid-signature
+        rejection from one corrupted-in-flight message).  Structural
+        failures (algorithm mismatch, keygen error, peer gone) fail fast.
+        """
+        delay = KE_RETRY_BACKOFF_S
+        for attempt in range(retries + 1):
+            status = await self._initiate_once(peer_id)
+            if status == "ok":
+                return True
+            transient = status in ("timeout", RejectReason.INVALID_SIGNATURE.value)
+            if not transient or attempt == retries or not self.node.is_connected(peer_id):
+                return False
+            logger.warning(
+                "key exchange with %s failed (%s); retry %d/%d in %.2fs",
+                peer_id[:8], status, attempt + 1, retries, delay,
+            )
+            await asyncio.sleep(delay)
+            delay *= 2
+        return False
+
+    async def _initiate_once(self, peer_id: str) -> str:
+        """One handshake attempt -> "ok" | "timeout" | a typed failure."""
         if self.ke_state.get(peer_id) == KeyExchangeState.INITIATED:
             logger.info("handshake with %s already in flight", peer_id[:8])
-            return False
+            return "already_in_flight"
         # Compatibility pre-check against gossiped peer settings (ref: :564-586).
         peer_cfg = self.peer_settings.get(peer_id)
         if peer_cfg and peer_cfg.get("kem") != self.kem.name:
@@ -351,7 +591,7 @@ class SecureMessaging:
                 "algorithm mismatch with %s: %s vs %s",
                 peer_id[:8], self.kem.name, peer_cfg.get("kem"),
             )
-            return False
+            return RejectReason.ALGORITHM_MISMATCH.value
 
         message_id = str(uuid.uuid4())
         trips0 = self._trips_now()
@@ -386,7 +626,7 @@ class SecureMessaging:
                 pk, sk = await self._kem_keygen()
             except Exception:
                 logger.exception("ephemeral keygen failed")
-                return False
+                return RejectReason.KEYGEN_ERROR.value
             ke_data["public_key"] = pk.hex()
             sig = await self._sign(_canonical(ke_data))
         else:
@@ -407,23 +647,26 @@ class SecureMessaging:
         )
         if not sent:
             self._cleanup_exchange(message_id, peer_id)
-            return False
+            return "send_failed"
         try:
             await asyncio.wait_for(fut, KEY_EXCHANGE_TIMEOUT)
             self._handshake_trips.record(self._trips_now() - trips0)
-            return True
+            return "ok"
         except asyncio.TimeoutError:
             # Timeout-but-key-exists recovery (reference: :670-681).
             if peer_id in self.shared_keys:
-                return True
+                return "ok"
             self._cleanup_exchange(message_id, peer_id)
             self._log("key_exchange", peer=peer_id, success=False, reason="timeout")
-            return False
+            return "timeout"
         except RuntimeError as e:
-            # Typed rejection from the peer (ke_reject) or a local crypto error.
+            # Typed rejection from the peer (ke_reject) or a local crypto
+            # error; KeyExchangeFailed carries the reason as an attribute
+            # so the retry loop classifies on the typed value, never on
+            # message text.
             logger.warning("key exchange with %s failed: %s", peer_id[:8], e)
             self._cleanup_exchange(message_id, peer_id)
-            return False
+            return getattr(e, "reason", "error")
 
     def _cpu_fallback_kem(self):
         """cpu-backend twin of the active KEM, arming the batch queue's
@@ -499,6 +742,19 @@ class SecureMessaging:
             out["device_trips"] = b.device_trips
             out["fallback_trips"] = b.fallback_trips
             out["breaker_trips"] = b.trips
+            out["breaker_state"] = b.state
+            out["breaker_opens"] = b.opens
+            out["breaker_closes"] = b.closes
+            # the degradation gauge across every queue of this engine
+            # (VERDICT r3: a silently cpu-served "TPU" fleet must be visible)
+            total = fb = 0
+            for fam_key in ("kem_queue", "sig_queue", "fused_queue"):
+                for q in out.get(fam_key, {}).values():
+                    total += q["ops"]
+                    fb += q["fallback_ops"]
+            out["device_served_fraction"] = (
+                round((total - fb) / total, 4) if total else None
+            )
         for algo, key in ((self.kem, "kem_opcache"), (self.signature, "sig_opcache")):
             cache = getattr(algo, "opcache", None)
             if cache is not None:
@@ -533,6 +789,24 @@ class SecureMessaging:
 
         def _warm():
             try:
+                # Device-health gate first (provider/health.py): validate the
+                # accelerated path for THIS environment before trusting it
+                # with live traffic — a failed family quarantines the shared
+                # breaker onto the cpu fallback, and HQC re-routes its FFT.
+                from ..provider import health
+
+                health.gate_facades(bkem, bsig, bfused)
+                first = bkem or bsig or bfused
+                if first is not None and first.breaker.state == "quarantined":
+                    # the facades share one breaker: a quarantine pins the
+                    # cpu fallback for the process, so compiling the device
+                    # buckets would burn minutes for a path that can never
+                    # serve traffic
+                    logger.warning(
+                        "device path quarantined by the health gate; "
+                        "skipping device warmup"
+                    )
+                    return
                 if bkem is not None:
                     bkem.warmup(WARMUP_SIZES)
                 if bsig is not None:
@@ -807,7 +1081,7 @@ class SecureMessaging:
     def _fail_pending(self, message_id: str, reason: str) -> None:
         fut = self._pending.pop(message_id, None)
         if fut is not None and not fut.done():
-            fut.set_exception(RuntimeError(f"key exchange failed: {reason}"))
+            fut.set_exception(KeyExchangeFailed(reason))
 
     async def _handle_ke_confirm(self, peer_id: str, msg: dict) -> None:
         data = msg.get("ke_data") or {}
@@ -875,9 +1149,20 @@ class SecureMessaging:
         is_file: bool = False,
         filename: str | None = None,
     ) -> Message | None:
-        """Sign-then-encrypt send (reference: :1560-1668)."""
+        """Sign-then-encrypt send (reference: :1560-1668).
+
+        While a dropped session is healing (reconnect + re-handshake in
+        flight), the message is queued in the bounded outbox and delivered —
+        encrypted under the POST-heal key — once the session re-establishes;
+        the returned Message is the queued one.  With no heal in progress
+        and no session, returns None as before (fail closed).
+        """
+        if not self.node.is_connected(peer_id) and peer_id in self._healing:
+            return self._queue_outbound(peer_id, content, is_file, filename)
         if not self.verify_key_exchange_state(peer_id):
             ok = await self.initiate_key_exchange(peer_id)
+            if not ok and peer_id in self._healing:
+                return self._queue_outbound(peer_id, content, is_file, filename)
             if not ok and peer_id not in self.shared_keys:
                 logger.warning("no shared key with %s; message not sent", peer_id[:8])
                 return None
@@ -891,6 +1176,13 @@ class SecureMessaging:
             symmetric_algo=self.symmetric.name,
             signature_algo=self.signature.name,
         )
+        if not await self._encrypt_and_send(peer_id, message):
+            return None
+        return message
+
+    async def _encrypt_and_send(self, peer_id: str, message: Message) -> bool:
+        """Sign-then-encrypt tail of send_message, shared with the outbox
+        flush (which re-encrypts queued messages under the healed key)."""
         package = {
             "message": message.to_dict(),
             "sig_algo": self.signature.name,
@@ -904,18 +1196,22 @@ class SecureMessaging:
                 "message_id": message.message_id,
                 "sender": self.node_id,
                 "recipient": peer_id,
-                "is_file": is_file,
+                "is_file": message.is_file,
             }
         )
-        ct = self.symmetric.encrypt(self.shared_keys[peer_id], _canonical(package), ad)
+        key = self.shared_keys.get(peer_id)
+        if key is None:
+            logger.warning("no shared key with %s; message not sent", peer_id[:8])
+            return False
+        ct = self.symmetric.encrypt(key, _canonical(package), ad)
         sent = await self.node.send_message(peer_id, "secure_message", ct=ct, ad=ad)
         if not sent:
-            return None
+            return False
         self._log(
-            "message_sent", peer=peer_id, size=len(content),
-            algorithm=self.symmetric.name, is_file=is_file,
+            "message_sent", peer=peer_id, size=len(message.content),
+            algorithm=self.symmetric.name, is_file=message.is_file,
         )
-        return message
+        return True
 
     async def send_file(self, peer_id: str, path: str | Path) -> Message | None:
         p = Path(path)
@@ -934,8 +1230,36 @@ class SecureMessaging:
         try:
             pt = self.symmetric.decrypt(key, msg.get("ct", b""), ad)
         except ValueError:
-            logger.warning("AEAD decrypt failed from %s", peer_id[:8])
+            # Corrupted/tampered ciphertext, or a desynchronised key.  Never
+            # plaintext; after REKEY_AFTER_AEAD_FAILURES consecutive
+            # failures, drop the session key and re-key automatically
+            # instead of silently rejecting this peer's traffic forever.
+            failures = self._aead_failures.get(peer_id, 0) + 1
+            self._aead_failures[peer_id] = failures
+            logger.warning("AEAD decrypt failed from %s (%d consecutive)",
+                           peer_id[:8], failures)
+            now = time.monotonic()
+            if now - self._last_rekey.get(peer_id, -REKEY_COOLDOWN_S) < REKEY_COOLDOWN_S:
+                # a rekey just happened: this is (very likely) an old-key
+                # message still in flight — undecryptable either way, and
+                # re-dropping the fresh key would churn forever under
+                # steady traffic (and hand any peer a one-message DoS
+                # lever forcing endless handshakes)
+                return
+            if failures >= REKEY_AFTER_AEAD_FAILURES:
+                self._aead_failures[peer_id] = 0
+                self._last_rekey[peer_id] = now
+                logger.warning(
+                    "dropping session key for %s after %d AEAD failure(s); "
+                    "re-keying", peer_id[:8], failures,
+                )
+                self.shared_keys.pop(peer_id, None)
+                self.raw_secrets.pop(peer_id, None)
+                self.ke_state[peer_id] = KeyExchangeState.NONE
+                self._log("rekey", peer=peer_id, reason="aead_failures")
+                self._spawn(self.initiate_key_exchange(peer_id), "rekey")
             return
+        self._aead_failures.pop(peer_id, None)
         try:
             package = json.loads(pt)
             message = Message.from_dict(package["message"])

@@ -1,0 +1,10 @@
+"""Deterministic fault injection for chaos-testing the self-healing stack.
+
+See :mod:`.plan` for the engine and docs/robustness.md for the fault model
+and the injection boundaries.
+"""
+
+from .plan import (ACTIONS, SCOPES, FaultInjected, FaultPlan,  # noqa: F401
+                   FaultRule, active, device_dispatch, install,
+                   instrument_scalar_ops, net_send, poison_results,
+                   scalar_op, uninstall, warmup)

@@ -336,8 +336,18 @@ def _cyclic_mul_sparse(p: HQCParams, dense: jax.Array, sup: jax.Array) -> jax.Ar
     disagree on duplicates — FFT/matmul go through ``_support_to_bits``
     where duplicates collapse to ONE hit, while the rotated-gather loop
     counts each, so a doubled position cancels mod 2.  Distinctness is the
-    stated common contract; nothing in the KEM can violate it.
+    stated common contract; nothing in the KEM can violate it, and it is
+    asserted below (under ``__debug__``, on concrete inputs only — traced
+    values cannot be inspected) so an A/B harness feeding a duplicated
+    support fails HERE, not as a silent cross-implementation divergence.
     """
+    if __debug__ and not isinstance(sup, jax.core.Tracer):
+        _s = np.sort(np.asarray(sup), axis=-1)
+        assert bool((np.diff(_s, axis=-1) != 0).all()), (
+            "_cyclic_mul_sparse: support positions must be pairwise distinct "
+            "(the FFT/matmul and rotated-gather formulations disagree on "
+            "duplicates)"
+        )
     impl = _cyclic_impl()
     if impl == "fft":
         return _cyclic_mul_fft(p, dense, sup)

@@ -26,6 +26,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
 
+from ..faults import plan as _faults
+
 logger = logging.getLogger(__name__)
 
 #: hello-response window; generous because a peer's loop can stall for a
@@ -80,6 +82,15 @@ class P2PNode:
         self._msg_handlers: dict[str, list[MessageHandler]] = {}
         self._conn_handlers: list[ConnectionHandler] = []
         self._running = False
+        #: peers THIS node dialed (only the dialing side redials on a drop —
+        #: the listening side cannot know the peer's current address)
+        self._dialed: set[str] = set()
+        #: last known (host, listen_port) per peer; survives disconnects so
+        #: session healing (app/messaging.py) can redial
+        self._addr: dict[str, tuple[str, int]] = {}
+        #: peers whose disconnect was requested locally (stop(), an explicit
+        #: disconnect): these must NOT be healed back
+        self._intentional: set[str] = set()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -143,10 +154,58 @@ class P2PNode:
         """
         for attempt in range(retries + 1):
             peer_id, retryable = await self._connect_once(host, port, timeout)
+            if peer_id is not None:
+                self._dialed.add(peer_id)
             if peer_id is not None or not retryable or attempt == retries:
                 return peer_id
             await asyncio.sleep(0.5 * (attempt + 1))
         return None
+
+    def should_heal(self, peer_id: str) -> bool:
+        """True when a dropped session to ``peer_id`` is OURS to redial:
+        this node is running, dialed the peer originally, knows an address,
+        and the disconnect was not locally requested."""
+        return (
+            self._running
+            and peer_id in self._dialed
+            and peer_id in self._addr
+            and peer_id not in self._intentional
+        )
+
+    async def reconnect(self, peer_id: str, timeout: float = 10.0,
+                        retries: int = 2) -> bool:
+        """Redial a dropped peer at its last known address (existing
+        connect backoff applies).  False when unknown, unreachable, or a
+        DIFFERENT node now answers there."""
+        addr = self._addr.get(peer_id)
+        if addr is None:
+            return False
+        prior_dialed = set(self._dialed)
+        got = await self.connect_to_peer(addr[0], addr[1], timeout, retries)
+        if got is not None and got != peer_id:
+            if got in prior_dialed:
+                # The address was reused by a node we HAD chosen to talk to
+                # (its hello just re-registered it, clobbering any previous
+                # socket): keep this verified session rather than killing a
+                # peer the heal machinery exists to protect.
+                logger.warning(
+                    "reconnect to %s reached known peer %s instead; keeping "
+                    "that session", peer_id[:8], got[:8],
+                )
+                return False
+            # A true stranger answered.  Drop the probe connection WITHOUT
+            # marking it intentional (a genuine later session stays
+            # healable) — and remove it from _dialed first, so its
+            # disconnect event cannot spawn a heal that redials a node this
+            # peer never chose.
+            logger.warning(
+                "reconnect to %s found a different node (%s); dropping it",
+                peer_id[:8], got[:8],
+            )
+            self._dialed.discard(got)
+            await self.disconnect_from_peer(got, intentional=False)
+            return False
+        return got == peer_id
 
     async def _connect_once(self, host: str, port: int,
                             timeout: float) -> tuple[str | None, bool]:
@@ -205,11 +264,19 @@ class P2PNode:
                 task.cancel()
         peer = _Peer(peer_id, reader, writer, host, port)
         self._peers[peer_id] = peer
+        self._addr[peer_id] = (host, port)
+        self._intentional.discard(peer_id)
         self._read_tasks[peer_id] = asyncio.create_task(self._read_loop(peer))
         logger.info("peer %s connected (%s:%s)", peer_id[:8], host, port)
         self._fire_connection_event("connect", peer_id)
 
-    async def disconnect_from_peer(self, peer_id: str) -> None:
+    async def disconnect_from_peer(self, peer_id: str,
+                                   intentional: bool = True) -> None:
+        """Drop a peer.  ``intentional=True`` (the default: a local request)
+        additionally marks the peer as not-to-be-healed; transport-failure
+        evictions pass False so session healing may redial."""
+        if intentional:
+            self._intentional.add(peer_id)
         peer = self._peers.pop(peer_id, None)
         task = self._read_tasks.pop(peer_id, None)
         if task:
@@ -226,13 +293,23 @@ class P2PNode:
         if peer is None:
             logger.warning("send to unknown peer %s", peer_id[:8])
             return False
+        # fault-injection boundary (faults/): a plan may drop, delay, or
+        # corrupt this message BEFORE encoding — a no-op without a plan
+        action, payload2 = _faults.net_send(self.node_id, peer_id, msg_type,
+                                            payload)
+        if action == "drop":
+            return True  # swallowed by the (simulated) network
+        if action == "delay":
+            await asyncio.sleep(payload2)
+        else:
+            payload = payload2
         message = {"type": msg_type, **{k: _encode_value(v) for k, v in payload.items()}}
         try:
             await self._send_frame(peer.writer, peer.write_lock, message)
             return True
         except (ConnectionError, OSError) as e:
             logger.warning("send to %s failed: %s; evicting", peer_id[:8], e)
-            await self.disconnect_from_peer(peer_id)
+            await self.disconnect_from_peer(peer_id, intentional=False)
             return False
 
     async def _send_frame(self, writer, lock: asyncio.Lock, message: dict) -> None:

@@ -174,7 +174,20 @@ def make_provider_mesh(devices: int, backend: str):
 
 
 class CryptoAlgorithm(abc.ABC):
-    """Common metadata for all algorithms (reference: crypto/algorithm_base.py)."""
+    """Common metadata for all algorithms (reference: crypto/algorithm_base.py).
+
+    Every concrete subclass's scalar ops (generate_keypair / encapsulate /
+    decapsulate / sign / verify / encrypt / decrypt) are instrumented with
+    the deterministic fault-injection hook (faults/) at class-creation time
+    — one module-global ``None`` check per call when no plan is installed,
+    so chaos tests never monkeypatch a provider.
+    """
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        from ..faults import instrument_scalar_ops
+
+        instrument_scalar_ops(cls)
 
     #: canonical registry name, e.g. "ML-KEM-768"
     name: str = ""

@@ -428,3 +428,133 @@ def test_batched_fused_composite_falls_back_to_per_op_cpu():
     for qname in ("keygen_sign", "encaps_verify_sign", "decaps_verify_sign"):
         assert st[qname]["fallback_flushes"] >= 1
         assert st[qname]["device_trips"] == 0
+
+
+# -- breaker state machine (closed -> open -> half-open -> closed) ------------
+
+
+def test_breaker_half_open_probe_heals_and_fraction_recovers():
+    """A transiently-raising device opens the breaker; after the cool-off
+    ONE queued flush runs as the canary probe, its success closes the
+    breaker, and every later op rides the device path again — the
+    device_served_fraction of the post-heal window is 1.0 (the r3 fix:
+    no more silently-permanent degradation)."""
+    boom = {"n": 2}  # dispatches 2..3 raise
+
+    def device(items):
+        boom["n"] -= 0  # keep a stable reference
+        if boom.get("fail"):
+            raise RuntimeError("transient device fault")
+        return [("dev", x) for x in items]
+
+    async def run():
+        br = Breaker(cooloff_s=0.05)
+        q = OpQueue(device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: [("cpu", x) for x in items],
+                    breaker=br)
+        q._warm_buckets.add(1)
+        a = await q.submit(1)                 # closed: device
+        assert a == ("dev", 1) and br.state == "closed"
+        boom["fail"] = True
+        b = await q.submit(2)                 # device raises -> fallback, OPEN
+        assert b == ("cpu", 2) and br.state == "open"
+        c = await q.submit(3)                 # open: fallback, device untouched
+        assert c == ("cpu", 3)
+        boom["fail"] = False                  # device recovers
+        await asyncio.sleep(0.08)             # cool-off expires
+        pre = q.stats.ops - q.stats.fallback_ops
+        d = await q.submit(4)                 # half-open canary probe: device
+        assert d == ("dev", 4) and br.state == "closed"
+        outs = [await q.submit(i) for i in range(5, 10)]
+        assert outs == [("dev", i) for i in range(5, 9 + 1)]
+        post = (q.stats.ops - q.stats.fallback_ops) - pre
+        assert post == 6  # probe + 5 healed ops: post-heal fraction is 1.0
+        return br, q.stats.as_dict()
+
+    br, st = asyncio.run(run())
+    assert br.opens == 1 and br.closes == 1 and br.trips == 1
+    assert br.cooloff_s == br.base_cooloff_s  # reset on close
+    assert 0 < st["device_served_fraction"] < 1  # cumulative gauge visible
+
+
+def test_breaker_probe_failure_reopens_with_exponential_backoff():
+    """A failed canary re-opens the breaker with a doubled (capped)
+    cool-off; only ONE probe dispatch reaches the still-broken device per
+    half-open window."""
+    device_calls = []
+
+    def device(items):
+        device_calls.append(len(items))
+        raise RuntimeError("still broken")
+
+    async def run():
+        br = Breaker(cooloff_s=0.04, cooloff_max_s=0.1)
+        q = OpQueue(device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: [("cpu", x) for x in items],
+                    breaker=br)
+        q._warm_buckets.add(1)
+        await q.submit(1)                     # trip 1: cooloff 0.04
+        assert br.state == "open" and abs(br.cooloff_s - 0.04) < 1e-9
+        await asyncio.sleep(0.06)
+        await q.submit(2)                     # probe fails: cooloff 0.08
+        assert br.state == "open" and abs(br.cooloff_s - 0.08) < 1e-9
+        await asyncio.sleep(0.1)
+        await q.submit(3)                     # probe fails: capped at 0.1
+        assert abs(br.cooloff_s - 0.1) < 1e-9
+        # while open, a burst of flushes must not touch the device at all
+        n_dev = len(device_calls)
+        outs = [await q.submit(i) for i in range(4, 8)]
+        assert outs == [("cpu", i) for i in range(4, 8)]
+        assert len(device_calls) == n_dev
+        return br
+
+    br = asyncio.run(run())
+    assert br.trips == 3 and br.closes == 0
+    assert len(device_calls) == 3  # one per closed/half-open window
+
+
+def test_breaker_quarantine_pins_fallback_forever():
+    """A health-gate quarantine (wrong answers, not slowness) pins the cpu
+    fallback: no cool-off, no probe, for the process lifetime."""
+    device_calls = []
+
+    async def run():
+        br = Breaker(cooloff_s=0.01)
+        q = OpQueue(lambda items: device_calls.append(len(items)) or items,
+                    max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: [("cpu", x) for x in items],
+                    breaker=br)
+        q._warm_buckets.add(1)
+        br.quarantine("KAT mismatch")
+        assert br.state == "quarantined" and br.is_open()
+        await asyncio.sleep(0.03)             # a cool-off would have expired
+        outs = [await q.submit(i) for i in range(3)]
+        assert outs == [("cpu", i) for i in range(3)]
+        br.trip()                             # later trips cannot demote it
+        assert br.state == "quarantined"
+        return q.stats
+
+    st = asyncio.run(run())
+    assert device_calls == [] and st.fallback_ops == 3
+
+
+def test_device_exception_serves_waiters_from_fallback():
+    """A raising device dispatch (worker crash / injected fault) must not
+    fail its waiters when a fallback is armed: ops are re-served from the
+    cpu and the failure is recorded to the breaker."""
+
+    def device(items):
+        raise RuntimeError("XLA worker died")
+
+    async def run():
+        q = OpQueue(device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: [("cpu", x) for x in items],
+                    breaker=Breaker(cooloff_s=60.0))
+        q._warm_buckets.update({1, 2})
+        out = await asyncio.gather(q.submit(1), q.submit(2))
+        return out, q.stats
+
+    out, st = asyncio.run(run())
+    assert out == [("cpu", 1), ("cpu", 2)]
+    assert st.breaker_trips == 1 and st.fallback_ops == 2
+    assert st.as_dict()["device_served_fraction"] == 0.0

@@ -1,0 +1,239 @@
+"""provider/health.py — the device-health gate.
+
+The accelerated path must be re-validated per environment (PQC-HA,
+arXiv:2308.06621) before it is trusted: correct providers pass (and the
+verdict is cached keyed by the environment fingerprint), wrong answers
+quarantine the batched facade's breaker onto the cpu fallback, and the HQC
+FFT gate re-routes to the Toeplitz product.  Negative verdicts are never
+cached — a transient device fault must re-probe at next startup.
+"""
+
+import asyncio
+import hashlib
+import hmac
+import os
+
+import pytest
+
+from quantum_resistant_p2p_tpu.provider import health
+from quantum_resistant_p2p_tpu.provider.base import (KeyExchangeAlgorithm,
+                                                     SignatureAlgorithm)
+from quantum_resistant_p2p_tpu.provider.batched import BatchedKEM, Breaker
+
+
+class _GoodKEM(KeyExchangeAlgorithm):
+    name = "GOOD-KEM"
+    public_key_len = secret_key_len = ciphertext_len = 32
+
+    def __init__(self, backend="tpu"):
+        self.backend = backend
+        self.probes = 0
+
+    def generate_keypair(self):
+        self.probes += 1
+        sk = os.urandom(32)
+        return hashlib.sha256(b"pk" + sk).digest(), sk
+
+    def encapsulate(self, public_key):
+        ct = os.urandom(32)
+        return ct, hashlib.sha256(public_key + ct).digest()
+
+    def decapsulate(self, secret_key, ciphertext):
+        pk = hashlib.sha256(b"pk" + secret_key).digest()
+        return hashlib.sha256(pk + ciphertext).digest()
+
+
+class _BrokenKEM(_GoodKEM):
+    """Device computes a WRONG shared secret on decaps (a numerics fault a
+    latency breaker can never see)."""
+
+    name = "BROKEN-KEM"
+
+    def decapsulate(self, secret_key, ciphertext):
+        return hashlib.sha256(b"wrong" + secret_key + ciphertext).digest()
+
+
+class _GoodSig(SignatureAlgorithm):
+    name = "GOOD-SIG"
+    public_key_len = secret_key_len = signature_len = 32
+
+    def __init__(self, backend="tpu"):
+        self.backend = backend
+
+    def generate_keypair(self):
+        sk = os.urandom(32)
+        return hashlib.sha256(b"pk" + sk).digest(), sk
+
+    def sign(self, secret_key, message):
+        pk = hashlib.sha256(b"pk" + secret_key).digest()
+        return hashlib.sha256(b"sig" + pk + message).digest()
+
+    def verify(self, public_key, message, signature):
+        return hmac.compare_digest(
+            signature, hashlib.sha256(b"sig" + public_key + message).digest()
+        )
+
+
+class _RubberStampSig(_GoodSig):
+    """Accepts anything — the tamper check must catch it."""
+
+    name = "STAMP-SIG"
+
+    def verify(self, public_key, message, signature):
+        return True
+
+
+@pytest.fixture(autouse=True)
+def tmp_health_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("QRP2P_HEALTH_CACHE", str(tmp_path / "health"))
+    monkeypatch.delenv("QRP2P_HEALTH_GATE", raising=False)
+    yield tmp_path / "health"
+
+
+def test_fingerprint_names_the_numerics_axes():
+    key = health.env_fingerprint()
+    for axis in ("jax=", "jaxlib=", "platform=", "dev=", "probe="):
+        assert axis in key
+
+
+def test_cpu_backend_needs_no_gate():
+    v = health.ensure_validated(_GoodKEM(backend="cpu"))
+    assert v.ok and "cpu backend" in v.detail
+
+
+def test_positive_verdict_cached_per_environment(tmp_health_cache):
+    kem = _GoodKEM()
+    v1 = health.ensure_validated(kem, cpu_twin=_GoodKEM("cpu"))
+    assert v1.ok and not v1.cached
+    probes = kem.probes
+    assert list(tmp_health_cache.glob("health_*.json"))
+    v2 = health.ensure_validated(kem, cpu_twin=_GoodKEM("cpu"))
+    assert v2.ok and v2.cached
+    assert kem.probes == probes  # no re-probe: the disk verdict was trusted
+
+
+def test_wrong_answers_fail_and_are_never_cached(tmp_health_cache):
+    kem = _BrokenKEM()
+    v1 = health.ensure_validated(kem)
+    assert not v1.ok and "decaps" in v1.detail
+    assert not list(tmp_health_cache.glob("health_*.json"))
+    probes = kem.probes
+    v2 = health.ensure_validated(kem)  # self-healing: re-probed, not pinned
+    assert not v2.ok and kem.probes == probes + 1
+
+
+def test_cross_impl_disagreement_detected():
+    """Device internally consistent but disagreeing with the cpu reference
+    must fail (the PQC-HA per-environment re-validation)."""
+
+    class SelfConsistentButWrong(_GoodKEM):
+        name = "DRIFTED-KEM"
+
+        def encapsulate(self, public_key):
+            ct = os.urandom(32)
+            return ct, hashlib.sha256(b"drift" + public_key + ct).digest()
+
+        def decapsulate(self, secret_key, ciphertext):
+            pk = hashlib.sha256(b"pk" + secret_key).digest()
+            return hashlib.sha256(b"drift" + pk + ciphertext).digest()
+
+    v = health.ensure_validated(SelfConsistentButWrong(), cpu_twin=_GoodKEM("cpu"))
+    assert not v.ok and "cpu reference" in v.detail
+
+
+def test_rubber_stamp_verify_fails_tamper_check():
+    v = health.ensure_validated(_RubberStampSig())
+    assert not v.ok and "tampered" in v.detail
+
+
+def test_gate_facades_quarantines_broken_device_onto_fallback():
+    """A failed family pins the facade's shared breaker on the cpu fallback
+    for the process: wrong answers cannot be probed back to health."""
+    kem = BatchedKEM(_BrokenKEM(), max_batch=4, max_wait_ms=1.0,
+                     fallback=_GoodKEM("cpu"), breaker=Breaker(cooloff_s=0.01))
+    for q in (kem._kg, kem._enc, kem._dec):
+        q._warm_buckets.add(1)
+    verdicts = health.gate_facades(kem)
+    assert [v.ok for v in verdicts] == [False]
+    assert kem.breaker.state == "quarantined"
+
+    async def run():
+        pk, sk = await kem.generate_keypair()
+        ct, ss = await kem.encapsulate(pk)
+        assert await kem.decapsulate(sk, ct) == ss  # GOOD math: the fallback
+        return kem.stats()
+
+    st = asyncio.run(run())
+    assert st["decaps"]["device_trips"] == 0
+    assert st["decaps"]["device_served_fraction"] == 0.0
+
+
+def test_gate_facades_leaves_healthy_device_closed():
+    kem = BatchedKEM(_GoodKEM(), max_batch=4, max_wait_ms=1.0,
+                     fallback=_GoodKEM("cpu"), breaker=Breaker(cooloff_s=0.01))
+    verdicts = health.gate_facades(kem)
+    assert [v.ok for v in verdicts] == [True]
+    assert kem.breaker.state == "closed"
+
+
+def test_gate_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("QRP2P_HEALTH_GATE", "0")
+    kem = BatchedKEM(_BrokenKEM(), max_batch=4, max_wait_ms=1.0,
+                     fallback=_GoodKEM("cpu"), breaker=Breaker(cooloff_s=0.01))
+    assert health.gate_facades(kem) == []
+    assert kem.breaker.state == "closed"
+
+
+def test_hqc_gate_reroutes_to_toeplitz(monkeypatch):
+    """An unvalidated FFT environment routes HQC to the exact Toeplitz
+    product (kem.hqc's forced impl) and says why; the verdict is healed
+    (ok) and never disk-cached by health (hqc owns its marker)."""
+    from quantum_resistant_p2p_tpu.kem import hqc as H
+
+    monkeypatch.setattr(H, "_FORCED_IMPL", None)
+    monkeypatch.setattr(H, "_fft_env_validated", lambda: False)
+    monkeypatch.delenv("QRP2P_HQC_FFT", raising=False)
+    monkeypatch.delenv("QRP2P_HQC_GATHER", raising=False)
+    monkeypatch.delenv("QRP2P_HQC_SELFCHECK", raising=False)
+
+    class FakeHQC(_GoodKEM):
+        name = "HQC-128"
+
+    v = health.ensure_validated(FakeHQC())
+    assert v.ok and "re-routed" in v.detail and not v.cacheable
+    assert H._FORCED_IMPL == "matmul"
+
+
+def test_mlkem_kat_pins_the_device_path():
+    """The pinned FIPS 203 vector (computed from pyref) passes through the
+    jax path — the per-environment KAT the gate runs for the flagship
+    family."""
+    pytest.importorskip("jax")
+
+    class FakeMLKEM(_GoodKEM):
+        name = "ML-KEM-768"
+
+    v = health.ensure_validated(FakeMLKEM())
+    assert v.ok and "KAT ok" in v.detail
+
+
+def test_fused_facade_probe_validates_against_cpu_twins():
+    """The composite fused-handshake path is its own device code path; the
+    gate probes keygen_sign at the LIVE offsets against the cpu twins."""
+    pytest.importorskip("jax")
+    from quantum_resistant_p2p_tpu.provider import get_fused, get_kem, get_signature
+    from quantum_resistant_p2p_tpu.provider.batched import BatchedFused
+    from quantum_resistant_p2p_tpu.provider.fused_providers import (
+        init_pk_offset, resp_ct_offset)
+
+    fused = get_fused(get_kem("ML-KEM-512", "tpu"),
+                      get_signature("ML-DSA-44", "tpu"))
+    bf = BatchedFused(fused, pk_off=init_pk_offset("ML-KEM-512", "AES-256-GCM"),
+                      ct_off=resp_ct_offset(), max_batch=4, max_wait_ms=1.0,
+                      fallback_kem=get_kem("ML-KEM-512", "cpu"),
+                      fallback_sig=get_signature("ML-DSA-44", "cpu"),
+                      breaker=Breaker(cooloff_s=0.01))
+    verdicts = health.gate_facades(bf)
+    assert [v.ok for v in verdicts] == [True]
+    assert bf.breaker.state == "closed"
+    assert verdicts[0].family.startswith("fused:")

@@ -444,6 +444,95 @@ def test_provider_contract_covers_fused_capability(tmp_path):
                for s in suppressed)
 
 
+# -- dispatch/breaker discipline ----------------------------------------------
+
+
+def test_dispatch_except_no_breaker_fires_on_swallowed_dispatch_failure():
+    """Trigger: an except around a device dispatch (a batch_fn call or a
+    device-executor submission) that neither re-raises nor records the
+    failure to the breaker."""
+    # scope to the rule under test: the same fixtures legitimately also
+    # trip the independent broad-except rule
+    ids = [i for i in rule_ids(
+        """
+        class Q:
+            def run(self, items):
+                try:
+                    return self.batch_fn(items)
+                except Exception:
+                    return None
+
+            async def run2(self, loop, items):
+                try:
+                    fut = loop.run_in_executor(self.breaker.device_executor,
+                                               self.batch_fn, items)
+                    return await fut
+                except TimeoutError:
+                    return None
+        """
+    ) if i == "dispatch-except-no-breaker"]
+    assert ids == ["dispatch-except-no-breaker"] * 2
+
+
+def test_dispatch_except_no_breaker_clean_when_recorded_or_reraised():
+    """Clean twins: recording to the breaker (record_failure / trip / a
+    *_trip_breaker helper) or re-raising satisfies the rule; narrow
+    excepts and non-dispatch try bodies are out of scope."""
+    ids = [i for i in rule_ids(
+        """
+        class Q:
+            def run(self, items):
+                try:
+                    return self.batch_fn(items)
+                except Exception:
+                    self.breaker.record_failure("device")
+                    return None
+
+            def run2(self, items):
+                try:
+                    return self.batch_fn(items)
+                except Exception as exc:
+                    self._trip_breaker("raised", 0.0, "device")
+                    return None
+
+            def run3(self, items):
+                try:
+                    return self.batch_fn(items)
+                except Exception:
+                    raise
+
+            def run4(self, items):
+                try:
+                    return self.batch_fn(items)
+                except ValueError:   # narrow: not this rule's concern
+                    return None
+
+            def run5(self, items):
+                try:
+                    return self.other_fn(items)  # not a dispatch
+                except Exception:
+                    return None
+        """
+    ) if i == "dispatch-except-no-breaker"]
+    assert ids == []
+
+
+def test_dispatch_except_no_breaker_suppression():
+    findings, suppressed = lint(
+        """
+        class Q:
+            def run(self, items):
+                try:
+                    return self.batch_fn(items)
+                except Exception:  # qrlint: disable=dispatch-except-no-breaker, broad-except
+                    return None
+        """
+    )
+    assert [f.rule for f in findings] == []
+    assert sorted(s.rule for s in suppressed) == [
+        "broad-except", "dispatch-except-no-breaker"]
+
+
 # -- engine mechanics ---------------------------------------------------------
 
 

@@ -1,4 +1,4 @@
-"""Provider-contract rule pack (cross-file).
+"""Provider-contract rule pack (cross-file) + dispatch/breaker discipline.
 
 The registry (provider/registry.py) is the only seam between the protocol
 engine and the crypto backends: ``SecureMessaging`` calls whatever the
@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import ast
 
-from .engine import Project, Rule, call_name
+from .engine import FileContext, Project, Rule, call_name, dotted_name, last_attr
 
 _BASE_SUFFIX = "provider/base.py"
 _REGISTRY_SUFFIX = "provider/registry.py"
@@ -210,4 +210,93 @@ class ProviderContractRule(Rule):
                 )
 
 
-PROVIDER_RULES = (ProviderContractRule,)
+class DispatchExceptBreakerRule(Rule):
+    """The round-3 regression class: a device dispatch wrapped in an
+    ``except`` that swallows the failure WITHOUT recording it to the circuit
+    breaker leaves the degrade/heal machinery blind — the fleet silently
+    stays (or silently goes) degraded.  Any ``try`` whose body performs a
+    device dispatch (a ``batch_fn(...)`` call, or ``run_in_executor`` given
+    the breaker's device/warm-up executor or a ``batch_fn`` callable) must
+    have every broad/``Exception``/``TimeoutError`` handler either re-raise
+    or RECORD THE FAILURE to the breaker (``trip`` / ``record_failure`` /
+    ``quarantine`` / a ``*trip_breaker*`` helper).  ``release`` and
+    ``record_success`` deliberately do NOT count: releasing a claim records
+    no outcome and the success path is exactly what a swallowed failure
+    must not take.
+    """
+
+    id = "dispatch-except-no-breaker"
+    description = (
+        "except around a device dispatch neither re-raises nor records the "
+        "failure to the circuit breaker (trip/record_failure/_trip_breaker)"
+    )
+
+    #: called-function names that ARE a device dispatch
+    _DISPATCH_CALLEES = {"batch_fn", "_device_call", "_warm_call"}
+    #: executor attributes whose run_in_executor submissions are dispatches
+    _DISPATCH_EXECUTORS = {"device_executor", "warmup_executor"}
+    #: handler calls that count as recording the FAILURE to the breaker
+    #: (release/record_success do not: no outcome / the success path)
+    _BREAKER_CALLS = {"trip", "record_failure", "quarantine"}
+
+    def start_file(self, ctx: FileContext):
+        return {ast.Try: lambda n: self._check(ctx, n)}
+
+    def _is_dispatch_call(self, call: ast.Call) -> bool:
+        name = last_attr(call.func)
+        if name in self._DISPATCH_CALLEES:
+            return True
+        if name == "run_in_executor":
+            for arg in call.args:
+                dotted = dotted_name(arg) or ""
+                leaf = dotted.rsplit(".", 1)[-1]
+                if (leaf in self._DISPATCH_CALLEES
+                        or leaf in self._DISPATCH_EXECUTORS):
+                    return True
+        return False
+
+    def _body_dispatches(self, try_node: ast.Try) -> bool:
+        for stmt in try_node.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and self._is_dispatch_call(node):
+                    return True
+        return False
+
+    @staticmethod
+    def _is_broad(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True  # bare except
+        types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+                 else [handler.type])
+        for t in types:
+            name = last_attr(t) or ""
+            if name in ("Exception", "BaseException", "TimeoutError"):
+                return True
+        return False
+
+    def _handler_records(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = last_attr(node.func) or ""
+                if name in self._BREAKER_CALLS or "trip_breaker" in name:
+                    return True
+        return False
+
+    def _check(self, ctx: FileContext, node: ast.Try) -> None:
+        if not self._body_dispatches(node):
+            return
+        for handler in node.handlers:
+            if self._is_broad(handler) and not self._handler_records(handler):
+                ctx.report(
+                    self, handler,
+                    "except around a device dispatch swallows the failure "
+                    "without recording it to the circuit breaker; call "
+                    "breaker.record_failure()/trip() (or a *_trip_breaker "
+                    "helper) or re-raise so degradation stays visible and "
+                    "healable",
+                )
+
+
+PROVIDER_RULES = (ProviderContractRule, DispatchExceptBreakerRule)

@@ -239,6 +239,13 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
                     fb_ops += q["fallback_ops"]
         stats["device_served_pct"] = round(
             100.0 * (total_ops - fb_ops) / total_ops, 1) if total_ops else None
+        # the 0..1 gauge tooling gates on (bench.py --slo fails <0.9): the
+        # r3 "silent CPU swarm" regression must be caught by the harness
+        stats["device_served_fraction"] = round(
+            (total_ops - fb_ops) / total_ops, 4) if total_ops else None
+        stats["breaker_state"] = hub._queue_breaker.state if hub._queue_breaker else None
+        stats["breaker_opens"] = hub._queue_breaker.opens if hub._queue_breaker else 0
+        stats["breaker_closes"] = hub._queue_breaker.closes if hub._queue_breaker else 0
         # Measured dispatch trips (never inferred): breaker delta over the
         # measured window across both sides.  In slo mode the window holds
         # ONLY handshakes, so the per-handshake quotient is exact at
