@@ -109,6 +109,19 @@ class KeyExchangeFailed(RuntimeError):
         self.reason = reason
 
 
+def _wipe(buf) -> None:
+    """Best-effort in-place zeroization of a mutable secret buffer.
+
+    Secrets this engine must shorten the lifetime of (ephemeral KEM secret
+    keys, per-peer raw shared secrets) are stored as ``bytearray`` so that
+    dropping them can actually clear the bytes — ``bytes`` copies made
+    transiently by providers are immutable and left to the GC (a documented
+    CPython limitation, not a policy choice).
+    """
+    if isinstance(buf, bytearray):
+        buf[:] = b"\x00" * len(buf)
+
+
 def _hkdf_sha256(ikm: bytes, salt: bytes, info: bytes, length: int = 32) -> bytes:
     """RFC 5869 HKDF-SHA256 (extract + expand) on the stdlib.
 
@@ -211,12 +224,16 @@ class SecureMessaging:
             self._bfused = self._make_fused()
             self._spawn_warmup()
 
-        # per-peer protocol state
+        # per-peer protocol state.  raw_secrets values are bytearrays so
+        # every drop path (rekey, reconnect, hot-swap) can zeroize in place
+        # (_wipe) instead of leaving the KEM secret to the GC.
         self.shared_keys: dict[str, bytes] = {}
-        self.raw_secrets: dict[str, bytes] = {}  # for AEAD-change re-derive
+        self.raw_secrets: dict[str, bytearray] = {}  # for AEAD-change re-derive
         self.ke_state: dict[str, KeyExchangeState] = {}
         self.peer_settings: dict[str, dict] = {}
-        self._ephemeral: dict[str, tuple[str, bytes]] = {}  # msg_id -> (peer, sk)
+        #: msg_id -> (peer, ephemeral KEM sk) — sk is a bytearray so every
+        #: drop path can zeroize it in place (_wipe)
+        self._ephemeral: dict[str, tuple[str, bytearray]] = {}
         self._pending: dict[str, asyncio.Future] = {}
         #: msg_id -> confirm transcript signed by the fused initiator step,
         #: parked so _handle_ke_response sends EXACTLY the signed bytes
@@ -379,7 +396,7 @@ class SecureMessaging:
         if event == "connect":
             # Fresh handshake per session: drop any stale key (ref: :447-452).
             self.shared_keys.pop(peer_id, None)
-            self.raw_secrets.pop(peer_id, None)
+            _wipe(self.raw_secrets.pop(peer_id, None))
             self.ke_state[peer_id] = KeyExchangeState.NONE
             self._spawn(self.request_peer_settings(peer_id), "settings gossip")
         elif event == "disconnect":
@@ -631,7 +648,7 @@ class SecureMessaging:
             sig = await self._sign(_canonical(ke_data))
         else:
             ke_data["public_key"] = pk.hex()
-        self._ephemeral[message_id] = (peer_id, sk)
+        self._ephemeral[message_id] = (peer_id, bytearray(sk))
         self.ke_state[peer_id] = KeyExchangeState.INITIATED
 
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -828,8 +845,18 @@ class SecureMessaging:
                 None, self._warmup_thread.join, timeout
             )
 
+    def _drop_ephemeral(self, message_id: str) -> None:
+        """Drop an exchange's ephemeral KEM sk, zeroizing it in place — the
+        single chokepoint for every drop path, so a future path cannot
+        forget the wipe.  In-flight decapsulations are safe: the handlers
+        pass an immutable COPY of the sk to the crypto layer, never the
+        wiped buffer itself."""
+        entry = self._ephemeral.pop(message_id, None)
+        if entry is not None:
+            _wipe(entry[1])
+
     def _cleanup_exchange(self, message_id: str, peer_id: str) -> None:
-        self._ephemeral.pop(message_id, None)
+        self._drop_ephemeral(message_id)
         self._pending.pop(message_id, None)
         if self.ke_state.get(peer_id) == KeyExchangeState.INITIATED:
             self.ke_state[peer_id] = KeyExchangeState.NONE
@@ -896,7 +923,7 @@ class SecureMessaging:
         """Responder success tail, shared by the per-op and fused ke_init
         paths (contractually wire-identical): adopt the shared secret and
         send the signed ke_response."""
-        self.raw_secrets[peer_id] = secret
+        self._adopt_secret(peer_id, secret)
         self.shared_keys[peer_id] = derive_message_key(
             secret, self.node_id, peer_id, self.symmetric.name
         )
@@ -984,17 +1011,22 @@ class SecureMessaging:
                 self._fail_pending(message_id, err.value)
                 return
             try:
-                secret = await self._kem_decaps(entry[1], bytes.fromhex(data["ciphertext"]))
+                # decapsulate a COPY: if the handshake timeout fires during
+                # this await, _cleanup_exchange wipes the stored bytearray —
+                # which must not zero the operand mid-decapsulation
+                secret = await self._kem_decaps(bytes(entry[1]),
+                                                bytes.fromhex(data["ciphertext"]))
             except Exception:
                 logger.exception("decapsulation failed")
                 self._fail_pending(message_id, "decapsulation_error")
                 return
             finally:
-                # Delete the ephemeral secret key immediately (reference: :1041).
-                self._ephemeral.pop(message_id, None)
+                # Delete AND zeroize the ephemeral secret key immediately
+                # (reference: :1041) — decapsulation is done with it either way.
+                self._drop_ephemeral(message_id)
             sig = None
 
-        self.raw_secrets[peer_id] = secret
+        self._adopt_secret(peer_id, secret)
         key = derive_message_key(secret, self.node_id, peer_id, self.symmetric.name)
         self.shared_keys[peer_id] = key
         self.ke_state[peer_id] = KeyExchangeState.CONFIRMED
@@ -1043,7 +1075,7 @@ class SecureMessaging:
         err = self._check_host(peer_id, data)
         if err is not None:
             self._fail_pending(message_id, err.value)
-            self._ephemeral.pop(message_id, None)
+            self._drop_ephemeral(message_id)
             return _HANDLED
         try:
             ct = bytes.fromhex(data.get("ciphertext", ""))
@@ -1063,8 +1095,10 @@ class SecureMessaging:
             "timestamp": time.time(),
         }
         try:
+            # COPY of the ephemeral sk: a timeout-path wipe racing this
+            # await must not zero the composite dispatch's operand
             ok, secret, sig = await f.decaps_verify_sign(
-                entry[1], ct, sig_pk, _canonical(data), sig_in,
+                bytes(entry[1]), ct, sig_pk, _canonical(data), sig_in,
                 self._sig_keypair[1], _canonical(confirm),
             )
         except Exception:
@@ -1072,9 +1106,9 @@ class SecureMessaging:
             return None
         if not ok:
             self._fail_pending(message_id, RejectReason.INVALID_SIGNATURE.value)
-            self._ephemeral.pop(message_id, None)
+            self._drop_ephemeral(message_id)
             return _HANDLED
-        self._ephemeral.pop(message_id, None)
+        self._drop_ephemeral(message_id)  # composite decaps used a copy
         self._fused_confirm[message_id] = confirm
         return secret, sig
 
@@ -1128,10 +1162,17 @@ class SecureMessaging:
         message_id = str(msg.get("message_id", ""))
         reason = str(msg.get("reason", "unknown"))
         logger.warning("key exchange rejected by %s: %s", peer_id[:8], reason)
-        self._ephemeral.pop(message_id, None)
+        self._drop_ephemeral(message_id)
         self.ke_state[peer_id] = KeyExchangeState.NONE
         self._log("key_exchange", peer=peer_id, success=False, reason=reason)
         self._fail_pending(message_id, reason)
+
+    def _adopt_secret(self, peer_id: str, secret: bytes) -> None:
+        """Install a session's raw KEM shared secret, zeroizing any
+        predecessor in place (rekey/re-handshake must not extend the old
+        secret's lifetime)."""
+        _wipe(self.raw_secrets.get(peer_id))
+        self.raw_secrets[peer_id] = bytearray(secret)
 
     def _save_peer_key(self, peer_id: str, secret: bytes) -> None:
         if self.key_storage is not None and getattr(self.key_storage, "is_unlocked", False):
@@ -1254,7 +1295,7 @@ class SecureMessaging:
                     "re-keying", peer_id[:8], failures,
                 )
                 self.shared_keys.pop(peer_id, None)
-                self.raw_secrets.pop(peer_id, None)
+                _wipe(self.raw_secrets.pop(peer_id, None))
                 self.ke_state[peer_id] = KeyExchangeState.NONE
                 self._log("rekey", peer=peer_id, reason="aead_failures")
                 self._spawn(self.initiate_key_exchange(peer_id), "rekey")
@@ -1334,6 +1375,12 @@ class SecureMessaging:
 
     async def set_key_exchange_algorithm(self, name: str) -> None:
         """Drop all shared keys and re-handshake (reference: :1741-1781)."""
+        old_cache = getattr(self.kem, "opcache", None)
+        if old_cache is not None:
+            # the outgoing provider's operand cache pins key-derived device
+            # state; the swap ends those keys' sessions, so end their cache
+            # lifetime too (qrflow secret-lifetime audit)
+            old_cache.zeroize()
         self.kem = get_kem(name, self.backend, devices=self.mesh_devices)
         if self.use_batching:
             from ..provider.batched import BatchedKEM
@@ -1346,6 +1393,8 @@ class SecureMessaging:
             self._spawn_warmup(kem=True, sig=False)
         peers = list(self.shared_keys)
         self.shared_keys.clear()
+        for stale in self.raw_secrets.values():
+            _wipe(stale)
         self.raw_secrets.clear()
         for peer_id in peers:
             self.ke_state[peer_id] = KeyExchangeState.NONE
@@ -1375,6 +1424,9 @@ class SecureMessaging:
 
     async def set_signature_algorithm(self, name: str) -> None:
         """Lazily load-or-generate the new keypair (reference: :1827-1851)."""
+        old_cache = getattr(self.signature, "opcache", None)
+        if old_cache is not None:
+            old_cache.zeroize()  # sk-derived device precomputes die with the swap
         self.signature = get_signature(name, self.backend,
                                        devices=self.mesh_devices)
         if self.use_batching:

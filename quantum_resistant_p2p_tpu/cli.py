@@ -355,11 +355,11 @@ class CLI:
 
                 raw = base64.b64decode(v["key"])  # save_peer_shared_key stores b64
                 if fmt == "hex":
-                    self.print(f"  hex: {raw.hex()}")
+                    self.print(f"  hex: {raw.hex()}")  # qrlint: disable=flow-secret-format — /key IS the user-invoked decrypt-and-display command (YES-confirmed + audit-logged), parity with the reference's key-view dialog
                 elif fmt == "base64":
-                    self.print(f"  base64: {base64.b64encode(raw).decode()}")
+                    self.print(f"  base64: {base64.b64encode(raw).decode()}")  # qrlint: disable=flow-secret-format — /key IS the user-invoked decrypt-and-display command (YES-confirmed + audit-logged)
                 else:
-                    self.print(f"  decimal: {' '.join(str(b) for b in raw)}")
+                    self.print(f"  decimal: {' '.join(str(b) for b in raw)}")  # qrlint: disable=flow-secret-format — /key IS the user-invoked decrypt-and-display command (YES-confirmed + audit-logged)
         elif cmd == "/delkey":
             ok = self.storage.delete_key_history(args[0])
             self.secure_logger.log_event("key_history_changed", deleted=args[0], ok=ok)

@@ -126,6 +126,13 @@ class Breaker:
     """
 
     def __init__(self, cooloff_s: float = 30.0, cooloff_max_s: float = 480.0):
+        import threading
+
+        #: guards every state-machine mutation: the breaker is shared between
+        #: the event loop (dispatch outcomes) and the warmup thread (the
+        #: device-health gate quarantines from there) — qrflow's
+        #: cross-thread-state pack proved the unlocked writes racy
+        self._lock = threading.RLock()
         self.base_cooloff_s = cooloff_s
         self.cooloff_s = cooloff_s  # current (grows exponentially while open)
         self.cooloff_max_s = cooloff_max_s
@@ -156,34 +163,37 @@ class Breaker:
 
     def is_open(self) -> bool:
         """True while no regular device dispatch may proceed."""
-        if self.state == "quarantined":
-            return True
-        return self.state == "open" and time.monotonic() < self._open_until
+        with self._lock:
+            if self.state == "quarantined":
+                return True
+            return self.state == "open" and time.monotonic() < self._open_until
 
     def _set_state(self, new: str, why: str = "") -> None:
-        if new == self.state:
-            return
-        log = logging.getLogger(__name__)
-        self.state = new
-        if new == "open":
-            self.opens += 1
-            log.warning(
-                "circuit breaker OPEN (%s): device dispatch path degraded; "
-                "serving from cpu fallback for %.1fs, then probing",
-                why or "tripped", self.cooloff_s,
-            )
-        elif new == "closed":
-            self.closes += 1
-            self.cooloff_s = self.base_cooloff_s
-            log.warning(
-                "circuit breaker CLOSED: device canary probe succeeded; "
-                "traffic restored to the device path"
-            )
-        elif new == "quarantined":
-            log.error(
-                "circuit breaker QUARANTINED (%s): device path disabled for "
-                "this process; all ops served from the cpu fallback", why,
-            )
+        """Transition + loud log.  Callers hold ``self._lock`` (RLock)."""
+        with self._lock:
+            if new == self.state:
+                return
+            log = logging.getLogger(__name__)
+            self.state = new
+            if new == "open":
+                self.opens += 1
+                log.warning(
+                    "circuit breaker OPEN (%s): device dispatch path degraded; "
+                    "serving from cpu fallback for %.1fs, then probing",
+                    why or "tripped", self.cooloff_s,
+                )
+            elif new == "closed":
+                self.closes += 1
+                self.cooloff_s = self.base_cooloff_s
+                log.warning(
+                    "circuit breaker CLOSED: device canary probe succeeded; "
+                    "traffic restored to the device path"
+                )
+            elif new == "quarantined":
+                log.error(
+                    "circuit breaker QUARANTINED (%s): device path disabled for "
+                    "this process; all ops served from the cpu fallback", why,
+                )
 
     def trip(self) -> None:
         """Record a device failure observed outside the claim protocol
@@ -200,66 +210,73 @@ class Breaker:
         refreshes the clock (or re-opens), so one incident's concurrent
         dispatches cannot compound the backoff or race the live canary.
         A quarantined breaker stays quarantined."""
-        self.trips += 1
-        if self.state == "quarantined":
-            return
-        if escalate:
-            self.cooloff_s = min(self.cooloff_s * 2.0, self.cooloff_max_s)
-        elif self.state == "closed":
-            self.cooloff_s = self.base_cooloff_s
-        self._open_until = time.monotonic() + self.cooloff_s
-        if self.state == "open":
-            logging.getLogger(__name__).debug(
-                "circuit breaker already open: cool-off clock refreshed "
-                "(concurrent dispatch of the same incident)"
-            )
-        else:
-            self._set_state(
-                "open", "canary probe failed" if escalate else "tripped"
-            )
+        with self._lock:
+            self.trips += 1
+            if self.state == "quarantined":
+                return
+            if escalate:
+                self.cooloff_s = min(self.cooloff_s * 2.0, self.cooloff_max_s)
+            elif self.state == "closed":
+                self.cooloff_s = self.base_cooloff_s
+            self._open_until = time.monotonic() + self.cooloff_s
+            if self.state == "open":
+                logging.getLogger(__name__).debug(
+                    "circuit breaker already open: cool-off clock refreshed "
+                    "(concurrent dispatch of the same incident)"
+                )
+            else:
+                self._set_state(
+                    "open", "canary probe failed" if escalate else "tripped"
+                )
 
     def quarantine(self, why: str) -> None:
         """Pin the fallback for the process lifetime (device-health gate:
         the device path computes WRONG answers, which no latency probe can
-        detect)."""
-        self.trips += 1
-        self._set_state("quarantined", why)
+        detect).  Runs on the WARMUP THREAD — the lock is what makes it safe
+        against concurrent loop-side trips."""
+        with self._lock:
+            self.trips += 1
+            self._set_state("quarantined", why)
 
     def acquire_dispatch(self) -> str:
         """Claim the next armed flush's route: ``"device"`` (closed),
         ``"probe"`` (half-open canary — exactly one in flight), or
         ``"fallback"``.  Pair with :meth:`record_success` /
         :meth:`record_failure` / :meth:`release`."""
-        if self.state == "closed":
-            return "device"
-        if self.state == "quarantined":
-            return "fallback"
-        if self.state == "open":
-            if time.monotonic() < self._open_until:
+        with self._lock:
+            if self.state == "closed":
+                return "device"
+            if self.state == "quarantined":
                 return "fallback"
-            self._set_state("half_open")
-        if self._probe_in_flight:
-            return "fallback"
-        self._probe_in_flight = True
-        return "probe"
+            if self.state == "open":
+                if time.monotonic() < self._open_until:
+                    return "fallback"
+                self._set_state("half_open")
+            if self._probe_in_flight:
+                return "fallback"
+            self._probe_in_flight = True
+            return "probe"
 
     def record_success(self, claim: str) -> None:
-        if claim == "probe":
-            self._probe_in_flight = False
-            self._set_state("closed")
+        with self._lock:
+            if claim == "probe":
+                self._probe_in_flight = False
+                self._set_state("closed")
 
     def record_failure(self, claim: str) -> None:
-        if claim == "probe":
-            self._probe_in_flight = False
-            self._trip(escalate=True)
-        else:
-            self._trip(escalate=False)
+        with self._lock:
+            if claim == "probe":
+                self._probe_in_flight = False
+                self._trip(escalate=True)
+            else:
+                self._trip(escalate=False)
 
     def release(self, claim: str) -> None:
         """Return an un-dispatched claim (e.g. the flush went to the warm-up
         path instead) without recording an outcome."""
-        if claim == "probe":
-            self._probe_in_flight = False
+        with self._lock:
+            if claim == "probe":
+                self._probe_in_flight = False
 
     def register_queue(self, queue: "OpQueue") -> None:
         self._queues.add(queue)
@@ -375,7 +392,13 @@ class OpQueue:
         self.breaker.register_queue(self)
         #: pow2 sizes whose device program has completed at least once; a
         #: cold bucket's ops are served by the fallback while the compile
-        #: runs in the background (never hostage to a compile)
+        #: runs in the background (never hostage to a compile).  Guarded by
+        #: ``_warm_lock``: facade warmups mark buckets from the WARMUP
+        #: THREAD while loop-side dispatches read and mutate the same sets
+        #: (qrflow cross-thread-state).
+        import threading
+
+        self._warm_lock = threading.Lock()
         self._warm_buckets: set[int] = set()
         self._warming: set[int] = set()
         self.stats = QueueStats()
@@ -386,6 +409,14 @@ class OpQueue:
         #: strong refs to in-flight dispatch tasks: the loop holds only weak
         #: references, so an unreferenced flush could be GC'd mid-dispatch
         self._dispatch_tasks: set[asyncio.Task] = set()
+
+    def mark_warm(self, bucket: int) -> None:
+        """Record that ``bucket``'s device program is compiled.  Thread-safe:
+        the facades' ``warmup()`` runs on the background warmup thread while
+        the event loop reads/mutates the same sets mid-dispatch."""
+        with self._warm_lock:
+            self._warming.discard(bucket)
+            self._warm_buckets.add(bucket)
 
     async def submit(self, item: Any) -> Any:
         loop = asyncio.get_running_loop()
@@ -479,26 +510,33 @@ class OpQueue:
             return await self._run_fallback(items)
         bucket = max(self.bucket_floor, _next_pow2(len(items)))
         scale = max(1.0, bucket / self.degrade_ref_batch)
-        if bucket not in self._warm_buckets:
+        with self._warm_lock:
+            is_warm = bucket in self._warm_buckets
+            start_warm = not is_warm and bucket not in self._warming
+            if start_warm:
+                self._warming.add(bucket)
+        if not is_warm:
             # A bucket's first device dispatch is a jit compile — tens of
             # seconds cold, easily past the protocol timeout.  Never hold
             # live ops hostage to a compile: serve them from the cpu NOW and
             # warm the bucket in the background (the nice-19 1-thread warmup
             # pool serialises compiles; the device takes over once warm).
             self.breaker.release(claim)  # nothing dispatches on this claim
-            if bucket not in self._warming:
-                self._warming.add(bucket)
+            if start_warm:
                 self._count_trip()
                 warm = loop.run_in_executor(self.breaker.warmup_executor,
                                             self._warm_call, items)
 
                 def _mark(f, b=bucket):
-                    self._warming.discard(b)
                     if f.cancelled():
+                        with self._warm_lock:
+                            self._warming.discard(b)
                         return
                     if f.exception() is None:
-                        self._warm_buckets.add(b)
+                        self.mark_warm(b)
                     else:
+                        with self._warm_lock:
+                            self._warming.discard(b)
                         logging.getLogger(__name__).warning(
                             "bucket %d warm-up failed: %s", b, f.exception()
                         )
@@ -511,8 +549,11 @@ class OpQueue:
                 # a later flush retries; the stuck thread, if any, still
                 # occupies only the 1-thread warmup pool.
                 def _unstick(b=bucket, w=warm):
-                    if not w.done() and b in self._warming:
-                        self._warming.discard(b)
+                    with self._warm_lock:
+                        stuck = not w.done() and b in self._warming
+                        if stuck:
+                            self._warming.discard(b)
+                    if stuck:
                         logging.getLogger(__name__).warning(
                             "bucket %d warm-up still running after %.0fs; "
                             "will retry on a later flush", b,
@@ -720,7 +761,7 @@ class BatchedKEM:
                 self.algo.encapsulate_batch(same)  # cache miss: _enc_cold
                 self.algo.encapsulate_batch(same)  # cache hit:  _enc_pre
             for q in (self._kg, self._enc, self._dec):
-                q._warm_buckets.add(n2)
+                q.mark_warm(n2)  # runs on the warmup thread: locked handoff
 
     async def generate_keypair(self) -> tuple[bytes, bytes]:
         return await self._kg.submit(None)
@@ -836,7 +877,7 @@ class BatchedSignature:
                 sigs_d = self.algo.sign_batch(sks_d, [b"warmup"] * n2)
                 self.algo.verify_batch(pks_d, [b"warmup"] * n2, sigs_d)
             for q in (self._sign, self._verify):
-                q._warm_buckets.add(n2)
+                q.mark_warm(n2)  # runs on the warmup thread: locked handoff
 
     async def sign(self, secret_key: bytes, message: bytes) -> bytes:
         return await self._sign.submit((secret_key, message))
@@ -1095,7 +1136,8 @@ class BatchedFused:
         buckets = sorted({max(self.bucket_floor, _next_pow2(n)) for n in sizes})
         self.fused.warmup(tuple(buckets), pk_off=self.pk_off, ct_off=self.ct_off)
         for q in (self._kg, self._enc, self._dec):
-            q._warm_buckets.update(buckets)
+            for b in buckets:
+                q.mark_warm(b)  # runs on the warmup thread: locked handoff
 
     def stats(self) -> dict[str, Any]:
         return {

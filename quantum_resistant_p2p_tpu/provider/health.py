@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import hmac
 import json
 import logging
 import os
@@ -99,16 +100,16 @@ def _cache_dir() -> pathlib.Path:
     return pathlib.Path(_CACHE_DIR)
 
 
-def _marker(family: str, key: str) -> pathlib.Path:
-    digest = hashlib.sha256(f"{family}|{key}".encode()).hexdigest()[:16]
+def _marker(family: str, fingerprint: str) -> pathlib.Path:
+    digest = hashlib.sha256(f"{family}|{fingerprint}".encode()).hexdigest()[:16]
     return _cache_dir() / f"health_{digest}.json"
 
 
-def _read_cached(family: str, key: str) -> HealthVerdict | None:
+def _read_cached(family: str, fingerprint: str) -> HealthVerdict | None:
     """Positive cached verdict for (family, environment), else None."""
     try:
-        rec = json.loads(_marker(family, key).read_text())
-        if (isinstance(rec, dict) and rec.get("key") == key
+        rec = json.loads(_marker(family, fingerprint).read_text())
+        if (isinstance(rec, dict) and rec.get("key") == fingerprint
                 and rec.get("family") == family and rec.get("ok")):
             return HealthVerdict(family, True, rec.get("detail", "cached"),
                                  cached=True)
@@ -117,14 +118,14 @@ def _read_cached(family: str, key: str) -> HealthVerdict | None:
     return None
 
 
-def _write_cached(family: str, key: str, verdict: HealthVerdict) -> None:
+def _write_cached(family: str, fingerprint: str, verdict: HealthVerdict) -> None:
     if not verdict.ok or not verdict.cacheable:
         return  # negative verdicts re-probe every startup (self-healing)
     try:
         d = _cache_dir()
         d.mkdir(parents=True, exist_ok=True)
-        _marker(family, key).write_text(json.dumps(
-            {"family": family, "key": key, "ok": True,
+        _marker(family, fingerprint).write_text(json.dumps(
+            {"family": family, "key": fingerprint, "ok": True,
              "detail": verdict.detail}
         ))
     except OSError:
@@ -188,9 +189,10 @@ def _check_kem_roundtrip(algo, cpu_twin) -> HealthVerdict:
     """Device roundtrip + cross-implementation agreement with the cpu twin."""
     pk, sk = algo.generate_keypair()
     ct, ss = algo.encapsulate(pk)
-    if algo.decapsulate(sk, ct) != ss:
+    if not hmac.compare_digest(algo.decapsulate(sk, ct), ss):
         return HealthVerdict(algo.name, False, "device decaps != device encaps")
-    if cpu_twin is not None and cpu_twin.decapsulate(sk, ct) != ss:
+    if cpu_twin is not None and not hmac.compare_digest(
+            cpu_twin.decapsulate(sk, ct), ss):
         return HealthVerdict(
             algo.name, False,
             "cpu reference decaps disagrees with device encaps",
@@ -252,7 +254,7 @@ def _check_fused(facade) -> HealthVerdict:
             "(device-side render/sign numerics)",
         )
     ct, ss = cpu_kem.encapsulate(pk)
-    if cpu_kem.decapsulate(ksk, ct) != ss:
+    if not hmac.compare_digest(cpu_kem.decapsulate(ksk, ct), ss):
         return HealthVerdict(
             name, False, "fused keygen keypair fails the cpu KEM roundtrip",
         )
@@ -295,8 +297,8 @@ def ensure_validated(algo, cpu_twin=None) -> HealthVerdict:
     family = getattr(algo, "name", type(algo).__name__)
     if getattr(algo, "backend", "cpu") != "tpu":
         return HealthVerdict(family, True, "cpu backend; no device to gate")
-    key = env_fingerprint()
-    cached = _read_cached(family, key)
+    fingerprint = env_fingerprint()
+    cached = _read_cached(family, fingerprint)
     if cached is not None:
         return cached
     try:
@@ -304,7 +306,7 @@ def ensure_validated(algo, cpu_twin=None) -> HealthVerdict:
     except Exception as e:
         logger.exception("device-health probe for %s crashed", family)
         verdict = HealthVerdict(family, False, f"probe crashed: {e!r}")
-    _write_cached(family, key, verdict)
+    _write_cached(family, fingerprint, verdict)
     return verdict
 
 
@@ -352,8 +354,8 @@ def _ensure_fused_validated(facade) -> HealthVerdict:
     ensure_validated; the cache key carries the live transcript offsets —
     jit keys on them, so a different protocol layout re-probes)."""
     family = f"fused:{facade.fused.name}@{facade.pk_off}"
-    key = env_fingerprint()
-    cached = _read_cached(family, key)
+    fingerprint = env_fingerprint()
+    cached = _read_cached(family, fingerprint)
     if cached is not None:
         return cached
     try:
@@ -362,5 +364,5 @@ def _ensure_fused_validated(facade) -> HealthVerdict:
         logger.exception("device-health probe for %s crashed", family)
         verdict = HealthVerdict(family, False, f"probe crashed: {e!r}")
     verdict.family = family
-    _write_cached(family, key, verdict)
+    _write_cached(family, fingerprint, verdict)
     return verdict

@@ -78,6 +78,16 @@ class DeviceOperandCache:
         with self._lock:
             self._entries.clear()
 
+    def zeroize(self) -> None:
+        """End the cached keys' device-state lifetime (same convention as
+        SecureLogger.zeroize / KeyStorage.lock).  Sign-path entries are
+        KEY-EQUIVALENT material: an algorithm hot-swap or shutdown must not
+        leave them pinned on device — dropping the references releases the
+        buffers to the runtime (host code cannot overwrite device memory, so
+        release is the strongest zeroization available here).  Called by
+        SecureMessaging's hot-swap paths."""
+        self.clear()
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

@@ -179,7 +179,7 @@ class MLDSASignature(_MeshDispatchMixin, SignatureAlgorithm):
                 self.params.name, sks, mus, rnds
             )
         elif (self.opcache is not None and self._mesh is None
-              and (n == 1 or (sks[0] == sks).all())):
+              and (n == 1 or (sks[0] == sks).all())):  # qrlint: disable=flow-secret-compare — single-key-batch detection compares the node's OWN sk rows for identity; timing reveals batch homogeneity (operational fact), not key content
             # Single-key batch — the steady state (one node, one long-lived
             # sig key): a hit skips the sk upload + ExpandA + key NTTs; a
             # miss runs the cache-filling combined program.  One dispatch

@@ -16,6 +16,8 @@ for the 192/256-bit sets.
 
 from __future__ import annotations
 
+# qrlint: disable-file=cross-thread-state — ADRS address words are mutated freely per FIPS 205 idiom, but every ADRS instance is constructed inside the signing/verify call that mutates it (never stored on a shared object), so multi-domain callers each own a private instance
+
 import hashlib
 import hmac as hmac_mod
 from dataclasses import dataclass

@@ -777,7 +777,7 @@ def sign_mu_compact(name: str, sk, mu, rnd, *,
         kappa_d = kappa_d.at[idx_d[:live]].set(kappa_r[:live])
         done_host = np.asarray(done_r)[:live]  # tiny d2h transfer
         done_out[idx[done_host]] = True
-        idx = idx[~done_host]
+        idx = idx[~done_host]  # qrlint: disable=flow-secret-branch — ML-DSA rejection-sampling bookkeeping: which rows finished per round is public by FIPS 204 design (iteration counts leak, coefficients don't)
     return np.asarray(sig_out), done_out
 
 

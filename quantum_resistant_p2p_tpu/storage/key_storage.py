@@ -297,7 +297,11 @@ class KeyStorage:
             old_master = _derive_key(old_password, _unb64(vault["salt"]), vault["kdf"])
         except Exception:  # qrlint: disable=broad-except  — same contract as unlock(): any KDF failure means "wrong password" -> False
             return False
-        if old_master != self._master:
+        import hmac
+
+        # constant-time: a byte-wise != would leak how much of the derived
+        # master key matches (qrflow flow-secret-compare)
+        if not hmac.compare_digest(old_master, self._master):
             return False
         # Decrypt all entries under the old keys.
         plain: list[tuple[str, Any]] = []

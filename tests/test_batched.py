@@ -558,3 +558,80 @@ def test_device_exception_serves_waiters_from_fallback():
     assert out == [("cpu", 1), ("cpu", 2)]
     assert st.breaker_trips == 1 and st.fallback_ops == 2
     assert st.as_dict()["device_served_fraction"] == 0.0
+
+
+def test_breaker_mutations_are_thread_safe():
+    """Regression for the qrflow cross-thread-state finding: the breaker is
+    mutated from the event loop (dispatch outcomes) AND the warmup thread
+    (health-gate quarantine).  N concurrent trip() calls from worker threads
+    must never lose a count, and a quarantine racing loop-side trips must
+    stick — both fail intermittently without Breaker._lock."""
+    import threading
+
+    br = Breaker(cooloff_s=60.0)
+    N_THREADS, N_TRIPS = 16, 200
+    start = threading.Barrier(N_THREADS)
+
+    def hammer():
+        start.wait()
+        for _ in range(N_TRIPS):
+            br.trip()
+
+    threads = [threading.Thread(target=hammer) for _ in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert br.trips == N_THREADS * N_TRIPS
+    assert br.state == "open"
+
+    # quarantine from a "warmup thread" while loop-side failures keep landing
+    br2 = Breaker(cooloff_s=60.0)
+    stop = threading.Event()
+
+    def loop_side():
+        while not stop.is_set():
+            br2.record_failure("device")
+
+    t = threading.Thread(target=loop_side)
+    t.start()
+    try:
+        quarantiner = threading.Thread(
+            target=br2.quarantine, args=("health gate failed",))
+        quarantiner.start()
+        quarantiner.join()
+    finally:
+        stop.set()
+        t.join()
+    assert br2.state == "quarantined"           # later trips cannot demote it
+    assert br2.acquire_dispatch() == "fallback"
+
+
+def test_mark_warm_from_thread_is_visible_to_loop_dispatch():
+    """Regression for the qrflow OpQueue._warm_buckets finding: the facade
+    warmup marks buckets from the warmup thread; a loop-side flush must see
+    the marking (locked handoff, no direct set poke) and take the device
+    path instead of re-warming."""
+    import threading
+
+    device_calls = []
+
+    def device(items):
+        device_calls.append(len(items))
+        return [("dev", x) for x in items]
+
+    async def run():
+        q = OpQueue(device, max_batch=4, max_wait_ms=1.0,
+                    fallback_fn=lambda items: [("cpu", x) for x in items],
+                    breaker=Breaker(cooloff_s=60.0))
+        t = threading.Thread(target=q.mark_warm, args=(1,))
+        t.start()
+        t.join()
+        assert 1 in q._warm_buckets and 1 not in q._warming
+        out = await q.submit("a")
+        return out, q.stats
+
+    out, st = asyncio.run(run())
+    assert out == ("dev", "a")
+    assert device_calls == [1]
+    assert st.fallback_ops == 0 and st.device_trips == 1

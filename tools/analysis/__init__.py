@@ -20,6 +20,11 @@ rule packs:
 
 Run: ``python -m tools.analysis.run quantum_resistant_p2p_tpu`` (or the
 ``qrlint`` console script).  Docs: docs/static_analysis.md.
+
+The ``flow`` subpackage (**qrflow**) is the whole-program half built on
+this engine: an interprocedural secret-taint / constant-time analysis and
+a cross-thread shared-state race detector, run as a second CI ratchet —
+``python -m tools.analysis.flow.run quantum_resistant_p2p_tpu``.
 """
 
 from __future__ import annotations

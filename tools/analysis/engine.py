@@ -82,6 +82,10 @@ class FileContext:
         self.suppressed: list[Finding] = []
         self._line_disables: dict[int, set[str]] = {}
         self._file_disables: set[str] = set()
+        #: lazy (start, end) line spans of every statement, for suppression
+        #: matching when a finding is reported OUTSIDE the walk (cross-file
+        #: and dataflow rules have no ctx.stack to find the enclosing stmt)
+        self._stmt_spans: list[tuple[int, int]] | None = None
         for lineno, line in enumerate(self.lines, start=1):
             m = _SUPPRESS_RE.search(line)
             if not m:
@@ -129,13 +133,38 @@ class FileContext:
     def _is_suppressed(self, finding: Finding, node: ast.AST) -> bool:
         if finding.rule in self._file_disables:
             return True
-        candidates = {finding.line}
+        candidates = set(
+            range(getattr(node, "lineno", finding.line),
+                  (getattr(node, "end_lineno", None) or finding.line) + 1)
+        )
+        candidates.add(finding.line)
         stmt = self.enclosing_statement(node)
-        if stmt is not None:
+        if stmt is None:
+            # reported outside the walk (cross-file / dataflow rules): find
+            # the smallest statement whose span contains the node instead
+            span = self._containing_stmt_span(getattr(node, "lineno", finding.line))
+            if span is not None:
+                candidates.update(range(span[0], span[1] + 1))
+        else:
             candidates.update(range(stmt.lineno, (stmt.end_lineno or stmt.lineno) + 1))
         return any(
             finding.rule in self._line_disables.get(line, ()) for line in candidates
         )
+
+    def _containing_stmt_span(self, line: int) -> tuple[int, int] | None:
+        """(start, end) of the smallest statement covering ``line``, or None."""
+        if self._stmt_spans is None:
+            self._stmt_spans = [
+                (n.lineno, n.end_lineno or n.lineno)
+                for n in ast.walk(self.tree)
+                if isinstance(n, ast.stmt) and not isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+            ]
+        best: tuple[int, int] | None = None
+        for start, end in self._stmt_spans:
+            if start <= line <= end and (best is None or (end - start) < (best[1] - best[0])):
+                best = (start, end)
+        return best
 
 
 class Project:

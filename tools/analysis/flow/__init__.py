@@ -1,0 +1,47 @@
+"""qrflow — interprocedural secret-taint / constant-time analysis and a
+cross-thread shared-state race detector, layered on the qrlint engine.
+
+qrlint (tools/analysis) is per-file and per-function: it cannot see a
+decapsulated shared secret flowing through three call frames into a log
+line, or an attribute mutated from both the warmup thread and the asyncio
+event loop.  qrflow adds the whole-program half:
+
+* callgraph.py — a project-wide call graph: name/attribute resolution
+  through module imports, ``self`` method dispatch (including subclass
+  overrides), ``functools.partial``, provider-registry dispatch
+  (``get_kem``/``get_signature``/``get_fused`` calls resolve to every
+  registered implementation), and async/await, thread-target, executor,
+  and loop-callback edges.
+* taint.py — a forward interprocedural taint analysis over a small
+  lattice (PUBLIC < ZEROIZED < SECRET_DERIVED < SECRET) with per-function
+  summaries computed to fixpoint (the summary cache keeps CI runs fast)
+  and crypto-op models (keygen/encaps/decaps/sign/verify/AEAD) so
+  signatures and ciphertexts stay public while shared secrets stay secret.
+* domains.py — per-object ownership domains (event-loop-owned,
+  thread-owned, executor-owned, lock-guarded) inferred from where
+  attributes are written, feeding the race pack.
+* packs.py — the two analysis packs as qrlint ``Rule`` objects:
+  secret-flow / constant-time (``flow-secret-*``) and the cross-thread
+  race pack (``cross-thread-state`` / ``asyncio-off-loop``), plus the
+  suppression-justification ratchet (``unjustified-suppression``).
+* sarif.py / run.py — human, JSON, and SARIF 2.1.0 output and the CLI:
+  ``python -m tools.analysis.flow.run quantum_resistant_p2p_tpu`` (or the
+  ``qrflow`` console script).
+
+Suppression uses the same inline convention as qrlint
+(``# qrlint: disable=rule-id — one-line justification``); qrflow
+additionally REQUIRES the justification for its own rule ids.
+Docs: docs/static_analysis.md (qrflow section).
+"""
+
+from __future__ import annotations
+
+from ..engine import Rule  # noqa: F401  (re-export for rule authors)
+
+
+def flow_rules() -> list[Rule]:
+    """All qrflow rules, instantiated fresh (they share one cached
+    analysis per project run)."""
+    from .packs import FLOW_RULES
+
+    return [cls() for cls in FLOW_RULES]

@@ -1,0 +1,609 @@
+"""Project-wide call graph for qrflow.
+
+One indexing pass over every parsed file builds function/class/module
+tables; a second pass resolves call sites to project functions.  The
+resolution ladder, most precise first:
+
+1. lexical names — nested functions (closures), module functions, and
+   ``from x import y`` imports of linted modules;
+2. ``self.m(...)`` — the enclosing class's MRO (name-based, like the
+   provider-contract rule) plus subclass overrides, since a self call can
+   dispatch to either;
+3. typed receivers — locals/attributes assigned from ``ClassName(...)``
+   or from a provider-registry getter (``get_kem``/``get_signature``/
+   ``get_fused``/``get_symmetric``), which resolve to every implementation
+   class named at a ``register_*`` call site (registry dispatch);
+4. fallback — a method name defined by at most ``FALLBACK_MAX`` project
+   classes resolves to all of them (sound-ish; wildly common names stay
+   unresolved rather than connecting everything to everything).
+
+Besides plain calls the graph records DEFERRED edges with a kind that the
+ownership-domain inference (domains.py) seeds from: ``thread``
+(``threading.Thread(target=...)``), ``executor`` (``run_in_executor`` /
+``.submit``), ``loop_cb`` (``call_soon``/``call_later``/asyncio
+``add_done_callback``), ``task`` (``create_task``/``ensure_future``),
+``partial`` (``functools.partial`` — bound arguments feed the taint
+pass), ``await`` (async edges), and ``ref`` (a bare function reference
+passed as an argument).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+from ..engine import FileContext, Project, dotted_name, last_attr
+
+#: a method name defined by more than this many classes is too generic to
+#: fallback-resolve (precision over recall)
+FALLBACK_MAX = 8
+
+#: attribute calls that MUTATE their receiver's container attribute
+#: (``x.attr.add(v)`` counts as a write of ``attr`` for the race pack)
+MUTATORS = {
+    "add", "append", "extend", "update", "insert", "remove", "discard",
+    "pop", "popitem", "clear", "setdefault", "move_to_end", "record",
+}
+
+_REGISTRY_GETTERS = {
+    "get_kem": "register_kem",
+    "get_signature": "register_signature",
+    "get_fused": "register_fused",
+    "get_symmetric": "_AEADS",
+}
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    fid: str
+    name: str
+    qualname: str
+    node: ast.AST
+    ctx: FileContext
+    path: str
+    class_name: str | None
+    parent: "FunctionInfo | None"
+    is_async: bool
+    params: list[str]
+    children: dict[str, "FunctionInfo"] = dataclasses.field(default_factory=dict)
+
+    @property
+    def is_init(self) -> bool:
+        return self.name in ("__init__", "__post_init__")
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    ctx: FileContext
+    path: str
+    bases: list[str]
+    methods: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    #: attributes assigned to ``self`` anywhere in the class (plus
+    #: dataclass-style annotated fields)
+    attrs: set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    ctx: FileContext
+    functions: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    classes: dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    #: local alias -> ("module/path/suffix", imported-name-or-None)
+    imports: dict[str, tuple[str, str | None]] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CallSite:
+    caller: FunctionInfo
+    callee: FunctionInfo
+    node: ast.AST
+    kind: str  # call | await | partial | thread | executor | loop_cb | task | ref
+    label: str = ""   # thread name, when known
+    bound: int = 0    # positional args bound by a partial
+
+
+def _base_names(cls: ast.ClassDef) -> list[str]:
+    out = []
+    for base in cls.bases:
+        name = last_attr(base)
+        if name:
+            out.append(name)
+    return out
+
+
+def _import_suffix(module: str | None, level: int) -> str:
+    """Best-effort path suffix for an imported module (relative imports
+    drop the dots; absolute imports keep the dotted tail)."""
+    return (module or "").replace(".", "/")
+
+
+class CallGraph:
+    """Functions, classes, and resolved call edges of one project run."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: dict[str, FunctionInfo] = {}
+        self.modules: dict[str, ModuleInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}          # last definition wins
+        self.by_method_name: dict[str, list[FunctionInfo]] = {}
+        self.subclasses: dict[str, set[str]] = {}
+        self.registry_impls: dict[str, set[str]] = {g: set() for g in _REGISTRY_GETTERS}
+        #: class name -> attr -> set of class names the attr may hold
+        self.class_attr_types: dict[str, dict[str, set[str]]] = {}
+        self.edges: list[CallSite] = []
+        self.edges_by_caller: dict[str, list[CallSite]] = {}
+        self.edges_by_callee: dict[str, list[CallSite]] = {}
+        #: id(Call node) -> call sites resolved from that exact node
+        self.edges_at: dict[int, list[CallSite]] = {}
+
+        for ctx in project.contexts.values():
+            self._index_module(ctx)
+        self._index_registry()
+        self._index_subclasses()
+        self._index_attr_types()
+        for mod in self.modules.values():
+            for fn in _walk_functions(mod):
+                self._build_edges(fn, mod)
+
+    # -- indexing -------------------------------------------------------------
+
+    def _index_module(self, ctx: FileContext) -> None:
+        mod = ModuleInfo(ctx.path, ctx)
+        self.modules[ctx.path] = mod
+        # imports anywhere in the module (function-local deferred imports are
+        # idiomatic here — ``from ..provider import health`` inside the warmup
+        # closure — and must still resolve for domain propagation)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                suffix = _import_suffix(node.module, node.level)
+                for alias in node.names:
+                    mod.imports.setdefault(alias.asname or alias.name,
+                                           (suffix, alias.name))
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imports.setdefault(alias.asname or alias.name,
+                                           (alias.name.replace(".", "/"), None))
+
+        def index_fn(node, class_name, parent, prefix):
+            qualname = f"{prefix}{node.name}"
+            fid = f"{ctx.path}::{qualname}"
+            params = [a.arg for a in [*node.args.posonlyargs, *node.args.args]]
+            fn = FunctionInfo(
+                fid=fid, name=node.name, qualname=qualname, node=node, ctx=ctx,
+                path=ctx.path, class_name=class_name, parent=parent,
+                is_async=isinstance(node, ast.AsyncFunctionDef), params=params,
+            )
+            self.functions[fid] = fn
+            if parent is not None:
+                parent.children[node.name] = fn
+            for child in node.body:
+                index_stmt(child, class_name, fn, f"{qualname}.<locals>.")
+            return fn
+
+        def index_stmt(node, class_name, parent_fn, prefix):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = index_fn(node, class_name, parent_fn, prefix)
+                if class_name is not None and parent_fn is None:
+                    cls = mod.classes[class_name]
+                    cls.methods[node.name] = fn
+                    self.by_method_name.setdefault(node.name, []).append(fn)
+                elif parent_fn is None:
+                    mod.functions[node.name] = fn
+            elif isinstance(node, ast.ClassDef) and parent_fn is None:
+                cls = ClassInfo(node.name, node, ctx, ctx.path, _base_names(node))
+                mod.classes[node.name] = cls
+                self.classes[node.name] = cls
+                for item in node.body:
+                    if isinstance(item, ast.AnnAssign) and isinstance(item.target, ast.Name):
+                        cls.attrs.add(item.target.id)   # dataclass-style field
+                    index_stmt(item, node.name, None, f"{node.name}.")
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                        targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                        for t in targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                cls.attrs.add(t.attr)
+            else:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        index_stmt(child, class_name, parent_fn, prefix)
+
+        for node in ctx.tree.body:
+            index_stmt(node, None, None, "")
+
+    def _index_registry(self) -> None:
+        """Classes named at ``register_*`` call sites (and in the AEAD
+        table) — what a registry getter's result can be at runtime."""
+        inv = {v: k for k, v in _REGISTRY_GETTERS.items()}
+        for ctx in self.project.contexts.values():
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    fname = (dotted_name(node.func) or "").split(".")[-1]
+                    getter = inv.get(fname)
+                    if getter is None:
+                        continue
+                    for sub in ast.walk(node):
+                        if (isinstance(sub, ast.Call)
+                                and isinstance(sub.func, ast.Name)
+                                and sub.func.id[:1].isupper()):
+                            self.registry_impls[getter].add(sub.func.id)
+                elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    names = [getattr(t, "id", None) for t in targets]
+                    if "_AEADS" in names and isinstance(node.value, ast.Dict):
+                        for v in node.value.values:
+                            if isinstance(v, ast.Name):
+                                self.registry_impls["get_symmetric"].add(v.id)
+
+    def _index_subclasses(self) -> None:
+        for cls in self.classes.values():
+            for base in cls.bases:
+                self.subclasses.setdefault(base, set()).add(cls.name)
+
+    def _transitive_subclasses(self, name: str) -> set[str]:
+        out: set[str] = set()
+        stack = [name]
+        while stack:
+            for sub in self.subclasses.get(stack.pop(), ()):
+                if sub not in out:
+                    out.add(sub)
+                    stack.append(sub)
+        return out
+
+    def _index_attr_types(self) -> None:
+        """``self.attr = ClassName(...)`` / ``self.attr = get_kem(...)``
+        assignments, collected class-wide (flow-insensitive)."""
+        for cls in self.classes.values():
+            table = self.class_attr_types.setdefault(cls.name, {})
+            for node in ast.walk(cls.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                types = self.value_types(node.value, {})
+                if not types:
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name) and t.value.id == "self"):
+                        table.setdefault(t.attr, set()).update(types)
+
+    # -- type-ish resolution --------------------------------------------------
+
+    def value_types(self, node: ast.AST, local_types: dict[str, set[str]]) -> set[str]:
+        """Possible project class names for the value of ``node``."""
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func) or ""
+            leaf = fname.split(".")[-1]
+            if leaf in _REGISTRY_GETTERS:
+                return set(self.registry_impls[leaf])
+            if isinstance(node.func, ast.Name) and node.func.id in self.classes:
+                return {node.func.id}
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.classes):
+                return {node.func.attr}
+            return set()
+        if isinstance(node, ast.Name):
+            return set(local_types.get(node.id, ()))
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            if node.value.id == "self":
+                return set()  # caller consults class_attr_types with context
+            return set()
+        if isinstance(node, ast.IfExp):
+            return (self.value_types(node.body, local_types)
+                    | self.value_types(node.orelse, local_types))
+        return set()
+
+    def mro_methods(self, cls_name: str) -> dict[str, FunctionInfo]:
+        out: dict[str, FunctionInfo] = {}
+        seen: set[str] = set()
+
+        def collect(name: str) -> None:
+            if name in seen or name not in self.classes:
+                return
+            seen.add(name)
+            cls = self.classes[name]
+            for mname, fn in cls.methods.items():
+                out.setdefault(mname, fn)
+            for base in cls.bases:
+                collect(base)
+
+        collect(cls_name)
+        return out
+
+    # -- edge construction ----------------------------------------------------
+
+    def _module_function(self, suffix: str, name: str | None) -> FunctionInfo | None:
+        for path, mod in self.modules.items():
+            norm = path.replace("\\", "/")
+            if suffix and (norm.endswith(suffix + ".py")
+                           or norm.endswith(suffix + "/__init__.py")):
+                if name is None:
+                    return None
+                return mod.functions.get(name)
+            # ``from pkg.mod import f`` where suffix names the module
+            if suffix and name and norm.endswith(f"{suffix}/{name}.py"):
+                return None
+        return None
+
+    def _resolve_name(self, name: str, fn: FunctionInfo, mod: ModuleInfo) -> list[FunctionInfo]:
+        scope = fn
+        while scope is not None:
+            if name in scope.children:
+                return [scope.children[name]]
+            sibling = scope.parent
+            if sibling is not None and name in sibling.children:
+                return [sibling.children[name]]
+            scope = scope.parent
+        if name in mod.functions:
+            return [mod.functions[name]]
+        if name in mod.imports:
+            suffix, orig = mod.imports[name]
+            # ``from x import f`` — f may be a function of module x
+            target = self._module_function(suffix, orig)
+            if target is not None:
+                return [target]
+            # or f may itself be a module: handled at attribute resolution
+        if name in self.classes:
+            init = self.mro_methods(name).get("__init__")
+            return [init] if init is not None else []
+        return []
+
+    def _resolve_method(self, cls_names: Iterable[str], meth: str) -> list[FunctionInfo]:
+        out: list[FunctionInfo] = []
+        for cls_name in cls_names:
+            hit = self.mro_methods(cls_name).get(meth)
+            if hit is not None and hit not in out:
+                out.append(hit)
+        return out
+
+    #: method names too ubiquitous (files, dicts, sockets, arrays all have
+    #: them) for name-only fallback resolution to mean anything
+    _FALLBACK_BLOCKLIST = frozenset({
+        "read", "write", "get", "put", "update", "pop", "add", "close",
+        "open", "send", "recv", "start", "stop", "run", "clear", "keys",
+        "values", "items", "copy", "append", "extend", "join", "split",
+        "encode", "decode", "format", "count", "index", "insert", "remove",
+    })
+
+    def _fallback_by_name(self, meth: str) -> list[FunctionInfo]:
+        if meth in self._FALLBACK_BLOCKLIST or meth.startswith("__"):
+            return []
+        cands = self.by_method_name.get(meth, [])
+        if 1 <= len(cands) <= FALLBACK_MAX:
+            return list(cands)
+        return []
+
+    def resolve_callable(self, node: ast.AST, fn: FunctionInfo, mod: ModuleInfo,
+                         local_types: dict[str, set[str]]) -> list[FunctionInfo]:
+        """Project functions a callable expression may invoke."""
+        if isinstance(node, ast.Name):
+            return self._resolve_name(node.id, fn, mod)
+        if not isinstance(node, ast.Attribute):
+            return []
+        meth = node.attr
+        recv = node.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and fn.class_name is not None:
+                own = self.mro_methods(fn.class_name).get(meth)
+                targets = [own] if own is not None else []
+                for sub in self._transitive_subclasses(fn.class_name):
+                    override = self.classes[sub].methods.get(meth)
+                    if override is not None and override not in targets:
+                        targets.append(override)
+                if targets:
+                    return targets
+                return self._fallback_by_name(meth)
+            if recv.id in mod.imports:     # module alias: health.gate_facades
+                suffix, orig = mod.imports[recv.id]
+                sub_suffix = f"{suffix}/{orig}" if orig else suffix
+                target = (self._module_function(sub_suffix, meth)
+                          or self._module_function(suffix, meth))
+                if target is not None:
+                    return [target]
+            types = self._lookup_types(recv.id, fn, local_types)
+            if types:
+                hits = self._resolve_method(types, meth)
+                if hits:
+                    return hits
+            return self._fallback_by_name(meth)
+        if (isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and fn.class_name is not None):
+            types = self.class_attr_types.get(fn.class_name, {}).get(recv.attr, set())
+            hits = self._resolve_method(types, meth)
+            if hits:
+                return hits
+        return self._fallback_by_name(meth)
+
+    def _lookup_types(self, name: str, fn: FunctionInfo,
+                      local_types: dict[str, set[str]]) -> set[str]:
+        if name in local_types:
+            return local_types[name]
+        # closure variable: consult enclosing functions' local types
+        scope = fn.parent
+        while scope is not None:
+            parent_types = getattr(scope, "_local_types", None)
+            if parent_types and name in parent_types:
+                return parent_types[name]
+            scope = scope.parent
+        return set()
+
+    def _local_types_of(self, fn: FunctionInfo, mod: ModuleInfo) -> dict[str, set[str]]:
+        """Flow-insensitive local var -> class-name sets for one body."""
+        types: dict[str, set[str]] = {}
+        cls_attr = self.class_attr_types.get(fn.class_name or "", {})
+
+        def attr_types(node: ast.AST) -> set[str]:
+            if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                return set(cls_attr.get(node.attr, ()))
+            return self.value_types(node, types)
+
+        for stmt in _own_statements(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, ast.Name):
+                    got = attr_types(stmt.value)
+                    if got:
+                        types.setdefault(t.id, set()).update(got)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if isinstance(stmt.target, ast.Name) and isinstance(
+                        stmt.iter, (ast.Tuple, ast.List)):
+                    got: set[str] = set()
+                    for el in stmt.iter.elts:
+                        got |= attr_types(el)
+                    if got:
+                        types.setdefault(stmt.target.id, set()).update(got)
+        fn._local_types = types  # type: ignore[attr-defined]  (closure lookups)
+        return types
+
+    def _add_edge(self, caller: FunctionInfo, callee: FunctionInfo, node: ast.AST,
+                  kind: str, label: str = "", bound: int = 0) -> None:
+        site = CallSite(caller, callee, node, kind, label, bound)
+        self.edges.append(site)
+        self.edges_by_caller.setdefault(caller.fid, []).append(site)
+        self.edges_by_callee.setdefault(callee.fid, []).append(site)
+        self.edges_at.setdefault(id(node), []).append(site)
+
+    def _build_edges(self, fn: FunctionInfo, mod: ModuleInfo) -> None:
+        local_types = self._local_types_of(fn, mod)
+        #: var -> how its future was made (for add_done_callback kinds)
+        fut_kind: dict[str, str] = {}
+        for stmt in _own_statements(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(
+                    stmt.targets[0], ast.Name) and isinstance(stmt.value, ast.Call):
+                leaf = last_attr(stmt.value.func) or ""
+                if leaf in ("run_in_executor", "create_task", "ensure_future",
+                            "create_future"):
+                    fut_kind[stmt.targets[0].id] = "loop_cb"
+                elif leaf == "submit":
+                    fut_kind[stmt.targets[0].id] = "executor"
+
+        def resolve_ref(node: ast.AST) -> list[FunctionInfo]:
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                return self.resolve_callable(node, fn, mod, local_types)
+            return []
+
+        def visit(node: ast.AST, in_await: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return  # nested functions are walked as their own callers
+            if isinstance(node, ast.Await):
+                visit(node.value, True)
+                return
+            if isinstance(node, ast.Call):
+                self._call_edges(node, fn, mod, local_types, fut_kind,
+                                 resolve_ref, in_await)
+            for child in ast.iter_child_nodes(node):
+                visit(child, False)
+
+        body = getattr(fn.node, "body", [])
+        for stmt in body:
+            visit(stmt, False)
+
+    def _call_edges(self, call: ast.Call, fn: FunctionInfo, mod: ModuleInfo,
+                    local_types, fut_kind, resolve_ref, in_await: bool) -> None:
+        leaf = last_attr(call.func) or ""
+        dotted = dotted_name(call.func) or leaf
+
+        # deferred-execution special forms seed ownership domains
+        if leaf == "partial" and dotted.split(".")[0] in ("functools", "partial"):
+            if call.args:
+                for target in resolve_ref(call.args[0]):
+                    self._add_edge(fn, target, call, "partial",
+                                   bound=len(call.args) - 1)
+            return
+        if leaf == "Thread":
+            label = "thread"
+            target_node = None
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_node = kw.value
+                elif kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    label = f"thread:{kw.value.value}"
+            for target in resolve_ref(target_node) if target_node is not None else []:
+                self._add_edge(fn, target, call, "thread", label=label)
+            return
+        if leaf == "run_in_executor" and len(call.args) >= 2:
+            for target in resolve_ref(call.args[1]):
+                self._add_edge(fn, target, call, "executor")
+            return
+        if leaf == "submit" and call.args:
+            for target in resolve_ref(call.args[0]):
+                self._add_edge(fn, target, call, "executor")
+            return
+        if leaf in ("call_soon", "call_later", "call_at", "call_soon_threadsafe"):
+            idx = 0 if leaf == "call_soon" or leaf == "call_soon_threadsafe" else 1
+            if len(call.args) > idx:
+                for target in resolve_ref(call.args[idx]):
+                    self._add_edge(fn, target, call, "loop_cb")
+            return
+        if leaf == "add_done_callback" and call.args:
+            recv = call.func.value if isinstance(call.func, ast.Attribute) else None
+            kind = "loop_cb"
+            if isinstance(recv, ast.Name):
+                kind = fut_kind.get(recv.id, "loop_cb")
+            for target in resolve_ref(call.args[0]):
+                self._add_edge(fn, target, call, kind)
+            return
+        if leaf in ("create_task", "ensure_future") and call.args:
+            inner = call.args[0]
+            if isinstance(inner, ast.Call):
+                for target in self.resolve_callable(inner.func, fn, mod, local_types):
+                    self._add_edge(fn, target, inner, "task")
+            else:
+                for target in resolve_ref(inner):
+                    self._add_edge(fn, target, call, "task")
+            return
+
+        # plain (or awaited) call
+        for target in self.resolve_callable(call.func, fn, mod, local_types):
+            self._add_edge(fn, target, call, "await" if in_await else "call")
+        # bare function references passed as arguments (handler tables etc.)
+        for arg in [*call.args, *[kw.value for kw in call.keywords]]:
+            if isinstance(arg, (ast.Name, ast.Attribute)) and not isinstance(
+                    arg, ast.Constant):
+                for target in resolve_ref(arg):
+                    if target.name == (last_attr(arg) or ""):
+                        self._add_edge(fn, target, arg, "ref")
+
+
+def _own_statements(fn: FunctionInfo):
+    """Every statement of ``fn``'s body, excluding nested function bodies."""
+    def walk(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield stmt
+            for field in ("body", "orelse", "finalbody"):
+                yield from walk(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from walk(handler.body)
+    yield from walk(getattr(fn.node, "body", []))
+
+
+def _walk_functions(mod: ModuleInfo):
+    seen: set[str] = set()
+
+    def rec(fn: FunctionInfo):
+        if fn.fid in seen:
+            return
+        seen.add(fn.fid)
+        yield fn
+        for child in fn.children.values():
+            yield from rec(child)
+
+    for fn in mod.functions.values():
+        yield from rec(fn)
+    for cls in mod.classes.values():
+        for fn in cls.methods.values():
+            yield from rec(fn)
+
+
+def build_callgraph(project: Project) -> CallGraph:
+    return CallGraph(project)

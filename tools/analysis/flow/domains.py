@@ -1,0 +1,205 @@
+"""Ownership-domain inference and the cross-thread race pack's raw data.
+
+Every function gets a set of OWNERSHIP DOMAINS — execution contexts its
+body can run in:
+
+* ``loop``       — the asyncio event loop: async defs, loop callbacks
+  (``call_soon``/``call_later``), asyncio-future done-callbacks, tasks.
+* ``thread:<n>`` — a named ``threading.Thread`` target (and everything it
+  calls): e.g. ``thread:qrp2p-warmup`` for the background warmup.
+* ``executor``   — callables submitted to a ThreadPoolExecutor
+  (``run_in_executor`` / ``.submit``) and their transitive callees.
+
+Domains propagate along plain call/await edges to a fixpoint: a sync
+helper called from both a coroutine and a thread target ends up owning
+``{loop, thread:...}`` — which is exactly the signature of shared state.
+
+On top of the domains, every ATTRIBUTE WRITE SITE is collected — direct
+assignments (``self.x = v``, ``obj.x += v``) and container mutation
+through a method (``obj.attr.add(v)``, ``self.stats.record(...)``) — with
+its receiver class resolved by the call graph's type machinery (falling
+back to the project-unique class that assigns that attribute name).
+Writes inside ``__init__``/``__post_init__`` are construction, not
+sharing, and are excluded; writes under a ``with <...lock...>:`` block
+are marked lock-guarded.
+
+packs.py turns this into findings:
+
+* ``cross-thread-state`` — one (class, attribute) written from two
+  different domains (or from one function owned by two domains) with at
+  least one write not lock-guarded: a data race unless a documented
+  handoff exists.
+* ``asyncio-off-loop``   — a non-threadsafe event-loop API
+  (``create_task``/``ensure_future``/``call_soon``/``call_later``/
+  ``call_at``) invoked from a function owned by a thread/executor
+  domain; use ``call_soon_threadsafe`` / ``run_coroutine_threadsafe``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from ..engine import dotted_name, last_attr
+from .callgraph import MUTATORS, CallGraph, FunctionInfo
+
+#: loop APIs that are NOT safe to call from another thread (their
+#: threadsafe twins are fine and excluded by name)
+OFF_LOOP_APIS = {"create_task", "ensure_future", "call_soon", "call_later",
+                 "call_at"}
+
+PROPAGATE_KINDS = ("call", "await")
+
+
+def infer_domains(cg: CallGraph) -> dict[str, set[str]]:
+    domains: dict[str, set[str]] = {fid: set() for fid in cg.functions}
+    for fid, fn in cg.functions.items():
+        if fn.is_async:
+            domains[fid].add("loop")
+    for site in cg.edges:
+        if site.kind == "thread":
+            domains[site.callee.fid].add(site.label or "thread")
+        elif site.kind == "executor":
+            domains[site.callee.fid].add("executor")
+        elif site.kind in ("loop_cb", "task"):
+            domains[site.callee.fid].add("loop")
+    changed = True
+    while changed:
+        changed = False
+        for site in cg.edges:
+            if site.kind not in PROPAGATE_KINDS:
+                continue
+            src = domains[site.caller.fid]
+            dst = domains[site.callee.fid]
+            if src - dst:
+                dst |= src
+                changed = True
+    return domains
+
+
+@dataclasses.dataclass
+class WriteSite:
+    cls: str
+    attr: str
+    fn: FunctionInfo
+    node: ast.AST
+    locked: bool
+    kind: str   # "assign" | "mutate"
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    name = (dotted_name(node) or last_attr(node) or "").lower()
+    if "lock" in name:
+        return True
+    if isinstance(node, ast.Call):
+        return _is_lock_expr(node.func)
+    return False
+
+
+class _AttrIndex:
+    """attr name -> classes that assign it (for receiver-class fallback)."""
+
+    def __init__(self, cg: CallGraph):
+        self.by_attr: dict[str, set[str]] = {}
+        for cls in cg.classes.values():
+            for attr in cls.attrs:
+                self.by_attr.setdefault(attr, set()).add(cls.name)
+
+    def unique_owner(self, attr: str) -> str | None:
+        owners = self.by_attr.get(attr, set())
+        return next(iter(owners)) if len(owners) == 1 else None
+
+
+def collect_write_sites(cg: CallGraph) -> list[WriteSite]:
+    out: list[WriteSite] = []
+    attr_index = _AttrIndex(cg)
+    for fid, fn in cg.functions.items():
+        if fn.is_init:
+            continue
+        local_types = getattr(fn, "_local_types", {})
+        cls_attr = cg.class_attr_types.get(fn.class_name or "", {})
+
+        def receiver_classes(recv: ast.AST, attr: str) -> list[str]:
+            if isinstance(recv, ast.Name):
+                if recv.id == "self" and fn.class_name is not None:
+                    return [fn.class_name]
+                types = cg._lookup_types(recv.id, fn, local_types)
+                if types:
+                    return sorted(types)
+            if (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"):
+                types = cls_attr.get(recv.attr, set())
+                if types:
+                    return sorted(types)
+            owner = attr_index.unique_owner(attr)
+            return [owner] if owner is not None else []
+
+        def record(recv: ast.AST, attr: str, node: ast.AST, locked: bool,
+                   kind: str) -> None:
+            for cls in receiver_classes(recv, attr):
+                if cls in cg.classes and attr in cg.classes[cls].attrs:
+                    out.append(WriteSite(cls, attr, fn, node, locked, kind))
+
+        def walk(node: ast.AST, locked: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                now_locked = locked or any(
+                    _is_lock_expr(item.context_expr) for item in node.items)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, now_locked)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        record(t.value, t.attr, node, locked, "assign")
+                    elif (isinstance(t, ast.Subscript)
+                          and isinstance(t.value, ast.Attribute)):
+                        record(t.value.value, t.value.attr, node, locked,
+                               "mutate")
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if (node.func.attr in MUTATORS
+                        and isinstance(node.func.value, ast.Attribute)):
+                    inner = node.func.value
+                    record(inner.value, inner.attr, node, locked, "mutate")
+            for child in ast.iter_child_nodes(node):
+                walk(child, locked)
+
+        for stmt in getattr(fn.node, "body", []):
+            walk(stmt, False)
+    return out
+
+
+@dataclasses.dataclass
+class OffLoopCall:
+    fn: FunctionInfo
+    node: ast.AST
+    api: str
+
+
+def collect_off_loop_calls(cg: CallGraph,
+                           domains: dict[str, set[str]]) -> list[OffLoopCall]:
+    out: list[OffLoopCall] = []
+    for fid, fn in cg.functions.items():
+        owned = domains.get(fid, set())
+        if not any(d == "executor" or d.startswith("thread") for d in owned):
+            continue
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.Call):
+                leaf = last_attr(node.func) or ""
+                if leaf in OFF_LOOP_APIS:
+                    out.append(OffLoopCall(fn, node, leaf))
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        for stmt in getattr(fn.node, "body", []):
+            walk(stmt)
+    return out

@@ -1,0 +1,259 @@
+"""qrflow analysis packs, exposed as qrlint ``Rule`` objects.
+
+One :class:`FlowAnalysis` is computed per project run (call graph ->
+taint fixpoint -> domain inference -> write-site collection) and cached
+on the ``Project``; the thin rule classes below each publish their own
+finding id from it, so ``--select``/``--ignore`` and the inline
+``# qrlint: disable=`` suppression machinery work unchanged.
+
+Rule ids:
+
+======================  =====================================================
+flow-secret-in-log      tainted value reaches a logging / audit-log call
+flow-secret-in-exception tainted value embedded in an exception message
+flow-secret-format      repr()/str()/f-string renders a tainted value
+flow-secret-to-network  tainted value reaches a network send before AEAD
+flow-secret-compare     ==/!= on key material (use hmac.compare_digest)
+flow-secret-branch      secret-dependent branch / secret-indexed lookup
+cross-thread-state      attribute written from two ownership domains unlocked
+asyncio-off-loop        non-threadsafe loop API called from a thread domain
+unjustified-suppression a qrflow suppression with no one-line justification
+======================  =====================================================
+
+Scope policy for the constant-time rules (``flow-secret-compare`` /
+``flow-secret-branch``): paths under ``pyref/`` are excluded by default —
+they are pure-Python FIPS references where arithmetic on secret
+polynomials IS the algorithm and no production traffic runs through them;
+the jax providers that do serve traffic are branch-free on secrets by
+construction (qrlint's ``traced-branch`` forbids Python control flow on
+traced values).  Pass ``ct_all=True`` (CLI ``--ct-all``) to lift the
+exclusion for an audit sweep.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..engine import FileContext, Project, Rule
+from .callgraph import build_callgraph
+from .domains import (collect_off_loop_calls, collect_write_sites,
+                      infer_domains)
+from .taint import SinkHit, TaintEngine
+
+#: constant-time rules skip these path fragments by default (see module doc)
+CT_EXCLUDE = ("pyref/", "pyref\\")
+CT_RULES = ("flow-secret-compare", "flow-secret-branch")
+
+#: process-wide default for lifting the CT_EXCLUDE scope (set by the CLI's
+#: ``--ct-all``; a module flag because rules are constructed by the engine
+#: without CLI context)
+CT_ALL = False
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*qrlint:\s*disable(?:-file)?\s*=\s*(?P<rules>[\w.,\- ]+)(?P<rest>.*)$")
+
+
+class FlowAnalysis:
+    """All qrflow findings for one project, computed once and cached."""
+
+    def __init__(self, project: Project, ct_all: bool = False):
+        self.project = project
+        self.cg = build_callgraph(project)
+        self.findings: list[tuple[str, FileContext, object, str]] = []
+        self._run_taint(ct_all)
+        self._run_races()
+
+    @classmethod
+    def of(cls, project: Project, ct_all: bool | None = None) -> "FlowAnalysis":
+        cached = getattr(project, "_qrflow_analysis", None)
+        if cached is None:
+            cached = cls(project, ct_all=CT_ALL if ct_all is None else ct_all)
+            project._qrflow_analysis = cached  # type: ignore[attr-defined]
+        return cached
+
+    def _add(self, rule_id: str, ctx: FileContext, node, message: str) -> None:
+        self.findings.append((rule_id, ctx, node, message))
+
+    # -- taint ----------------------------------------------------------------
+
+    def _run_taint(self, ct_all: bool) -> None:
+        engine = TaintEngine(self.cg)
+        engine.solve()
+        self.taint_engine = engine
+        seen: set[tuple[str, str, int, int]] = set()
+
+        def report(hit: SinkHit) -> None:
+            if hit.rule in CT_RULES and not ct_all and any(
+                    frag in hit.fn.path for frag in CT_EXCLUDE):
+                return
+            key = (hit.rule, hit.fn.path,
+                   getattr(hit.node, "lineno", 0),
+                   getattr(hit.node, "col_offset", 0))
+            if key in seen:
+                return
+            seen.add(key)
+            self._add(hit.rule, hit.fn.ctx, hit.node,
+                      f"{hit.message} [in {hit.fn.qualname}]")
+
+        engine.report_pass(lambda fn: True, report)
+
+    # -- races ----------------------------------------------------------------
+
+    def _run_races(self) -> None:
+        domains = infer_domains(self.cg)
+        self.domains = domains
+        sites = collect_write_sites(self.cg)
+        by_attr: dict[tuple[str, str], list] = {}
+        for site in sites:
+            by_attr.setdefault((site.cls, site.attr), []).append(site)
+        for (cls, attr), group in sorted(by_attr.items()):
+            all_domains: set[str] = set()
+            for site in group:
+                all_domains |= {
+                    d for d in domains.get(site.fn.fid, set())
+                    if d == "loop" or d == "executor" or d.startswith("thread")
+                }
+            if len(all_domains) < 2:
+                continue
+            unguarded = [s for s in group if not s.locked]
+            if not unguarded:
+                continue
+            site = unguarded[0]
+            writers = sorted({s.fn.qualname for s in group})
+            self._add(
+                "cross-thread-state", site.fn.ctx, site.node,
+                f"{cls}.{attr} is written from multiple ownership domains "
+                f"({', '.join(sorted(all_domains))}) by "
+                f"{', '.join(writers[:4])}"
+                f"{'…' if len(writers) > 4 else ''} with at least one write "
+                "not lock-guarded; add a lock or hand off via "
+                "call_soon_threadsafe",
+            )
+        for call in collect_off_loop_calls(self.cg, domains):
+            owned = sorted(domains.get(call.fn.fid, set()))
+            self._add(
+                "asyncio-off-loop", call.fn.ctx, call.node,
+                f"{call.api}() called from {call.fn.qualname}, which runs in "
+                f"domain(s) {', '.join(owned)}: event-loop APIs are not "
+                "thread-safe off-loop; use call_soon_threadsafe / "
+                "run_coroutine_threadsafe",
+            )
+
+
+class _FlowRule(Rule):
+    """Base: publish one finding id out of the shared analysis."""
+
+    severity = "error"
+
+    def check_project(self, project: Project) -> None:
+        analysis = FlowAnalysis.of(project)
+        for rule_id, ctx, node, message in analysis.findings:
+            if rule_id == self.id:
+                project.report(self, ctx, node, message)
+
+
+class SecretInLogFlowRule(_FlowRule):
+    id = "flow-secret-in-log"
+    description = ("interprocedural: key material (decaps output, secret key, "
+                   "HKDF output) reaches a logging or audit-log call")
+
+
+class SecretInExceptionFlowRule(_FlowRule):
+    id = "flow-secret-in-exception"
+    description = "interprocedural: key material embedded in an exception message"
+
+
+class SecretFormatFlowRule(_FlowRule):
+    id = "flow-secret-format"
+    description = "repr()/str()/f-string renders interprocedurally-tainted key material"
+
+
+class SecretToNetworkFlowRule(_FlowRule):
+    id = "flow-secret-to-network"
+    description = "key material reaches a network send before AEAD encryption"
+
+
+class SecretCompareFlowRule(_FlowRule):
+    id = "flow-secret-compare"
+    description = ("==/!= on key material — variable-time comparison; "
+                   "use hmac.compare_digest")
+
+
+class SecretBranchFlowRule(_FlowRule):
+    id = "flow-secret-branch"
+    description = ("secret-dependent if/while or secret-indexed table lookup "
+                   "— branch/cache timing side channel")
+
+
+class CrossThreadStateRule(_FlowRule):
+    id = "cross-thread-state"
+    description = ("attribute written from two ownership domains (event loop "
+                   "/ warmup thread / executor) without a lock")
+
+
+class AsyncioOffLoopRule(_FlowRule):
+    id = "asyncio-off-loop"
+    description = ("non-threadsafe asyncio API called from a thread/executor "
+                   "ownership domain")
+
+
+class UnjustifiedSuppressionRule(Rule):
+    """Suppressing a qrflow finding requires a one-line justification after
+    the rule ids (separated by a non-word character, e.g. ``—``) — the same
+    convention docs/static_analysis.md mandates for qrlint, here enforced."""
+
+    id = "unjustified-suppression"
+    severity = "error"
+    description = ("a qrflow suppression comment carries no one-line "
+                   "justification after the rule id(s)")
+
+    #: ids whose suppressions this rule polices (its own id included so a
+    #: suppression of THIS rule also needs a reason)
+    _POLICED: frozenset[str] = frozenset({
+        "flow-secret-in-log", "flow-secret-in-exception", "flow-secret-format",
+        "flow-secret-to-network", "flow-secret-compare", "flow-secret-branch",
+        "cross-thread-state", "asyncio-off-loop", "unjustified-suppression",
+    })
+
+    def check_project(self, project: Project) -> None:
+        for ctx in project.contexts.values():
+            for lineno, line in enumerate(ctx.lines, start=1):
+                m = _SUPPRESS_RE.search(line)
+                if not m:
+                    continue
+                blob = m.group("rules")
+                rest = m.group("rest") or ""
+                # ids run up to the first non-[word,space,comma,dash] char;
+                # everything after that separator is the justification
+                sep = re.search(r"[^\w,\- ]", blob)
+                ids_part = blob[: sep.start()] if sep else blob
+                justification = (blob[sep.start():] if sep else "") + rest
+                ids = {tok for part in ids_part.split(",")
+                       for tok in part.strip().split() if tok}
+                flow_ids = ids & self._POLICED
+                if flow_ids and not re.search(r"\w", justification):
+                    node = _LineNode(lineno)
+                    project.report(
+                        self, ctx, node,
+                        f"suppression of {', '.join(sorted(flow_ids))} has no "
+                        "justification — append one after the rule id "
+                        "(e.g. `# qrlint: disable=flow-secret-compare — "
+                        "probe-only ephemeral key`)",
+                    )
+
+
+class _LineNode:
+    """Minimal AST-node stand-in so line-anchored findings route through
+    the normal report/suppression machinery."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.end_lineno = lineno
+        self.col_offset = 0
+
+
+FLOW_RULES = (
+    SecretInLogFlowRule, SecretInExceptionFlowRule, SecretFormatFlowRule,
+    SecretToNetworkFlowRule, SecretCompareFlowRule, SecretBranchFlowRule,
+    CrossThreadStateRule, AsyncioOffLoopRule, UnjustifiedSuppressionRule,
+)

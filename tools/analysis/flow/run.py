@@ -1,0 +1,91 @@
+"""qrflow CLI — ``python -m tools.analysis.flow.run <package-or-path>``.
+
+Exit status mirrors qrlint's ratchet contract: 0 when the tree is clean
+(modulo explicit, JUSTIFIED suppressions), 1 when any error-severity
+finding remains, 2 on usage errors.  ``--format json`` and ``--format
+sarif`` emit machine-readable output (SARIF for code-scanning UIs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from ..engine import Engine, render_findings
+from . import flow_rules
+from .sarif import to_sarif
+
+
+def _resolve_target(target: str) -> Path:
+    p = Path(target)
+    if p.exists():
+        return p
+    p = Path(target.replace(".", "/"))
+    if p.exists():
+        return p
+    raise SystemExit(f"qrflow: no such file, directory, or package: {target!r}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="qrflow",
+        description=("interprocedural secret-taint / constant-time / "
+                     "cross-thread-race analysis (docs/static_analysis.md)"),
+    )
+    ap.add_argument("targets", nargs="*", default=["quantum_resistant_p2p_tpu"],
+                    help="files, directories, or package names (default: the package)")
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human", help="output format (default: human)")
+    ap.add_argument("--json", action="store_true",
+                    help="alias for --format json (qrlint compatibility)")
+    ap.add_argument("--select", help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    ap.add_argument("--ct-all", action="store_true",
+                    help="run the constant-time rules on pyref/ too "
+                         "(audit sweep; excluded by default)")
+    args = ap.parse_args(argv)
+
+    from . import packs
+
+    packs.CT_ALL = bool(args.ct_all)
+
+    rules = flow_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.id:26} [{rule.severity}] {rule.description}")
+        return 0
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",")}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"qrflow: unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+    if args.ignore:
+        dropped = {r.strip() for r in args.ignore.split(",")}
+        rules = [r for r in rules if r.id not in dropped]
+
+    targets = [_resolve_target(t) for t in (args.targets or ["quantum_resistant_p2p_tpu"])]
+    findings, suppressed = Engine(rules).lint_paths(targets)
+
+    fmt = "json" if args.json else args.format
+    if fmt == "sarif":
+        print(json.dumps(to_sarif(findings, suppressed, rules), indent=2))
+    else:
+        out = render_findings(findings, suppressed, as_json=(fmt == "json"))
+        if out and fmt == "human":
+            # the summary trailer says "qrlint:"; rebrand ONLY that line
+            lines = out.splitlines()
+            lines[-1] = lines[-1].replace("qrlint:", "qrflow:", 1)
+            out = "\n".join(lines)
+        if out:
+            print(out)
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

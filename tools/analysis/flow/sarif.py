@@ -1,0 +1,120 @@
+"""SARIF 2.1.0 output for qrflow (and a structural schema checker).
+
+SARIF is the interchange format CI surfaces (GitHub code scanning, VS
+Code SARIF viewers) ingest; qrflow emits the minimal valid subset: one
+run, the tool driver with its rule inventory, one ``result`` per finding
+and per suppressed finding (the latter carrying an ``inSource``
+suppression so viewers render them as waived, not hidden).
+
+``check_sarif`` is a small structural validator for exactly the subset
+this module emits — the required-property/type skeleton of the SARIF
+2.1.0 spec (§3.13-3.27: version, runs[].tool.driver.name,
+results[].message.text, rule ids, physical locations with 1-based
+regions).  The test suite runs every emitted document through it, so the
+output cannot drift from the spec subset silently; it deliberately has
+no dependency on a JSON-Schema library (the image may not ship one).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..engine import Finding, Rule
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA_URI = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def to_sarif(findings: list[Finding], suppressed: list[Finding],
+             rules: list[Rule], tool_name: str = "qrflow") -> dict[str, Any]:
+    rule_ids = sorted({f.rule for f in [*findings, *suppressed]}
+                      | {r.id for r in rules if r.id})
+
+    def result(f: Finding, waived: bool) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path.replace("\\", "/")},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col)},
+                },
+            }],
+        }
+        if waived:
+            out["suppressions"] = [{"kind": "inSource"}]
+        return out
+
+    descriptions = {r.id: r.description for r in rules if r.id}
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool_name,
+                "informationUri": "https://example.invalid/qrflow",
+                "rules": [
+                    {"id": rid,
+                     "shortDescription": {"text": descriptions.get(rid, rid)}}
+                    for rid in rule_ids
+                ],
+            }},
+            "results": [
+                *[result(f, waived=False) for f in findings],
+                *[result(f, waived=True) for f in suppressed],
+            ],
+        }],
+    }
+
+
+def check_sarif(doc: Any) -> list[str]:
+    """Structural errors for the SARIF subset ``to_sarif`` emits; empty
+    list = valid."""
+    errors: list[str] = []
+
+    def need(obj, key, typ, where):
+        if not isinstance(obj, dict) or key not in obj:
+            errors.append(f"{where}: missing required property {key!r}")
+            return None
+        if not isinstance(obj[key], typ):
+            errors.append(f"{where}.{key}: expected {typ.__name__}, "
+                          f"got {type(obj[key]).__name__}")
+            return None
+        return obj[key]
+
+    if need(doc, "version", str, "$") != SARIF_VERSION:
+        errors.append(f"$.version: must be {SARIF_VERSION!r}")
+    runs = need(doc, "runs", list, "$")
+    for i, run in enumerate(runs or []):
+        tool = need(run, "tool", dict, f"$.runs[{i}]")
+        driver = need(tool or {}, "driver", dict, f"$.runs[{i}].tool")
+        need(driver or {}, "name", str, f"$.runs[{i}].tool.driver")
+        for j, rule in enumerate((driver or {}).get("rules", [])):
+            need(rule, "id", str, f"$.runs[{i}]...rules[{j}]")
+        results = need(run, "results", list, f"$.runs[{i}]")
+        for j, res in enumerate(results or []):
+            where = f"$.runs[{i}].results[{j}]"
+            need(res, "ruleId", str, where)
+            if res.get("level") not in ("error", "warning", "note", "none"):
+                errors.append(f"{where}.level: invalid {res.get('level')!r}")
+            msg = need(res, "message", dict, where)
+            need(msg or {}, "text", str, f"{where}.message")
+            for k, loc in enumerate(res.get("locations", [])):
+                lwhere = f"{where}.locations[{k}]"
+                phys = need(loc, "physicalLocation", dict, lwhere)
+                art = need(phys or {}, "artifactLocation", dict,
+                           f"{lwhere}.physicalLocation")
+                need(art or {}, "uri", str,
+                     f"{lwhere}.physicalLocation.artifactLocation")
+                region = (phys or {}).get("region", {})
+                for field in ("startLine", "startColumn"):
+                    val = region.get(field)
+                    if val is not None and (not isinstance(val, int) or val < 1):
+                        errors.append(
+                            f"{lwhere}...region.{field}: must be a 1-based int")
+            for k, sup in enumerate(res.get("suppressions", [])):
+                if sup.get("kind") not in ("inSource", "external"):
+                    errors.append(f"{where}.suppressions[{k}].kind: invalid")
+    return errors

@@ -1,0 +1,763 @@
+"""Forward interprocedural taint analysis over a small secrecy lattice.
+
+Lattice (join = max)::
+
+    PUBLIC(0) < ZEROIZED(1) < SECRET_DERIVED(2) < SECRET(3)
+
+* SECRET — raw key material: decapsulated shared secrets, KEM/signature
+  secret keys, passwords.
+* SECRET_DERIVED — deterministic key-grade derivations (HKDF outputs,
+  vault entries): still key material, but one derivation away.
+* ZEROIZED — a formerly secret location after an explicit wipe; kept
+  distinct from PUBLIC so a wipe is visible in provenance.
+* PUBLIC — everything else, including one-way hashes, ciphertexts,
+  signatures, and verification results (the crypto-op MODELS below pin
+  these down so a signature over a transcript never drags its signing
+  key's taint onto the wire).
+
+The analysis is flow-sensitive per function (one forward pass in
+statement order), context-insensitive across functions: every call site
+joins its argument taints into the callee's parameter vector, callee
+return taints come from a per-function SUMMARY, and a worklist iterates
+to fixpoint (finite lattice + monotone joins = termination).  The
+summary cache — (function, parameter-taint vector) -> summary — skips
+re-analysis of anything whose inputs did not change, which is what keeps
+the whole-tree CI run cheap.
+
+Tuples are modelled element-wise where it matters: ``generate_keypair``
+returns ``(PUBLIC, SECRET)``, so ``pk, sk = kem.generate_keypair()``
+taints only ``sk``, and ``self._sig_keypair[0]`` (the public half of a
+secret-named pair) stays sendable.
+
+Sinks (reported by packs.py with rule ids):
+
+* logging calls (including the audit log), exception messages,
+  ``repr()``/``str()`` and f-string interpolation — exfiltration sinks
+  for any taint >= SECRET_DERIVED;
+* network sends (``send_message``/``sendall``/``sendto``) — key material
+  must never leave before AEAD;
+* ``==``/``!=`` on tainted operands in BRANCH POSITION (an if/while/
+  ternary test) — a variable-time comparison decision; use
+  ``hmac.compare_digest``.  Expression-position comparisons are
+  vectorized masking in this codebase (FO re-encryption checks,
+  decompose wraps) and stay data-flow on device;
+* secret-dependent ``if``/``while`` conditions (ordered comparisons or
+  arithmetic on SECRET values) and secret-indexed subscripts — classic
+  branch/cache timing channels.  Truthiness (``if secret:``), ``is
+  None`` checks and membership tests deliberately do NOT fire: they
+  reveal presence, not content.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable
+
+from ..engine import last_attr
+from ..rules_secret import _is_logging_call, is_secret_name
+from .callgraph import CallGraph, FunctionInfo
+
+PUBLIC, ZEROIZED, DERIVED, SECRET = 0, 1, 2, 3
+LEVEL_NAMES = {PUBLIC: "PUBLIC", ZEROIZED: "ZEROIZED",
+               DERIVED: "SECRET_DERIVED", SECRET: "SECRET"}
+
+
+class Taint:
+    """A lattice value, optionally structured element-wise for tuples,
+    carrying a human-readable provenance (``why``) for findings.  Equality
+    ignores provenance so the fixpoint converges on lattice values only."""
+
+    __slots__ = ("level", "elements", "why")
+
+    def __init__(self, level: int, elements: tuple["Taint", ...] | None = None,
+                 why: str = ""):
+        self.level = level
+        self.elements = elements
+        self.why = why
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, Taint) and self.level == other.level
+                and self.elements == other.elements)
+
+    def __hash__(self) -> int:
+        return hash((self.level, self.elements))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        inner = f", {self.elements!r}" if self.elements else ""
+        return f"Taint({LEVEL_NAMES[self.level]}{inner})"
+
+
+T_PUBLIC = Taint(PUBLIC)
+
+#: element structure deeper than this collapses to a scalar: self-referential
+#: flows (``state = (state, x)`` through a fixpoint) would otherwise nest
+#: tuples without bound
+MAX_TUPLE_DEPTH = 3
+
+
+def _clip(t: Taint, depth: int = MAX_TUPLE_DEPTH) -> Taint:
+    if t.elements is None:
+        return t
+    if depth <= 0:
+        return Taint(t.level, None, t.why)
+    clipped = tuple(_clip(e, depth - 1) for e in t.elements)
+    if clipped == t.elements:
+        return t
+    return Taint(t.level, clipped, t.why)
+
+
+def join(a: Taint, b: Taint) -> Taint:
+    if a is b:
+        return a
+    if a.elements is not None and b.elements is not None:
+        if len(a.elements) == len(b.elements):
+            elems = tuple(join(x, y) for x, y in zip(a.elements, b.elements))
+            return _clip(Taint(max(a.level, b.level), elems, a.why or b.why))
+        return Taint(max(a.level, b.level), None, a.why or b.why)
+    if a.elements is not None and b.level <= a.level:
+        return _clip(a)
+    if b.elements is not None and a.level <= b.level:
+        return _clip(b)
+    return a if a.level >= b.level else Taint(b.level, None, b.why)
+
+
+#: name suffixes that denote METADATA about a secret, not the secret itself
+#: (lengths, shapes, counts, offsets): ``secret_key_len`` is a public size
+_METADATA_SUFFIX = ("_len", "_lens", "_length", "_size", "_count", "_num",
+                    "_dim", "_ndim", "_off", "_offset", "_idx", "_index",
+                    "_shape", "_algo", "_name")
+
+#: attribute reads that yield public metadata of a (possibly secret) array
+METADATA_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize",
+                  "name"}
+
+
+def name_taint(name: str | None) -> Taint:
+    """Identifier-based seed: secret-named values are SECRET; ``*keypair*``
+    names are (public, secret) pairs; metadata-suffixed names (lengths,
+    shapes, offsets) are public no matter what they measure."""
+    if not name:
+        return T_PUBLIC
+    low = name.lower()
+    if low.endswith(_METADATA_SUFFIX):
+        return T_PUBLIC
+    if is_secret_name(name):
+        if "keypair" in low:
+            return Taint(SECRET, (T_PUBLIC, Taint(SECRET, why=f"secret half of {name!r}")),
+                         why=f"keypair {name!r}")
+        return Taint(SECRET, why=f"secret-named {name!r}")
+    return T_PUBLIC
+
+
+def _pair(why: str) -> Taint:
+    return Taint(SECRET, (T_PUBLIC, Taint(SECRET, why=why)), why=why)
+
+
+#: crypto-op models by callee name: fixed output taints that override
+#: propagation (signatures/ciphertexts are public BY CONSTRUCTION even
+#: though a secret key went in; decapsulation yields the shared secret).
+MODELS: dict[str, Taint] = {
+    "generate_keypair": _pair("generate_keypair()"),
+    "generate_keypair_batch": _pair("generate_keypair_batch()"),
+    "_kem_keygen": _pair("_kem_keygen()"),
+    "encapsulate": _pair("encapsulate()"),           # (ct, shared_secret)
+    "encapsulate_batch": _pair("encapsulate_batch()"),
+    "_kem_encaps": _pair("_kem_encaps()"),
+    "decapsulate": Taint(SECRET, why="decapsulate()"),
+    "decapsulate_batch": Taint(SECRET, why="decapsulate_batch()"),
+    "_kem_decaps": Taint(SECRET, why="_kem_decaps()"),
+    "keygen_sign": Taint(SECRET, (T_PUBLIC, Taint(SECRET, why="fused keygen_sign()"),
+                                  T_PUBLIC), why="fused keygen_sign()"),
+    "encaps_verify_sign": Taint(SECRET, (T_PUBLIC, T_PUBLIC,
+                                         Taint(SECRET, why="fused encaps_verify_sign()"),
+                                         T_PUBLIC), why="fused encaps_verify_sign()"),
+    "decaps_verify_sign": Taint(SECRET, (T_PUBLIC,
+                                         Taint(SECRET, why="fused decaps_verify_sign()"),
+                                         T_PUBLIC), why="fused decaps_verify_sign()"),
+    "sign": T_PUBLIC, "sign_batch": T_PUBLIC, "_sign": T_PUBLIC,
+    "verify": T_PUBLIC, "verify_batch": T_PUBLIC, "_verify": T_PUBLIC,
+    "encrypt": T_PUBLIC, "decrypt": T_PUBLIC,
+    "derive_message_key": Taint(DERIVED, why="derive_message_key()"),
+    "_hkdf_sha256": Taint(DERIVED, why="_hkdf_sha256()"),
+    "hkdf": Taint(DERIVED, why="hkdf()"),
+    "derive_key": Taint(DERIVED, why="derive_key()"),
+    "retrieve": Taint(DERIVED, why="vault retrieve()"),
+    "compare_digest": T_PUBLIC,
+}
+
+#: calls whose result no longer reveals the input (sizes, hashes, types)
+SANITIZERS = {
+    "len", "type", "bool", "id", "hash", "sha256", "sha384", "sha512",
+    "sha3_256", "sha3_512", "blake2b", "blake2s", "md5",
+    "hexdigest", "digest",
+}
+
+#: call names that wipe their argument / receiver in place
+WIPERS = {"wipe", "_wipe", "zeroize", "_zeroize", "_wipe_secret", "wipe_secret"}
+
+NETWORK_SINKS = {"send_message", "sendall", "sendto"}
+
+#: vectorized masked-select primitives: an ``==``/``<`` producing a MASK for
+#: these is data-flow selection (constant-time by construction), not a
+#: variable-time comparison
+MASK_FNS = {"where", "select", "select_n", "cond", "switch",
+            "dynamic_update_slice", "dynamic_slice"}
+
+
+@dataclasses.dataclass
+class Summary:
+    ret: Taint = dataclasses.field(default_factory=lambda: T_PUBLIC)
+
+
+@dataclasses.dataclass
+class SinkHit:
+    rule: str
+    fn: FunctionInfo
+    node: ast.AST
+    message: str
+
+
+class TaintPass:
+    """One flow-sensitive forward pass over a single function body."""
+
+    def __init__(self, fn: FunctionInfo, cg: CallGraph,
+                 summaries: dict[str, Summary],
+                 param_taint: dict[str, list[Taint]],
+                 report: Callable[[SinkHit], None] | None = None):
+        self.fn = fn
+        self.cg = cg
+        self.summaries = summaries
+        self.param_taint = param_taint
+        self.report = report
+        self.env: dict[str, Taint] = {}
+        self.ret = T_PUBLIC
+        #: >0 while evaluating args of a masked-select primitive (MASK_FNS)
+        self._mask_depth = 0
+        #: >0 while evaluating an if/while/ternary TEST — the only position
+        #: where ==/!= on key material is a variable-time decision; in
+        #: expression position it is vectorized masking (FO re-encryption
+        #: checks, decompose wraps) that stays data-flow on device
+        self._branch_depth = 0
+        #: callee fid -> joined positional taints observed at call sites
+        self.callee_updates: dict[str, dict[int, Taint]] = {}
+        params = fn.params
+        incoming = param_taint.get(fn.fid, [])
+        for i, p in enumerate(params):
+            seed = name_taint(p)
+            if i < len(incoming):
+                seed = join(seed, incoming[i])
+            if seed.level > PUBLIC or seed.elements:
+                self.env[p] = seed
+
+    # -- driving --------------------------------------------------------------
+
+    def run(self) -> None:
+        for stmt in getattr(self.fn.node, "body", []):
+            self.exec_stmt(stmt)
+
+    def _hit(self, rule: str, node: ast.AST, message: str) -> None:
+        if self.report is not None:
+            self.report(SinkHit(rule, self.fn, node, message))
+
+    # -- statements -----------------------------------------------------------
+
+    def exec_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # analyzed as their own functions
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value)
+            for t in stmt.targets:
+                self.assign(t, val, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.assign(stmt.target, self.eval(stmt.value), stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            val = join(self.eval(stmt.target), self.eval(stmt.value))
+            self.assign(stmt.target, val, stmt.value)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.ret = join(self.ret, self.eval(stmt.value))
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self.check_condition(stmt.test)
+            self._branch_depth += 1
+            try:
+                self.eval(stmt.test)
+            finally:
+                self._branch_depth -= 1
+            for s in [*stmt.body, *stmt.orelse]:
+                self.exec_stmt(s)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self.eval(stmt.iter)
+            target_taint = Taint(it.level, None, it.why)
+            if (isinstance(stmt.target, ast.Tuple)
+                    and isinstance(stmt.iter, (ast.Tuple, ast.List))
+                    and stmt.iter.elts
+                    and all(isinstance(e, ast.Tuple)
+                            and len(e.elts) == len(stmt.target.elts)
+                            for e in stmt.iter.elts)):
+                # for (name, value) in (("sk_seed", sk_seed), ...): join the
+                # iterable COLUMN-wise so the label stays public
+                cols = [T_PUBLIC] * len(stmt.target.elts)
+                for row in stmt.iter.elts:
+                    for i, cell in enumerate(row.elts):
+                        cols[i] = join(cols[i], self.eval(cell))
+                target_taint = Taint(max(c.level for c in cols), tuple(cols),
+                                     it.why)
+            self.assign(stmt.target, target_taint, stmt.iter)
+            for s in [*stmt.body, *stmt.orelse]:
+                self.exec_stmt(s)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                val = self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, val, item.context_expr)
+            for s in stmt.body:
+                self.exec_stmt(s)
+        elif isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self.exec_stmt(s)
+            for handler in stmt.handlers:
+                for s in handler.body:
+                    self.exec_stmt(s)
+            for s in [*stmt.orelse, *stmt.finalbody]:
+                self.exec_stmt(s)
+        elif isinstance(stmt, ast.Raise):
+            if isinstance(stmt.exc, ast.Call):
+                for arg in [*stmt.exc.args,
+                            *[kw.value for kw in stmt.exc.keywords]]:
+                    t = self.eval(arg)
+                    if t.level >= DERIVED:
+                        self._hit(
+                            "flow-secret-in-exception", arg,
+                            f"{LEVEL_NAMES[t.level]} value"
+                            f"{_why(t)} embedded in an exception message "
+                            "(exceptions end up in logs and tracebacks)",
+                        )
+            elif stmt.exc is not None:
+                self.eval(stmt.exc)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                path = _target_path(t)
+                if path:
+                    self.env[path] = Taint(ZEROIZED, why="deleted")
+        # Assert/Pass/Import/Global/Nonlocal/Break/Continue: no taint effect
+
+    def assign(self, target: ast.AST, val: Taint, value_node: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elems = val.elements
+            for i, t in enumerate(target.elts):
+                if elems is not None and i < len(elems):
+                    self.assign(t, elems[i], value_node)
+                else:
+                    self.assign(t, Taint(val.level, None, val.why), value_node)
+            return
+        path = _target_path(target)
+        if path is None:
+            if isinstance(target, ast.Subscript):
+                base = _target_path(target.value)
+                if base is not None:   # d[k] = v joins into the container
+                    self.env[base] = join(self.env.get(base, T_PUBLIC),
+                                          Taint(val.level, None, val.why))
+            return
+        prev = self.env.get(path)
+        if _is_empty_const(value_node) and prev is not None and prev.level >= DERIVED:
+            self.env[path] = Taint(ZEROIZED, why=f"{path} cleared")
+        else:
+            self.env[path] = val
+
+    # -- sink checks ----------------------------------------------------------
+
+    def check_condition(self, test: ast.AST) -> None:
+        """Secret-dependent control flow: ordered comparisons or arithmetic
+        on SECRET inside an if/while test.  (Eq/NotEq anywhere is already
+        the compare sink; truthiness / is-None / membership stay quiet.)"""
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare):
+                ops = [type(op) for op in node.ops]
+                if any(op in (ast.Lt, ast.LtE, ast.Gt, ast.GtE) for op in ops):
+                    for side in (node.left, *node.comparators):
+                        t = self.eval(side)
+                        if t.level >= SECRET:
+                            self._hit(
+                                "flow-secret-branch", node,
+                                f"branch depends on an ordered comparison of a "
+                                f"SECRET value{_why(t)} — a timing side channel",
+                            )
+                            break
+            elif isinstance(node, ast.BinOp):
+                t = join(self.eval(node.left), self.eval(node.right))
+                if t.level >= SECRET:
+                    self._hit(
+                        "flow-secret-branch", node,
+                        f"branch depends on arithmetic over a SECRET value"
+                        f"{_why(t)} — a timing side channel",
+                    )
+
+    def _check_compare(self, node: ast.Compare) -> None:
+        if self._branch_depth <= 0:
+            return  # expression position: vectorized masking, not a branch
+        if self._mask_depth > 0:
+            return  # masked selection: constant-time by construction
+        if not any(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            return
+        sides = [node.left, *node.comparators]
+        if any(isinstance(s, ast.Constant) and s.value is None for s in sides):
+            return
+        # ``arange(n) == x`` builds a one-hot/iota mask, not a comparison
+        for s in sides:
+            if isinstance(s, ast.Call) and (last_attr(s.func) or "") in (
+                    "arange", "iota"):
+                return
+        for side in sides:
+            t = self.eval(side)
+            if t.level >= DERIVED:
+                self._hit(
+                    "flow-secret-compare", node,
+                    f"{LEVEL_NAMES[t.level]} value{_why(t)} compared with "
+                    "==/!= — a variable-time comparison; use "
+                    "hmac.compare_digest",
+                )
+                return
+
+    # -- expressions ----------------------------------------------------------
+
+    def eval(self, node: ast.AST) -> Taint:
+        if isinstance(node, ast.Constant):
+            return T_PUBLIC
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, name_taint(node.id))
+        if isinstance(node, ast.Attribute):
+            path = _target_path(node)
+            if path is not None and path in self.env:
+                return self.env[path]
+            if node.attr in METADATA_ATTRS:
+                return T_PUBLIC   # sk.shape / arr.dtype are public metadata
+            base = self.eval(node.value) if not (
+                isinstance(node.value, ast.Name) and node.value.id == "self"
+            ) else T_PUBLIC
+            return join(base, name_taint(node.attr))
+        if isinstance(node, ast.Await):
+            return self.eval(node.value)
+        if isinstance(node, ast.Call):
+            return self.eval_call(node)
+        if isinstance(node, ast.Compare):
+            self._check_compare(node)
+            for side in (node.left, *node.comparators):
+                self.eval(side)
+            return T_PUBLIC
+        if isinstance(node, ast.BoolOp):
+            out = T_PUBLIC
+            for v in node.values:
+                out = join(out, self.eval(v))
+            return out
+        if isinstance(node, ast.BinOp):
+            return join(self.eval(node.left), self.eval(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand)
+        if isinstance(node, ast.IfExp):
+            self._branch_depth += 1
+            try:
+                self.eval(node.test)
+            finally:
+                self._branch_depth -= 1
+            return join(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            elems = tuple(self.eval(e) for e in node.elts)
+            level = max((e.level for e in elems), default=PUBLIC)
+            why = next((e.why for e in elems if e.level == level and e.why), "")
+            return Taint(level, elems if isinstance(node, ast.Tuple) else None, why)
+        if isinstance(node, (ast.Set, ast.Dict)):
+            out = T_PUBLIC
+            vals = node.values if isinstance(node, ast.Dict) else node.elts
+            for v in vals:
+                if v is not None:
+                    out = join(out, self.eval(v))
+            return Taint(out.level, None, out.why)
+        if isinstance(node, ast.Subscript):
+            return self.eval_subscript(node)
+        if isinstance(node, ast.JoinedStr):
+            out = T_PUBLIC
+            for part in node.values:
+                if isinstance(part, ast.FormattedValue):
+                    t = self.eval(part.value)
+                    if t.level >= DERIVED:
+                        self._hit(
+                            "flow-secret-format", part,
+                            f"f-string interpolates a {LEVEL_NAMES[t.level]} "
+                            f"value{_why(t)} — the rendered string carries key "
+                            "material wherever it goes",
+                        )
+                    out = join(out, t)
+            return Taint(out.level, None, out.why)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            out = T_PUBLIC
+            for gen in node.generators:
+                it = self.eval(gen.iter)
+                self.assign(gen.target, Taint(it.level, None, it.why), gen.iter)
+            for part in ([node.key, node.value] if isinstance(node, ast.DictComp)
+                         else [node.elt]):
+                out = join(out, self.eval(part))
+            return Taint(out.level, None, out.why)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        if isinstance(node, ast.Lambda):
+            return T_PUBLIC
+        return T_PUBLIC
+
+    def eval_subscript(self, node: ast.Subscript) -> Taint:
+        base = self.eval(node.value)
+        idx = node.slice
+        idx_taint = self.eval(idx) if not isinstance(idx, ast.Slice) else T_PUBLIC
+        if idx_taint.level >= SECRET:
+            self._hit(
+                "flow-secret-branch", node,
+                f"subscript indexed by a SECRET value{_why(idx_taint)} — a "
+                "cache-timing side channel (table lookups must not be "
+                "secret-addressed)",
+            )
+        if base.elements is not None and isinstance(idx, ast.Constant) and isinstance(
+                idx.value, int):
+            i = idx.value
+            if -len(base.elements) <= i < len(base.elements):
+                return base.elements[i]
+        if (base.level >= DERIVED and isinstance(idx, ast.Constant)
+                and isinstance(idx.value, str)):
+            from ..rules_secret import NONSECRET_NAME_RE
+
+            if NONSECRET_NAME_RE.search(idx.value):
+                return T_PUBLIC  # stored["public"] — the public half
+        return Taint(base.level, None, base.why)
+
+    def eval_call(self, call: ast.Call) -> Taint:
+        leaf = last_attr(call.func) or ""
+        arg_nodes = [*call.args, *[kw.value for kw in call.keywords]]
+        if leaf in MASK_FNS:
+            self._mask_depth += 1
+            try:
+                arg_taints = [self.eval(a) for a in arg_nodes]
+            finally:
+                self._mask_depth -= 1
+        else:
+            arg_taints = [self.eval(a) for a in arg_nodes]
+
+        # sink: logging (incl. the audit log), repr()/str()
+        if _is_logging_call(call):
+            for a, t in zip(arg_nodes, arg_taints):
+                if t.level >= DERIVED:
+                    self._hit(
+                        "flow-secret-in-log", a,
+                        f"{LEVEL_NAMES[t.level]} value{_why(t)} flows into "
+                        f"logging sink {leaf!r}",
+                    )
+        if isinstance(call.func, ast.Name) and call.func.id in ("repr", "str"):
+            for t in arg_taints:
+                if t.level >= DERIVED:
+                    self._hit(
+                        "flow-secret-format", call,
+                        f"{call.func.id}() of a {LEVEL_NAMES[t.level]} value"
+                        f"{_why(t)} renders key material",
+                    )
+        # sink: network send before AEAD
+        if leaf in NETWORK_SINKS:
+            for a, t in zip(arg_nodes, arg_taints):
+                if t.level >= DERIVED:
+                    self._hit(
+                        "flow-secret-to-network", a,
+                        f"{LEVEL_NAMES[t.level]} value{_why(t)} passed to "
+                        f"network sink {leaf!r} without AEAD",
+                    )
+        # wipes
+        if leaf in WIPERS:
+            for a in call.args:
+                path = _target_path(a)
+                if path is not None:
+                    self.env[path] = Taint(ZEROIZED, why=f"wiped by {leaf}()")
+            recv = call.func.value if isinstance(call.func, ast.Attribute) else None
+            path = _target_path(recv) if recv is not None else None
+            if path is not None:
+                self.env[path] = Taint(ZEROIZED, why=f"wiped by {leaf}()")
+            return T_PUBLIC
+
+        # interprocedural propagation into resolved callees
+        sites = self.cg.edges_at.get(id(call), [])
+        for site in sites:
+            self._propagate_args(site, call, arg_taints)
+
+        # result taint: model > sanitizer > summaries > propagate
+        if leaf == "retrieve":
+            # vault lookups: only secret-named entries are key material
+            # (identity records, peer aliases, settings stay public)
+            arg0 = call.args[0] if call.args else None
+            entry = None
+            if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+                entry = arg0.value
+            elif isinstance(arg0, ast.Name):
+                entry = self._module_const(arg0.id)
+            if (entry is not None and not is_secret_name(entry)
+                    and "key" not in entry.lower()):
+                return T_PUBLIC
+            return MODELS[leaf]
+        if leaf in MODELS:
+            return MODELS[leaf]
+        if leaf in SANITIZERS:
+            return T_PUBLIC
+        rets = [self.summaries[s.callee.fid].ret for s in sites
+                if s.kind in ("call", "await") and s.callee.fid in self.summaries]
+        if rets:
+            out = rets[0]
+            for r in rets[1:]:
+                out = join(out, r)
+            return out
+        out = T_PUBLIC
+        for t in arg_taints:
+            out = join(out, Taint(t.level, None, t.why))
+        if isinstance(call.func, ast.Attribute):
+            recv_t = self.eval(call.func.value)
+            out = join(out, Taint(recv_t.level, None, recv_t.why))
+        return out
+
+    def _module_const(self, name: str) -> str | None:
+        """Value of a module-level ``NAME = "literal"`` in this file."""
+        cache = getattr(self.fn.ctx, "_qrflow_consts", None)
+        if cache is None:
+            cache = {}
+            for node in self.fn.ctx.tree.body:
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    cache[node.targets[0].id] = node.value.value
+            self.fn.ctx._qrflow_consts = cache  # type: ignore[attr-defined]
+        return cache.get(name)
+
+    def _propagate_args(self, site, call: ast.Call, arg_taints: list[Taint]) -> None:
+        callee = site.callee
+        params = callee.params
+        offset = 0
+        if params and params[0] == "self" and (
+                isinstance(call.func, ast.Attribute) or site.kind == "partial"):
+            offset = 1
+        updates = self.callee_updates.setdefault(callee.fid, {})
+        pos_taints = arg_taints[: len(call.args)]
+        kw_taints = arg_taints[len(call.args):]
+        if site.kind == "partial":
+            pos_taints = pos_taints[1:]   # args[0] is the callable itself
+        for i, t in enumerate(pos_taints):
+            if t.level > PUBLIC or t.elements:
+                idx = i + offset
+                if idx < len(params):
+                    updates[idx] = join(updates.get(idx, T_PUBLIC), t)
+        for kw, t in zip(call.keywords, kw_taints):
+            if kw.arg and kw.arg in params and (t.level > PUBLIC or t.elements):
+                idx = params.index(kw.arg)
+                updates[idx] = join(updates.get(idx, T_PUBLIC), t)
+
+
+def _target_path(node: ast.AST) -> str | None:
+    """Env key for a Name or dotted self-attribute chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _target_path(node.value)
+        if base is not None:
+            return f"{base}.{node.attr}"
+    return None
+
+
+def _is_empty_const(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and not node.value
+
+
+def _why(t: Taint) -> str:
+    return f" (from {t.why})" if t.why else ""
+
+
+class TaintEngine:
+    """Worklist fixpoint over per-function summaries with a summary cache."""
+
+    MAX_VISITS = 24   # safety valve; the lattice bounds real iteration counts
+
+    def __init__(self, cg: CallGraph):
+        self.cg = cg
+        self.summaries: dict[str, Summary] = {
+            fid: Summary() for fid in cg.functions}
+        self.param_taint: dict[str, list[Taint]] = {}
+        #: fid -> {param vector -> (return taint, callee arg-taint updates)}:
+        #: the summary cache — a pass whose inputs (param taints AND callee
+        #: summaries) are unchanged is a pure replay.  Entries are dropped
+        #: for every caller whenever a callee's summary rises.
+        self._cache: dict[str, dict[tuple[Taint, ...],
+                                    tuple[Taint, dict[str, dict[int, Taint]]]]] = {}
+        self.cache_hits = 0
+
+    def _params_key(self, fid: str) -> tuple[Taint, ...]:
+        return tuple(self.param_taint.get(fid, []))
+
+    def solve(self) -> None:
+        order = sorted(self.cg.functions)
+        visits: dict[str, int] = {}
+        work = list(order)
+        queued = set(order)
+        while work:
+            fid = work.pop(0)
+            queued.discard(fid)
+            if visits.get(fid, 0) >= self.MAX_VISITS:
+                continue
+            visits[fid] = visits.get(fid, 0) + 1
+            fn = self.cg.functions[fid]
+            key = self._params_key(fid)
+            cached = self._cache.get(fid, {}).get(key)
+            if cached is not None:
+                # summary cache: same function + same parameter taints (and
+                # no callee-summary change since, which invalidates below)
+                # means the pass is a pure replay — reuse, skip the walk
+                self.cache_hits += 1
+                ret, callee_updates = cached
+            else:
+                tp = TaintPass(fn, self.cg, self.summaries, self.param_taint)
+                tp.run()
+                ret, callee_updates = tp.ret, tp.callee_updates
+                self._cache.setdefault(fid, {})[key] = (ret, callee_updates)
+
+            def enqueue(f: str) -> None:
+                if f not in queued:
+                    queued.add(f)
+                    work.append(f)
+
+            # push argument taints into callees
+            for callee_fid, updates in callee_updates.items():
+                callee = self.cg.functions.get(callee_fid)
+                if callee is None:
+                    continue
+                vec = self.param_taint.setdefault(
+                    callee_fid, [T_PUBLIC] * len(callee.params))
+                changed = False
+                for idx, t in updates.items():
+                    if idx < len(vec):
+                        new = join(vec[idx], t)
+                        if new != vec[idx]:
+                            vec[idx] = new
+                            changed = True
+                if changed:
+                    enqueue(callee_fid)
+            # publish the return summary (monotone: only a JOIN that actually
+            # raises the summary re-enqueues callers)
+            new_ret = join(self.summaries[fid].ret, ret)
+            if new_ret != self.summaries[fid].ret:
+                self.summaries[fid].ret = new_ret
+                for site in self.cg.edges_by_callee.get(fid, []):
+                    # the caller's cached passes saw the OLD summary
+                    self._cache.pop(site.caller.fid, None)
+                    enqueue(site.caller.fid)
+
+    def report_pass(self, include: Callable[[FunctionInfo], bool],
+                    report: Callable[[SinkHit], None]) -> None:
+        """Final pass with stable summaries, emitting sink findings."""
+        for fid in sorted(self.cg.functions):
+            fn = self.cg.functions[fid]
+            if not include(fn):
+                continue
+            TaintPass(fn, self.cg, self.summaries, self.param_taint,
+                      report=report).run()

@@ -20,3 +20,8 @@ echo "python -m quantum_resistant_p2p_tpu --help ok"
 # Static-analysis ratchet: the tree must lint clean (docs/static_analysis.md).
 python -m tools.analysis.run quantum_resistant_p2p_tpu
 echo "qrlint clean"
+
+# Dataflow ratchet: interprocedural secret-taint / constant-time / race
+# analysis must also pass (every suppression carries a justification).
+python -m tools.analysis.flow.run quantum_resistant_p2p_tpu
+echo "qrflow clean"

@@ -68,6 +68,15 @@ SECTIONS: dict[str, list[str]] = {
         "quantum_resistant_p2p_tpu.utils.profiling",
         "quantum_resistant_p2p_tpu.utils.ctr_drbg",
     ],
+    "analysis": [
+        "tools.analysis.engine",
+        "tools.analysis.flow",
+        "tools.analysis.flow.callgraph",
+        "tools.analysis.flow.taint",
+        "tools.analysis.flow.domains",
+        "tools.analysis.flow.packs",
+        "tools.analysis.flow.sarif",
+    ],
 }
 
 
@@ -118,21 +127,35 @@ def render_module(modname: str) -> str:
     return "\n".join(lines)
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--only", action="append", metavar="SECTION",
+                    help="regenerate only these section page(s); other pages "
+                         "are left untouched (useful on minimal images where "
+                         "some sections' modules cannot import)")
+    args = ap.parse_args(argv)
+    wanted = set(args.only or SECTIONS)
+    unknown = wanted - set(SECTIONS)
+    if unknown:
+        print(f"unknown section(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+        return 2
     OUT_DIR.mkdir(parents=True, exist_ok=True)
     index = ["# API reference", "",
              "Generated from docstrings by `tools/gen_api_docs.py`; regenerate "
              "after API changes.", ""]
     for section, modules in SECTIONS.items():
-        page = [f"# {section}", ""]
-        for modname in modules:
-            page.append(render_module(modname))
-            page.append("")
-        out = OUT_DIR / f"{section}.md"
-        out.write_text("\n".join(page))
+        if section in wanted:
+            page = [f"# {section}", ""]
+            for modname in modules:
+                page.append(render_module(modname))
+                page.append("")
+            out = OUT_DIR / f"{section}.md"
+            out.write_text("\n".join(page))
+            print(f"wrote {out}")
         index.append(f"- [{section}]({section}.md): " + ", ".join(
             f"`{m.split('.')[-1]}`" for m in modules))
-        print(f"wrote {out}")
     (OUT_DIR / "README.md").write_text("\n".join(index) + "\n")
     print(f"wrote {OUT_DIR / 'README.md'}")
     return 0
