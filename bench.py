@@ -63,13 +63,18 @@ def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
     import asyncio
     import sys
 
-    from tools.swarm_bench import run_swarm
+    from tools.swarm_bench import run_swarm, write_obs_artifacts
 
     stats = asyncio.run(
         run_swarm(peers, backend="tpu", use_batching=True, max_batch=4096,
                   max_wait_ms=2.0, concurrency=1, warmup=warmup,
                   prewarm=True, slo=True)
     )
+    # obs/ artifacts ride along with the SLO JSON (bench_results/): the
+    # trace-event file renders the measured handshakes as flame graphs
+    # (the 4-trips budget, visible) and the metrics snapshot captures the
+    # queue/breaker state the p50/p99 numbers were measured under
+    write_obs_artifacts(stats, "bench_results", stem="slo")
     p50 = stats.get("p50_handshake_s")
     fraction = stats.get("device_served_fraction")
     out = {

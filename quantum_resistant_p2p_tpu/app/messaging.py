@@ -34,6 +34,9 @@ from pathlib import Path
 from typing import Any, Callable
 
 from ..net.p2p_node import P2PNode
+from ..obs import flight as obs_flight
+from ..obs import trace as obs_trace
+from ..obs.metrics import Registry
 from ..provider import get_fused, get_kem, get_signature, get_symmetric
 from ..provider.base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricAlgorithm
 from .message_store import Message
@@ -201,12 +204,33 @@ class SecureMessaging:
         self._bkem = self._bsig = self._bfused = None
         self._warmup_thread = None
         self._queue_breaker = None
+        # The engine's metrics registry (obs/metrics.py) — the single source
+        # metrics() reads from: the pre-existing queue/breaker/opcache
+        # counters join via collectors, new resilience counters and the
+        # per-handshake trip histogram live here directly.
+        self.registry = Registry(name=f"messaging:{node.node_id[:8]}")
         #: dispatch trips per completed initiated handshake (integer samples;
         #: meaningful at concurrency 1 — overlapping handshakes share the
-        #:  breaker counter).  docs/dispatch_budget.md defines the budget.
-        from ..utils.profiling import LatencyHistogram
-
-        self._handshake_trips = LatencyHistogram()
+        #: breaker counter).  docs/dispatch_budget.md defines the budget;
+        #: integer bucket boundaries make the percentiles exact.
+        self._handshake_trips = self.registry.histogram(
+            "handshake_trips", "dispatch trips per initiated handshake",
+            buckets=tuple(float(i) for i in range(33)),
+        )
+        self._ctr_rekeys = self.registry.counter(
+            "rekeys", "automatic re-keys after AEAD failures")
+        self._ctr_heals_ok = self.registry.counter(
+            "heals_ok", "session heals that reconnected and re-keyed")
+        self._ctr_heals_failed = self.registry.counter(
+            "heals_failed", "session heals that gave up")
+        self._ctr_outbox_queued = self.registry.counter(
+            "outbox_queued", "messages parked while a session healed")
+        self._ctr_outbox_dropped = self.registry.counter(
+            "outbox_dropped", "parked messages dropped (capacity or give-up)")
+        self._ctr_handshake_giveups = self.registry.counter(
+            "handshake_giveups", "initiated handshakes that failed finally")
+        self.registry.register_collector("queues", self._collect_queues)
+        self.registry.register_collector("opcaches", self._collect_opcaches)
         if use_batching:
             from ..provider.batched import BatchedKEM, BatchedSignature, Breaker
 
@@ -428,11 +452,15 @@ class SecureMessaging:
                     # API) mid-heal: the outbox must not strand silently
                     dropped = len(self._outbox.pop(peer_id, []))
                     if dropped:
+                        self._ctr_outbox_dropped.inc(dropped)
                         logger.warning(
                             "session heal for %s abandoned (no longer "
                             "healable); %d queued message(s) dropped",
                             peer_id[:8], dropped,
                         )
+                    self._ctr_heals_failed.inc()
+                    obs_flight.record("heal_abandoned", peer=peer_id[:8],
+                                      dropped=dropped)
                     return
                 await asyncio.sleep(delay)
                 delay *= 2
@@ -440,12 +468,16 @@ class SecureMessaging:
                     break
             else:
                 dropped = len(self._outbox.pop(peer_id, []))
+                self._ctr_outbox_dropped.inc(dropped)
+                self._ctr_heals_failed.inc()
                 logger.warning(
                     "session heal: %s unreachable after %d redials; giving up"
                     " (%d queued message(s) dropped)",
                     peer_id[:8], HEAL_ATTEMPTS, dropped,
                 )
                 self._log("session_heal", peer=peer_id, success=False)
+                obs_flight.trigger("heal_giveup", peer=peer_id[:8],
+                                   reason="unreachable", dropped=dropped)
                 return
             # reconnect fired the "connect" event, which reset the session
             # state; establish a fresh key before flushing anything
@@ -462,24 +494,31 @@ class SecureMessaging:
                         break
                     await asyncio.sleep(0.05)
             if ok:
+                self._ctr_heals_ok.inc()
                 logger.warning(
                     "session heal: %s reconnected and re-keyed; flushing %d "
                     "queued message(s)",
                     peer_id[:8], len(self._outbox.get(peer_id, [])),
                 )
                 self._log("session_heal", peer=peer_id, success=True)
+                obs_flight.record("heal_ok", peer=peer_id[:8],
+                                  flushed=len(self._outbox.get(peer_id, [])))
                 await self._flush_outbox(peer_id)
             else:
                 # reconnected but could not re-key: the outbox must not
                 # strand silently — drop it loudly, exactly like the
                 # unreachable case above
                 dropped = len(self._outbox.pop(peer_id, []))
+                self._ctr_outbox_dropped.inc(dropped)
+                self._ctr_heals_failed.inc()
                 logger.warning(
                     "session heal: %s reconnected but re-handshake failed; "
                     "giving up (%d queued message(s) dropped)",
                     peer_id[:8], dropped,
                 )
                 self._log("session_heal", peer=peer_id, success=False)
+                obs_flight.trigger("heal_giveup", peer=peer_id[:8],
+                                   reason="rehandshake_failed", dropped=dropped)
         finally:
             self._healing.discard(peer_id)
             # a message queued in the window between the flush completing
@@ -496,8 +535,10 @@ class SecureMessaging:
         """Park an outbound message while its session heals (bounded)."""
         box = self._outbox.setdefault(peer_id, [])
         if len(box) >= OUTBOX_CAPACITY:
+            self._ctr_outbox_dropped.inc()
             logger.warning("outbox for %s full; dropping message", peer_id[:8])
             return None
+        self._ctr_outbox_queued.inc()
         message = Message(
             content=content,
             sender_id=self.node_id,
@@ -541,6 +582,7 @@ class SecureMessaging:
                     # no further heal possible (intentional disconnect,
                     # node stopping): never strand silently
                     dropped = len(self._outbox.pop(peer_id, []))
+                    self._ctr_outbox_dropped.inc(dropped)
                     logger.warning(
                         "outbox for %s not healable; %d queued message(s) "
                         "dropped", peer_id[:8], dropped,
@@ -587,6 +629,15 @@ class SecureMessaging:
                 return True
             transient = status in ("timeout", RejectReason.INVALID_SIGNATURE.value)
             if not transient or attempt == retries or not self.node.is_connected(peer_id):
+                if status != "already_in_flight":
+                    # final failure: a flight-recorder trigger (auto-dumps a
+                    # diagnostic bundle when armed) — a benign concurrent
+                    # initiation is not a give-up
+                    self._ctr_handshake_giveups.inc()
+                    obs_flight.trigger(
+                        "handshake_giveup", peer=peer_id[:8], status=status,
+                        attempt=attempt + 1,
+                    )
                 return False
             logger.warning(
                 "key exchange with %s failed (%s); retry %d/%d in %.2fs",
@@ -598,6 +649,13 @@ class SecureMessaging:
 
     async def _initiate_once(self, peer_id: str) -> str:
         """One handshake attempt -> "ok" | "timeout" | a typed failure."""
+        with obs_trace.span("handshake.initiate", peer=peer_id[:8],
+                            kem=self.kem.name, sig=self.signature.name) as sp:
+            status = await self._initiate_attempt(peer_id)
+            sp.set_attr("status", status)
+            return status
+
+    async def _initiate_attempt(self, peer_id: str) -> str:
         if self.ke_state.get(peer_id) == KeyExchangeState.INITIATED:
             logger.info("handshake with %s already in flight", peer_id[:8])
             return "already_in_flight"
@@ -743,45 +801,73 @@ class SecureMessaging:
         b = self._bkem.breaker if self._bkem is not None else None
         return (b.device_trips + b.fallback_trips) if b is not None else 0
 
-    def metrics(self) -> dict[str, Any]:
-        """Operational counters: per-queue stats, aggregate dispatch trips,
-        operand-cache hit rates, and trips-per-initiated-handshake."""
-        out: dict[str, Any] = {
-            "backend": self.backend,
-            "batching": self.use_batching,
-        }
-        if self._bkem is not None:
-            out["kem_queue"] = self._bkem.stats()
-            out["sig_queue"] = self._bsig.stats()
-            if self._bfused is not None:
-                out["fused_queue"] = self._bfused.stats()
-            b = self._bkem.breaker
-            out["device_trips"] = b.device_trips
-            out["fallback_trips"] = b.fallback_trips
-            out["breaker_trips"] = b.trips
-            out["breaker_state"] = b.state
-            out["breaker_opens"] = b.opens
-            out["breaker_closes"] = b.closes
-            # the degradation gauge across every queue of this engine
-            # (VERDICT r3: a silently cpu-served "TPU" fleet must be visible)
-            total = fb = 0
-            for fam_key in ("kem_queue", "sig_queue", "fused_queue"):
-                for q in out.get(fam_key, {}).values():
-                    total += q["ops"]
-                    fb += q["fallback_ops"]
-            out["device_served_fraction"] = (
-                round((total - fb) / total, 4) if total else None
-            )
+    def _collect_queues(self) -> dict[str, Any]:
+        """Registry collector: the queue/breaker counters this engine's
+        facades already keep, absorbed at snapshot time (obs/metrics.py —
+        no second set of hot-path increments)."""
+        out: dict[str, Any] = {}
+        if self._bkem is None:
+            return out
+        out["kem_queue"] = self._bkem.stats()
+        out["sig_queue"] = self._bsig.stats()
+        if self._bfused is not None:
+            out["fused_queue"] = self._bfused.stats()
+        b = self._bkem.breaker
+        out["device_trips"] = b.device_trips
+        out["fallback_trips"] = b.fallback_trips
+        out["breaker_trips"] = b.trips
+        out["breaker_state"] = b.state
+        out["breaker_opens"] = b.opens
+        out["breaker_closes"] = b.closes
+        # the degradation gauge across every queue of this engine
+        # (VERDICT r3: a silently cpu-served "TPU" fleet must be visible)
+        total = fb = 0
+        for fam_key in ("kem_queue", "sig_queue", "fused_queue"):
+            for q in out.get(fam_key, {}).values():
+                total += q["ops"]
+                fb += q["fallback_ops"]
+        out["device_served_fraction"] = (
+            round((total - fb) / total, 4) if total else None
+        )
+        return out
+
+    def _collect_opcaches(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
         for algo, key in ((self.kem, "kem_opcache"), (self.signature, "sig_opcache")):
             cache = getattr(algo, "opcache", None)
             if cache is not None:
                 out[key] = cache.stats()
+        return out
+
+    def metrics(self) -> dict[str, Any]:
+        """Operational counters: per-queue stats, aggregate dispatch trips,
+        operand-cache hit rates, and trips-per-initiated-handshake — read
+        from the obs registry (obs/metrics.py), which is also what the
+        Prometheus exporter and flight-recorder bundles serve.  The legacy
+        key layout is a compatibility contract (tests/test_obs.py parity
+        test): keys are never removed or renamed, only added."""
+        out: dict[str, Any] = {
+            "backend": self.backend,
+            "batching": self.use_batching,
+        }
+        # the registry's collectors ARE the source; calling them directly
+        # skips exporting every instrument just to read two dicts back
+        out.update(self._collect_queues())
+        out.update(self._collect_opcaches())
         t = self._handshake_trips
         out["handshake_trips"] = {
             "count": t.count,
             "last": int(t.last) if t.last is not None else None,
             "p50": t.percentile(50),
             "p99": t.percentile(99),
+        }
+        out["resilience"] = {
+            "rekeys": self._ctr_rekeys.value,
+            "heals_ok": self._ctr_heals_ok.value,
+            "heals_failed": self._ctr_heals_failed.value,
+            "outbox_queued": self._ctr_outbox_queued.value,
+            "outbox_dropped": self._ctr_outbox_dropped.value,
+            "handshake_giveups": self._ctr_handshake_giveups.value,
         }
         return out
 
@@ -892,6 +978,12 @@ class SecureMessaging:
         """Responder: verify, encapsulate, derive, reply (reference: :695-905)."""
         data = msg.get("ke_data") or {}
         message_id = data.get("message_id", "?")
+        with obs_trace.span("handshake.respond", peer=peer_id[:8],
+                            kem=self.kem.name):
+            await self._handle_ke_init_inner(peer_id, msg, data, message_id)
+
+    async def _handle_ke_init_inner(self, peer_id: str, msg: dict, data: dict,
+                                    message_id: str) -> None:
         if await self._fused_handle_ke_init(peer_id, msg, data, message_id):
             return
         err = await self._check_common(peer_id, data, msg.get("sig", b""),
@@ -997,6 +1089,13 @@ class SecureMessaging:
         if entry is None or entry[0] != peer_id:
             logger.warning("ke_response for unknown exchange %s", message_id)
             return
+        with obs_trace.span("handshake.confirm", peer=peer_id[:8]):
+            await self._handle_ke_response_inner(peer_id, msg, data,
+                                                 message_id, entry)
+
+    async def _handle_ke_response_inner(self, peer_id: str, msg: dict,
+                                        data: dict, message_id: str,
+                                        entry) -> None:
         fused = await self._fused_handle_ke_response(
             peer_id, msg, data, message_id, entry
         )
@@ -1298,6 +1397,9 @@ class SecureMessaging:
                 _wipe(self.raw_secrets.pop(peer_id, None))
                 self.ke_state[peer_id] = KeyExchangeState.NONE
                 self._log("rekey", peer=peer_id, reason="aead_failures")
+                self._ctr_rekeys.inc()
+                obs_flight.record("rekey", peer=peer_id[:8],
+                                  reason="aead_failures", failures=failures)
                 self._spawn(self.initiate_key_exchange(peer_id), "rekey")
             return
         self._aead_failures.pop(peer_id, None)

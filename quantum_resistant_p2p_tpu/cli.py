@@ -80,7 +80,13 @@ commands:
   /settings                  show current + available algorithms
   /set kem|aead|sig <name>   hot-swap an algorithm
   /adopt <peer>              adopt the peer's gossiped settings
-  /metrics                   security metrics (events, bytes, algorithms)
+  /metrics [prom]            security + operational metrics (queues, breaker,
+                             trips, resilience counters; "prom" prints the
+                             Prometheus text exposition instead)
+  /trace [path]              export recent spans as chrome://tracing JSON
+                             (load in chrome://tracing or ui.perfetto.dev)
+  /flight [path]             dump the flight-recorder diagnostic bundle
+                             (recent redacted events + metrics snapshot)
   /logs [type] [n] [--since T] [--until T]
                              decrypted audit log (latest n, default 20;
                              T: 30m/2h/1d relative, HH:MM, or ISO date)
@@ -296,7 +302,43 @@ class CLI:
             ok = await m.adopt_peer_settings(self._peer(args[0]))
             self.print("adopted peer settings" if ok else "no gossiped settings for peer")
         elif cmd == "/metrics":
-            self.print(json.dumps(self.secure_logger.get_security_metrics(), indent=2))
+            if args and args[0] == "prom":
+                self.print(m.registry.to_prometheus())
+            else:
+                self.print(json.dumps(
+                    {
+                        "security": self.secure_logger.get_security_metrics(),
+                        "operational": m.metrics(),
+                    },
+                    indent=2, default=str,
+                ))
+        elif cmd == "/trace":
+            from .obs import trace as obs_trace
+
+            records = obs_trace.TRACER.snapshot()
+            path = Path(args[0]) if args else (
+                get_app_data_dir() / f"trace_{int(time.time())}.json"
+            )
+
+            def _export(records=records, path=path):
+                # render + serialize + write all off-loop: at the ring cap
+                # that is thousands of event dicts, and the loop is also
+                # serving TCP peers
+                path.write_text(json.dumps(obs_trace.to_chrome_trace(records)))
+
+            await asyncio.get_running_loop().run_in_executor(None, _export)
+            self.print(f"{len(records)} span(s) -> {path} "
+                       "(load in chrome://tracing or ui.perfetto.dev)")
+        elif cmd == "/flight":
+            from .obs import flight as obs_flight
+
+            path = Path(args[0]) if args else (
+                get_app_data_dir() / f"flight_{int(time.time())}.json"
+            )
+            bundle = await asyncio.get_running_loop().run_in_executor(
+                None, obs_flight.dump, "manual", path
+            )
+            self.print(f"{len(bundle['events'])} event(s) -> {path}")
         elif cmd == "/logs":
             # Filter surface of the reference's log viewer (event-type combo +
             # time-range pickers, ui/log_viewer_dialog.py:137-151) as args:

@@ -149,6 +149,13 @@ class FaultPlan:
     def _record(self, entry: dict[str, Any]) -> None:
         with self._lock:
             self.injected.append(entry)
+        # every injected fault is a flight-recorder trigger (obs/flight.py):
+        # chaos runs auto-dump a diagnostic bundle when a dump dir is armed,
+        # making PR-3's seeded scenarios explainable event-by-event.  The
+        # entry carries only scope/action/labels — no payload bytes.
+        from ..obs import flight as _flight
+
+        _flight.trigger("fault_injected", seed=self.seed, **entry)
 
     # -- scope hooks (called by the module-level functions below) ------------
 

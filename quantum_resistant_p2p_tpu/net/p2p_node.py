@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
 
 from ..faults import plan as _faults
+from ..obs import trace as obs_trace
 
 logger = logging.getLogger(__name__)
 
@@ -293,24 +294,27 @@ class P2PNode:
         if peer is None:
             logger.warning("send to unknown peer %s", peer_id[:8])
             return False
-        # fault-injection boundary (faults/): a plan may drop, delay, or
-        # corrupt this message BEFORE encoding — a no-op without a plan
-        action, payload2 = _faults.net_send(self.node_id, peer_id, msg_type,
-                                            payload)
-        if action == "drop":
-            return True  # swallowed by the (simulated) network
-        if action == "delay":
-            await asyncio.sleep(payload2)
-        else:
-            payload = payload2
-        message = {"type": msg_type, **{k: _encode_value(v) for k, v in payload.items()}}
-        try:
-            await self._send_frame(peer.writer, peer.write_lock, message)
-            return True
-        except (ConnectionError, OSError) as e:
-            logger.warning("send to %s failed: %s; evicting", peer_id[:8], e)
-            await self.disconnect_from_peer(peer_id, intentional=False)
-            return False
+        # the send rides the caller's span chain (a handshake's net sends
+        # interleave with its device dispatches in the flame graph)
+        with obs_trace.span("net.send", peer=peer_id[:8], msg_type=msg_type):
+            # fault-injection boundary (faults/): a plan may drop, delay, or
+            # corrupt this message BEFORE encoding — a no-op without a plan
+            action, payload2 = _faults.net_send(self.node_id, peer_id, msg_type,
+                                                payload)
+            if action == "drop":
+                return True  # swallowed by the (simulated) network
+            if action == "delay":
+                await asyncio.sleep(payload2)
+            else:
+                payload = payload2
+            message = {"type": msg_type, **{k: _encode_value(v) for k, v in payload.items()}}
+            try:
+                await self._send_frame(peer.writer, peer.write_lock, message)
+                return True
+            except (ConnectionError, OSError) as e:
+                logger.warning("send to %s failed: %s; evicting", peer_id[:8], e)
+                await self.disconnect_from_peer(peer_id, intentional=False)
+                return False
 
     async def _send_frame(self, writer, lock: asyncio.Lock, message: dict) -> None:
         body = json.dumps(message, separators=(",", ":")).encode()
@@ -387,11 +391,14 @@ class P2PNode:
         handlers = self._msg_handlers.get(msg_type, [])
         if not handlers:
             logger.debug("no handler for message type %r", msg_type)
-        for h in list(handlers):
-            try:
-                await h(peer_id, decoded)
-            except Exception:
-                logger.exception("handler for %r failed", msg_type)
+        # a fresh root per inbound message: handler work (and any crypto
+        # dispatches it enqueues) correlates under one receive trace
+        with obs_trace.span("net.recv", peer=peer_id[:8], msg_type=msg_type):
+            for h in list(handlers):
+                try:
+                    await h(peer_id, decoded)
+                except Exception:
+                    logger.exception("handler for %r failed", msg_type)
 
 
 def _encode_value(v: Any) -> Any:

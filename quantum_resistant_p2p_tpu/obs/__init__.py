@@ -1,0 +1,34 @@
+"""``obs`` — the unified observability subsystem (docs/observability.md).
+
+Three cooperating pieces, stdlib-only (importable on minimal images, no
+jax/cryptography dependency):
+
+* :mod:`.trace`   — correlated span tracer: contextvar-propagated span
+  contexts across ``await``/task boundaries with explicit handoff across
+  the warmup-thread/executor edges, a bounded ring of finished spans, and
+  a chrome://tracing trace-event exporter (one handshake = one flame
+  graph proving the 4-trips budget).
+* :mod:`.metrics` — typed registry (Counter/Gauge/Histogram, thread-safe,
+  allocation-free hot path) with collector absorption of the pre-existing
+  counters (``QueueStats``, breaker, opcaches) and JSON-snapshot +
+  Prometheus-text exporters.
+* :mod:`.flight`  — bounded ring-buffer flight recorder of recent
+  spans/events, redacted at record time with qrlint's secret-hygiene
+  vocabulary, auto-dumping a diagnostic bundle on breaker-open /
+  quarantine / handshake-give-up / injected-fault triggers.
+
+Every layer above reports through here: the batch queue and breaker
+(provider/batched.py), the protocol engine (app/messaging.py), the
+transport (net/p2p_node.py), the fault engine (faults/), the health gate
+(provider/health.py), and the bench harnesses (bench.py --slo,
+tools/swarm_bench.py).
+"""
+
+from __future__ import annotations
+
+from . import flight, metrics, trace  # noqa: F401
+from .flight import FlightRecorder  # noqa: F401
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      LatencyHistogram, Registry)
+from .trace import (Span, SpanContext, Tracer, current,  # noqa: F401
+                    span, to_chrome_trace)

@@ -1,0 +1,251 @@
+"""Correlated span tracer — the "where did this handshake spend its time"
+half of the observability layer (SURVEY.md §5; docs/observability.md).
+
+A :class:`Span` is one timed region with a name, a correlation context
+(``trace_id`` shared by a whole causal chain, ``span_id`` unique per
+region, ``parent_id`` linking the chain), and a small dict of public
+attributes.  The CURRENT span context lives in a :mod:`contextvars`
+variable, so it propagates automatically across ``await`` boundaries and
+into tasks (``loop.create_task`` / ``call_later`` copy the context at
+scheduling time — which is exactly why a batch queue's timer-driven flush
+inherits the context of the handshake that enqueued first).
+
+Two boundaries do NOT propagate contextvars and need an explicit handoff:
+``loop.run_in_executor`` workers and plain ``threading.Thread`` targets
+(the same edges qrflow's ownership-domain pack maps).  Capture
+:func:`current` on the loop side and pass it as ``parent=`` on the far
+side::
+
+    parent = trace.current()                    # loop side
+    def work():                                 # executor/thread side
+        with trace.span("device.dispatch", parent=parent, op=label):
+            ...
+
+Finished spans land in a bounded ring buffer and are fed to the flight
+recorder (obs/flight.py).  :func:`to_chrome_trace` renders a span list as
+chrome://tracing / Perfetto trace-event JSON, so a single traced handshake
+loads as a flame graph (the PR-2 four-trips-per-handshake budget, visible).
+
+Span attributes are DIAGNOSTIC METADATA — op labels, batch sizes, peer-id
+prefixes, states.  Key material must never be passed as an attribute:
+qrflow's ``flow-secret-in-trace`` sink rule enforces this statically, and
+the flight recorder redacts defensively at record time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+#: the current span context of this task/thread (None outside any span).
+#: Module-level so every tracer shares one propagation chain.
+_CURRENT: contextvars.ContextVar["SpanContext | None"] = contextvars.ContextVar(
+    "qrp2p_obs_span", default=None
+)
+
+
+class SpanContext:
+    """Immutable correlation handle: pass it across executor/thread hops."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"SpanContext({self.trace_id}/{self.span_id})"
+
+
+class Span:
+    """One live timed region.  All identity fields are fixed at
+    construction; the attribute dict is mutated only via :meth:`set_attr`
+    (lock-guarded: a span handle may legitimately cross the executor
+    boundary it was captured around)."""
+
+    __slots__ = ("name", "context", "parent_id", "attrs", "_lock")
+
+    def __init__(self, name: str, context: SpanContext, parent_id: str | None,
+                 attrs: dict[str, Any]):
+        self.name = name
+        self.context = context
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self._lock = threading.Lock()
+
+    def set_attr(self, key: str, value: Any) -> None:
+        """Attach one more public attribute to the span."""
+        with self._lock:
+            self.attrs[key] = value
+
+
+class Tracer:
+    """Bounded-ring span recorder with deterministic id assignment.
+
+    ``clock`` is injectable (tests pin it for byte-stable golden exports);
+    the default is a perf_counter timeline relative to tracer creation, so
+    exported timestamps are small non-negative microsecond offsets.
+    """
+
+    def __init__(self, cap: int = 4096,
+                 clock: Callable[[], float] | None = None):
+        self._lock = threading.Lock()
+        self._spans: deque[dict[str, Any]] = deque(maxlen=cap)
+        self._listeners: list[Callable[[dict[str, Any]], None]] = []
+        self._next_id = 0
+        if clock is None:
+            epoch = time.perf_counter()
+            clock = lambda: time.perf_counter() - epoch  # noqa: E731
+        self._clock = clock
+
+    # -- ids ------------------------------------------------------------------
+
+    def _new_id(self) -> str:
+        with self._lock:
+            self._next_id += 1
+            return f"{self._next_id:08x}"
+
+    # -- span lifecycle -------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: SpanContext | None = None,
+             **attrs: Any):
+        """Open a span; the block's duration is the span's duration.
+
+        ``parent`` defaults to the ambient context (contextvar); pass an
+        explicitly captured :func:`current` when crossing an executor or
+        thread boundary.  The span context is installed as ambient for the
+        duration of the block, so nested spans chain automatically.
+        """
+        if parent is None:
+            parent = _CURRENT.get()
+        if parent is None:
+            trace_id = "t" + self._new_id()
+            parent_id = None
+        else:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        ctx = SpanContext(trace_id, self._new_id())
+        sp = Span(name, ctx, parent_id, dict(attrs))
+        token = _CURRENT.set(ctx)
+        t0 = self._clock()
+        try:
+            yield sp
+        except BaseException as exc:
+            sp.set_attr("error", type(exc).__name__)
+            raise
+        finally:
+            _CURRENT.reset(token)
+            self._finish(sp, t0, self._clock() - t0)
+
+    def _finish(self, sp: Span, t0: float, dur: float) -> None:
+        with sp._lock:
+            # the handle may have crossed to a worker still set_attr-ing;
+            # copy under ITS lock or the dict can change size mid-copy
+            attrs = dict(sp.attrs)
+        rec = {
+            "name": sp.name,
+            "trace_id": sp.context.trace_id,
+            "span_id": sp.context.span_id,
+            "parent_id": sp.parent_id,
+            "t0": t0,
+            "dur": dur,
+            "thread": threading.current_thread().name,
+            "attrs": attrs,
+        }
+        with self._lock:
+            self._spans.append(rec)
+            listeners = list(self._listeners)
+        for cb in listeners:
+            try:
+                cb(rec)
+            except Exception:  # qrlint: disable=broad-except  — a failing listener (e.g. a torn-down flight recorder in tests) must never break the traced operation
+                pass
+
+    # -- consumption ----------------------------------------------------------
+
+    def add_listener(self, cb: Callable[[dict[str, Any]], None]) -> None:
+        """Subscribe to finished spans (the flight recorder's feed)."""
+        with self._lock:
+            if cb not in self._listeners:
+                self._listeners.append(cb)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        """Finished spans, oldest first (a copy)."""
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        """Drop recorded spans (tests; long-lived sessions before an export)."""
+        with self._lock:
+            self._spans.clear()
+
+
+def current() -> SpanContext | None:
+    """The ambient span context — capture on the loop side, pass as
+    ``parent=`` on the far side of an executor/thread hop."""
+    return _CURRENT.get()
+
+
+#: process-wide default tracer: instrumentation sites record here
+TRACER = Tracer()
+
+
+def span(name: str, parent: SpanContext | None = None, **attrs: Any):
+    """``TRACER.span(...)`` convenience (the form instrumentation uses)."""
+    return TRACER.span(name, parent=parent, **attrs)
+
+
+# -- chrome://tracing export --------------------------------------------------
+
+
+def to_chrome_trace(records: list[dict[str, Any]]) -> dict[str, Any]:
+    """Render finished-span records as a chrome://tracing (trace-event
+    format) JSON object: complete events (``"ph": "X"``) with microsecond
+    timestamps, one tid lane per recording thread, correlation ids in
+    ``args``.  Load the dumped JSON in chrome://tracing or
+    https://ui.perfetto.dev to see the flame graph.
+    """
+    tids: dict[str, int] = {}
+    events: list[dict[str, Any]] = []
+    for rec in records:
+        tid = tids.setdefault(rec["thread"], len(tids) + 1)
+        events.append({
+            "name": rec["name"],
+            "ph": "X",
+            "ts": round(rec["t0"] * 1e6, 3),
+            "dur": round(rec["dur"] * 1e6, 3),
+            "pid": 1,
+            "tid": tid,
+            "cat": rec["name"].split(".", 1)[0],
+            "args": {
+                "trace_id": rec["trace_id"],
+                "span_id": rec["span_id"],
+                "parent_id": rec["parent_id"],
+                **rec["attrs"],
+            },
+        })
+    meta = [
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+         "args": {"name": thread}}
+        for thread, tid in sorted(tids.items(), key=lambda kv: kv[1])
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str = "/tmp/qrp2p_trace"):
+    """Profile everything inside the block with ``jax.profiler``; view with
+    TensorBoard.  (Moved from ``utils.profiling``; a deprecation shim keeps
+    the old import path working.)"""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

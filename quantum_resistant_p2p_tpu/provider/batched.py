@@ -30,7 +30,9 @@ from typing import Any, Callable
 import numpy as np
 
 from ..faults import plan as _faults
-from ..utils.profiling import LatencyHistogram
+from ..obs import flight as obs_flight
+from ..obs import trace as obs_trace
+from ..obs.metrics import LatencyHistogram
 from .base import (KeyExchangeAlgorithm, SignatureAlgorithm,
                    next_pow2 as _next_pow2, pad_rows as _pad_rows)
 
@@ -56,7 +58,7 @@ class QueueStats:
     device_trips: int = 0
     #: per-flush batch sizes, most recent last (bounded)
     batch_sizes: list[int] = field(default_factory=list)
-    #: per-flush dispatch latency percentiles (utils.profiling)
+    #: per-flush dispatch latency percentiles (obs.metrics)
     dispatch_hist: LatencyHistogram = field(default_factory=LatencyHistogram)
     BATCH_SIZE_HISTORY = 1024
 
@@ -169,11 +171,15 @@ class Breaker:
             return self.state == "open" and time.monotonic() < self._open_until
 
     def _set_state(self, new: str, why: str = "") -> None:
-        """Transition + loud log.  Callers hold ``self._lock`` (RLock)."""
+        """Transition + loud log + structured flight-recorder event (the
+        one-time WARNINGs were log-only and invisible to tooling before
+        obs/; breaker-open and quarantine are auto-dump triggers).
+        Callers hold ``self._lock`` (RLock)."""
         with self._lock:
             if new == self.state:
                 return
             log = logging.getLogger(__name__)
+            old = self.state
             self.state = new
             if new == "open":
                 self.opens += 1
@@ -194,6 +200,18 @@ class Breaker:
                     "circuit breaker QUARANTINED (%s): device path disabled for "
                     "this process; all ops served from the cpu fallback", why,
                 )
+            # emit AFTER the bookkeeping so the event carries the real
+            # counters (open/quarantined are auto-dump triggers; the bundle
+            # build runs on the flight recorder's own thread, never here)
+            emit = (obs_flight.trigger if new in ("open", "quarantined")
+                    else obs_flight.record)
+            emit(
+                "breaker_open" if new == "open"
+                else "breaker_quarantined" if new == "quarantined"
+                else "breaker_transition",
+                state=new, prev=old, why=why, cooloff_s=round(self.cooloff_s, 3),
+                opens=self.opens, closes=self.closes,
+            )
 
     def trip(self) -> None:
         """Record a device failure observed outside the claim protocol
@@ -477,7 +495,22 @@ class OpQueue:
         self.stats.fallback_ops += len(items)
         self.breaker.fallback_trips += 1
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self.fallback_fn, items)
+        parent = obs_trace.current()
+        return await loop.run_in_executor(
+            None, self._traced_call, self.fallback_fn, "fallback.dispatch",
+            "fallback", parent, items,
+        )
+
+    def _traced_call(self, fn, span_name: str, route: str, parent,
+                     items: list[Any]) -> list[Any]:
+        """Run one dispatch callable inside a span, ON the worker thread —
+        so the span measures the actual device/fallback time and carries
+        the worker's thread lane in the flame graph.  ``parent`` is the
+        loop-side context captured before the executor hop (contextvars do
+        not cross ``run_in_executor``)."""
+        with obs_trace.span(span_name, parent=parent, op=self.label,
+                            n=len(items), route=route):
+            return fn(items)
 
     def _count_trip(self) -> None:
         """One serial device round trip (device or warmup executor): the
@@ -504,7 +537,10 @@ class OpQueue:
         loop = asyncio.get_running_loop()
         if self.fallback_fn is None:
             self._count_trip()
-            return await loop.run_in_executor(None, self._device_call, items)
+            return await loop.run_in_executor(
+                None, self._traced_call, self._device_call, "device.dispatch",
+                "direct", obs_trace.current(), items,
+            )
         claim = self.breaker.acquire_dispatch()
         if claim == "fallback":
             return await self._run_fallback(items)
@@ -524,8 +560,11 @@ class OpQueue:
             self.breaker.release(claim)  # nothing dispatches on this claim
             if start_warm:
                 self._count_trip()
-                warm = loop.run_in_executor(self.breaker.warmup_executor,
-                                            self._warm_call, items)
+                warm = loop.run_in_executor(
+                    self.breaker.warmup_executor, self._traced_call,
+                    self._warm_call, "device.dispatch", "warmup",
+                    obs_trace.current(), items,
+                )
 
                 def _mark(f, b=bucket):
                     if f.cancelled():
@@ -566,8 +605,11 @@ class OpQueue:
         self._count_trip()
         # Dedicated 2-thread device pool: an abandoned hung dispatch can never
         # starve the default executor that the cpu fallback runs on.
-        device = loop.run_in_executor(self.breaker.device_executor,
-                                      self._device_call, items)
+        device = loop.run_in_executor(
+            self.breaker.device_executor, self._traced_call,
+            self._device_call, "device.dispatch", claim,
+            obs_trace.current(), items,
+        )
         try:
             results = await asyncio.wait_for(
                 asyncio.shield(device), self.dispatch_timeout_s * scale
@@ -602,7 +644,13 @@ class OpQueue:
         self.stats.total_wait_s += time.perf_counter() - first_t
         t0 = time.perf_counter()
         try:
-            results = await self._run_batch(items)
+            # The flush task inherits the context captured when its timer/
+            # task was scheduled — i.e. the FIRST enqueuer's span — so a
+            # handshake's flushes chain under its handshake span.
+            with obs_trace.span("queue.flush", op=self.label, n=len(items),
+                                waited_ms=round(
+                                    1e3 * (t0 - first_t), 3)):
+                results = await self._run_batch(items)
             dt = time.perf_counter() - t0
             self.stats.total_dispatch_s += dt
             self.stats.dispatch_hist.record(dt)

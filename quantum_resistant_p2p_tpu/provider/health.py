@@ -331,7 +331,11 @@ def gate_facades(*facades) -> list[HealthVerdict]:
             verdict = ensure_validated(facade.algo,
                                        getattr(facade, "fallback", None))
         out.append(verdict)
+        from ..obs import flight as _flight
+
         if verdict.ok:
+            _flight.record("health_ok", family=verdict.family,
+                           detail=verdict.detail, cached=verdict.cached)
             logger.info("device health %s: ok (%s)%s", verdict.family,
                         verdict.detail, " [cached]" if verdict.cached else "")
             continue
@@ -339,6 +343,10 @@ def gate_facades(*facades) -> list[HealthVerdict]:
             "device health %s: FAILED (%s) in environment %s",
             verdict.family, verdict.detail, env_fingerprint(),
         )
+        # the quarantine below emits the breaker_quarantined trigger; this
+        # event records the verdict itself (also for the no-fallback case)
+        _flight.record("health_failed", family=verdict.family,
+                       detail=verdict.detail, env=env_fingerprint())
         have_fb = (getattr(facade, "fallback", None) is not None
                    or getattr(facade, "fallback_kem", None) is not None)
         if have_fb:

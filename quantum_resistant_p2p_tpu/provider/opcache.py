@@ -74,9 +74,13 @@ class DeviceOperandCache:
                 self._entries.popitem(last=False)
                 self.evictions += 1
 
-    def clear(self) -> None:
+    def clear(self) -> int:
+        """Drop every entry; returns how many were released (read and
+        cleared under one lock hold, so the count is exact)."""
         with self._lock:
+            n = len(self._entries)
             self._entries.clear()
+            return n
 
     def zeroize(self) -> None:
         """End the cached keys' device-state lifetime (same convention as
@@ -86,7 +90,13 @@ class DeviceOperandCache:
         buffers to the runtime (host code cannot overwrite device memory, so
         release is the strongest zeroization available here).  Called by
         SecureMessaging's hot-swap paths."""
-        self.clear()
+        n = self.clear()
+        # key-lifetime events belong in the flight ring: a dump after a
+        # hot-swap shows WHEN the outgoing provider's device state was
+        # released (counts only — never key identities)
+        from ..obs import flight as _flight
+
+        _flight.record("opcache_zeroized", entries=n)
 
     def __len__(self) -> int:
         with self._lock:

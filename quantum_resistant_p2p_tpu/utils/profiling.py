@@ -1,80 +1,26 @@
-"""Tracing / profiling hooks — the observability layer SURVEY.md §5 notes the
-reference lacks (its only timing is ad-hoc time.time() deltas in the test
-harness).
+"""Deprecated shim — the profiling tools moved into the observability
+subsystem (``quantum_resistant_p2p_tpu.obs``, PR 5):
 
-Two tools:
-* ``device_trace``: context manager around ``jax.profiler`` producing a
-  TensorBoard-loadable trace of the batched crypto dispatches.
-* ``LatencyHistogram``: sliding-window percentile tracker backing the
-  batch queue's per-flush dispatch stats (provider/batched.py QueueStats,
-  surfaced via the CLI's /batchstats and the swarm benchmark's hub_queue
-  section).
+* ``LatencyHistogram``  -> :class:`quantum_resistant_p2p_tpu.obs.metrics.LatencyHistogram`
+* ``device_trace``      -> :func:`quantum_resistant_p2p_tpu.obs.trace.device_trace`
+
+Existing imports keep working through this module; new code should import
+from ``obs`` directly (this shim will be removed once nothing imports it).
 """
 
 from __future__ import annotations
 
-import collections
-import contextlib
-import time
+import warnings
 
+from ..obs.metrics import LatencyHistogram  # noqa: F401
+from ..obs.trace import device_trace  # noqa: F401
 
-@contextlib.contextmanager
-def device_trace(log_dir: str = "/tmp/qrp2p_trace"):
-    """Profile everything inside the block; view with TensorBoard."""
-    import jax
+__all__ = ["LatencyHistogram", "device_trace"]
 
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield log_dir
-    finally:
-        jax.profiler.stop_trace()
-
-
-class LatencyHistogram:
-    """Sliding-window percentile tracker over the last ``cap`` samples.
-
-    A deque of recent samples, sorted on demand: percentiles reflect the
-    CURRENT behavior of the system (a lifetime reservoir would keep
-    reporting stale latencies long after a regression starts).  Queries are
-    rare (metrics dialogs, bench summaries), so the O(cap log cap) sort per
-    query is the right trade against per-record cost.
-    """
-
-    def __init__(self, cap: int = 1024):
-        self._window: collections.deque[float] = collections.deque(maxlen=cap)
-        self.count = 0
-        self.total = 0.0
-        #: most recent sample (None before the first record): metrics
-        #: surfaces like "trips in the last handshake" want the latest
-        #: observation, not a percentile of the window
-        self.last: float | None = None
-
-    def record(self, seconds: float) -> None:
-        self.count += 1
-        self.total += seconds
-        self._window.append(seconds)
-        self.last = seconds
-
-    @contextlib.contextmanager
-    def time(self):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.record(time.perf_counter() - t0)
-
-    def percentile(self, p: float) -> float | None:
-        if not self._window:
-            return None
-        s = sorted(self._window)
-        return s[min(len(s) - 1, int(p / 100.0 * len(s)))]
-
-    def summary(self) -> dict:
-        return {
-            "count": self.count,
-            "mean_s": self.total / self.count if self.count else None,
-            "last_s": self.last,
-            "p50_s": self.percentile(50),
-            "p95_s": self.percentile(95),
-            "p99_s": self.percentile(99),
-        }
+warnings.warn(
+    "quantum_resistant_p2p_tpu.utils.profiling moved to "
+    "quantum_resistant_p2p_tpu.obs (metrics.LatencyHistogram, "
+    "trace.device_trace); update imports",
+    DeprecationWarning,
+    stacklevel=2,
+)

@@ -66,6 +66,33 @@ def test_two_clis_chat(run, tmp_path):
     run(main())
 
 
+def test_trace_flight_and_prometheus_commands(run, tmp_path):
+    """The obs/ surface: /trace exports loadable chrome://tracing JSON,
+    /flight dumps a diagnostic bundle, /metrics prom emits the text
+    exposition format (docs/observability.md)."""
+    import json
+
+    async def main():
+        a, a_out = _mk(tmp_path, "obs")
+        await a.start()
+        tpath = tmp_path / "trace.json"
+        assert await a.handle(f"/trace {tpath}")
+        doc = json.loads(tpath.read_text())
+        assert "traceEvents" in doc and doc["displayTimeUnit"] == "ms"
+        fpath = tmp_path / "flight.json"
+        assert await a.handle(f"/flight {fpath}")
+        bundle = json.loads(fpath.read_text())
+        assert bundle["trigger"] == "manual"
+        assert "events" in bundle and "metrics" in bundle
+        assert await a.handle("/metrics prom")
+        assert "qrp2p_" in a_out.getvalue()
+        assert await a.handle("/metrics")
+        assert '"operational"' in a_out.getvalue()
+        assert not await a.handle("/quit")
+
+    run(main())
+
+
 def test_showkey_formats_warning_and_audit(run, tmp_path, monkeypatch):
     async def main():
         a, a_out = _mk(tmp_path, "a2")

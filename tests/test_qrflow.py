@@ -314,6 +314,58 @@ def test_sink_compare_trigger_clean_and_mask_exemptions():
     ) == []
 
 
+def test_sink_trace_trigger_clean_suppressed():
+    """flow-secret-in-trace: span attributes, metric labels, and flight
+    payloads are secret sinks (obs/ exports them in cleartext diagnostics)."""
+    assert rule_ids(
+        """
+        def f(tracer, kem, a, b):
+            ss = kem.decapsulate(a, b)
+            with tracer.span("op", material=ss):
+                pass
+        """
+    ) == ["flow-secret-in-trace"]
+    # metadata about the secret is fine (len() sanitizes)
+    assert rule_ids(
+        """
+        def f(tracer, kem, a, b):
+            ss = kem.decapsulate(a, b)
+            with tracer.span("op", n=len(ss)):
+                pass
+        """
+    ) == []
+    # flight-recorder payloads are sinks (receiver hint: flight/recorder)
+    assert rule_ids(
+        """
+        def f(flight, secret_key):
+            flight.record("ev", material=secret_key)
+        """
+    ) == ["flow-secret-in-trace"]
+    # metric label values are sinks unconditionally
+    assert rule_ids(
+        """
+        def g(counter, secret_key):
+            counter.labels(peer=secret_key).inc()
+        """
+    ) == ["flow-secret-in-trace"]
+    # an unrelated record() receiver stays quiet even with a secret nearby
+    assert rule_ids(
+        """
+        def h(window, secret_key):
+            window.record(len(secret_key))
+        """
+    ) == []
+    findings, suppressed = lint(
+        """
+        def f(flight, kem, a, b):
+            ss = kem.decapsulate(a, b)
+            flight.record("probe", digest=ss)  # qrlint: disable=flow-secret-in-trace — fixture: pinned KAT vector, not live key material
+        """
+    )
+    assert not findings
+    assert [s.rule for s in suppressed] == ["flow-secret-in-trace"]
+
+
 def test_sink_branch_trigger_and_clean():
     ids = rule_ids(
         """
@@ -563,8 +615,8 @@ def test_list_rules(capsys):
     assert qrflow_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("flow-secret-in-log", "flow-secret-compare",
-                "cross-thread-state", "asyncio-off-loop",
-                "unjustified-suppression"):
+                "flow-secret-in-trace", "cross-thread-state",
+                "asyncio-off-loop", "unjustified-suppression"):
         assert rid in out
 
 

@@ -13,6 +13,8 @@ flow-secret-in-log      tainted value reaches a logging / audit-log call
 flow-secret-in-exception tainted value embedded in an exception message
 flow-secret-format      repr()/str()/f-string renders a tainted value
 flow-secret-to-network  tainted value reaches a network send before AEAD
+flow-secret-in-trace    tainted value reaches an observability sink (span
+                        attribute, metric label, flight-recorder payload)
 flow-secret-compare     ==/!= on key material (use hmac.compare_digest)
 flow-secret-branch      secret-dependent branch / secret-indexed lookup
 cross-thread-state      attribute written from two ownership domains unlocked
@@ -173,6 +175,13 @@ class SecretToNetworkFlowRule(_FlowRule):
     description = "key material reaches a network send before AEAD encryption"
 
 
+class SecretInTraceFlowRule(_FlowRule):
+    id = "flow-secret-in-trace"
+    description = ("key material reaches an observability sink — span "
+                   "attributes, metric labels, and flight-recorder payloads "
+                   "are exported in cleartext diagnostics (obs/)")
+
+
 class SecretCompareFlowRule(_FlowRule):
     id = "flow-secret-compare"
     description = ("==/!= on key material — variable-time comparison; "
@@ -211,8 +220,9 @@ class UnjustifiedSuppressionRule(Rule):
     #: suppression of THIS rule also needs a reason)
     _POLICED: frozenset[str] = frozenset({
         "flow-secret-in-log", "flow-secret-in-exception", "flow-secret-format",
-        "flow-secret-to-network", "flow-secret-compare", "flow-secret-branch",
-        "cross-thread-state", "asyncio-off-loop", "unjustified-suppression",
+        "flow-secret-to-network", "flow-secret-in-trace", "flow-secret-compare",
+        "flow-secret-branch", "cross-thread-state", "asyncio-off-loop",
+        "unjustified-suppression",
     })
 
     def check_project(self, project: Project) -> None:
@@ -254,6 +264,7 @@ class _LineNode:
 
 FLOW_RULES = (
     SecretInLogFlowRule, SecretInExceptionFlowRule, SecretFormatFlowRule,
-    SecretToNetworkFlowRule, SecretCompareFlowRule, SecretBranchFlowRule,
-    CrossThreadStateRule, AsyncioOffLoopRule, UnjustifiedSuppressionRule,
+    SecretToNetworkFlowRule, SecretInTraceFlowRule, SecretCompareFlowRule,
+    SecretBranchFlowRule, CrossThreadStateRule, AsyncioOffLoopRule,
+    UnjustifiedSuppressionRule,
 )

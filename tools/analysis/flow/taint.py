@@ -198,6 +198,17 @@ WIPERS = {"wipe", "_wipe", "zeroize", "_zeroize", "_wipe_secret", "wipe_secret"}
 
 NETWORK_SINKS = {"send_message", "sendall", "sendto"}
 
+#: observability sinks (obs/): span attributes, metric labels, and
+#: flight-recorder payloads are exported in cleartext diagnostics (trace
+#: files, Prometheus scrapes, flight bundles) — key material must never
+#: reach them.  Unconditional method names first; the generic names below
+#: count only on an obs-looking receiver (``TRACER.span``, ``obs_trace.
+#: span``, ``flight.record``, ``RECORDER.trigger``) so an unrelated
+#: ``foo.record()`` stays quiet.
+TRACE_SINKS = {"set_attr", "add_event", "labels"}
+TRACE_SINKS_BY_RECEIVER = {"span", "record", "record_event", "trigger"}
+TRACE_RECEIVER_HINTS = ("trace", "tracer", "flight", "recorder", "metric")
+
 #: vectorized masked-select primitives: an ``==``/``<`` producing a MASK for
 #: these is data-flow selection (constant-time by construction), not a
 #: variable-time comparison
@@ -568,6 +579,17 @@ class TaintPass:
                         f"{LEVEL_NAMES[t.level]} value{_why(t)} passed to "
                         f"network sink {leaf!r} without AEAD",
                     )
+        # sink: observability (span attrs / metric labels / flight payloads)
+        if self._is_trace_sink(call, leaf):
+            for a, t in zip(arg_nodes, arg_taints):
+                if t.level >= DERIVED:
+                    self._hit(
+                        "flow-secret-in-trace", a,
+                        f"{LEVEL_NAMES[t.level]} value{_why(t)} passed to "
+                        f"observability sink {leaf!r} — span attributes, "
+                        "metric labels, and flight-recorder payloads are "
+                        "exported in cleartext diagnostics",
+                    )
         # wipes
         if leaf in WIPERS:
             for a in call.args:
@@ -617,6 +639,24 @@ class TaintPass:
             recv_t = self.eval(call.func.value)
             out = join(out, Taint(recv_t.level, None, recv_t.why))
         return out
+
+    @staticmethod
+    def _is_trace_sink(call: ast.Call, leaf: str) -> bool:
+        """Observability-sink classification (see TRACE_SINKS above)."""
+        if leaf in TRACE_SINKS:
+            return True
+        if leaf not in TRACE_SINKS_BY_RECEIVER:
+            return False
+        if isinstance(call.func, ast.Name):
+            # `from obs.trace import span` usage: the bare name IS the sink
+            return call.func.id == "span"
+        if isinstance(call.func, ast.Attribute):
+            from ..engine import dotted_name
+
+            recv = (dotted_name(call.func.value)
+                    or last_attr(call.func.value) or "")
+            return any(h in recv.lower() for h in TRACE_RECEIVER_HINTS)
+        return False
 
     def _module_const(self, name: str) -> str | None:
         """Value of a module-level ``NAME = "literal"`` in this file."""

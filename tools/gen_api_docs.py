@@ -68,6 +68,11 @@ SECTIONS: dict[str, list[str]] = {
         "quantum_resistant_p2p_tpu.utils.profiling",
         "quantum_resistant_p2p_tpu.utils.ctr_drbg",
     ],
+    "obs": [
+        "quantum_resistant_p2p_tpu.obs.trace",
+        "quantum_resistant_p2p_tpu.obs.metrics",
+        "quantum_resistant_p2p_tpu.obs.flight",
+    ],
     "analysis": [
         "tools.analysis.engine",
         "tools.analysis.flow",

@@ -159,11 +159,11 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
         received = 0
         got_all.clear()
         # the warmup clients stay in `clients`; drop their trip samples so
-        # initiator_trips_* describes only the measured (warm) window
-        from quantum_resistant_p2p_tpu.utils.profiling import LatencyHistogram
-
+        # initiator_trips_* describes only the measured (warm) window (the
+        # histogram is an obs-registry instrument now — reset in place so
+        # the registry keeps pointing at the live object)
         for sm in clients:
-            sm._handshake_trips = LatencyHistogram()
+            sm._handshake_trips.reset()
         # QueueStats are cumulative; reset so device_served_pct and the
         # dispatch histograms describe ONLY the measured window (warmup
         # ops land on cold buckets / the fallback by design)
@@ -265,6 +265,32 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
     return stats
 
 
+def write_obs_artifacts(stats: dict, out_dir: str | Path,
+                        stem: str = "swarm") -> dict:
+    """Attach the run's observability artifacts to its JSON output
+    (bench_results/): a chrome://tracing trace-event file of the recorded
+    spans and a metrics snapshot of every live registry.  Returns the
+    paths added to ``stats``.  CI uploads these next to the qrflow SARIF.
+    """
+    from quantum_resistant_p2p_tpu.obs import metrics as obs_metrics
+    from quantum_resistant_p2p_tpu.obs import trace as obs_trace
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    records = obs_trace.TRACER.snapshot()
+    trace_path = out / f"{stem}_trace_events.json"
+    trace_path.write_text(json.dumps(obs_trace.to_chrome_trace(records)))
+    metrics_path = out / f"{stem}_metrics_snapshot.json"
+    metrics_path.write_text(json.dumps(obs_metrics.global_snapshot(),
+                                       indent=2, default=str))
+    stats["obs"] = {
+        "spans_recorded": len(records),
+        "trace_events_file": str(trace_path),
+        "metrics_snapshot_file": str(metrics_path),
+    }
+    return stats["obs"]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--peers", type=int, default=1000)
@@ -288,6 +314,9 @@ def main(argv=None) -> int:
                     help="single-handshake SLO probe: sequential handshakes "
                          "only, with per-handshake dispatch-trip accounting "
                          "(forces --concurrency 1)")
+    ap.add_argument("--obs-dir", default="bench_results",
+                    help="directory for the trace-event + metrics-snapshot "
+                         "artifacts (slo mode; '' disables)")
     args = ap.parse_args(argv)
     if args.slo:
         args.concurrency = 1
@@ -296,6 +325,8 @@ def main(argv=None) -> int:
                   args.max_wait_ms, args.concurrency, args.warmup,
                   args.ke_timeout, args.batch_floor, args.prewarm, args.slo)
     )
+    if args.slo and args.obs_dir:
+        write_obs_artifacts(stats, args.obs_dir)
     print(json.dumps(stats))
     return 0 if stats["failures"] == 0 else 1
 
