@@ -113,6 +113,42 @@ def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
     return 0
 
 
+def multichip_main(out_path: str | None, shards: str, hs_peers: int,
+                   emulate: int) -> int:
+    """1→N-chip scaling probe (tools/swarm_bench.run_multichip): batch-4096
+    ML-KEM-768 encaps/s on a GSPMD-sharded mesh plus warm handshakes/s
+    through the placement scheduler, at each shard count.  Writes the
+    scaling-curve JSON (a REAL ``MULTICHIP_r0N.json`` — earlier rounds
+    only recorded reachability) to ``--out`` and, for the CI artifact, to
+    ``bench_results/multichip_scaling.json``.
+
+    Exit status: non-zero when any shard count's handshake window had
+    failures (reachability-only environments still exit 0 with the
+    encaps-only curve).
+    """
+    import sys
+
+    from tools.swarm_bench import run_multichip
+
+    counts = tuple(int(c) for c in shards.split(",") if c)
+    out = run_multichip(shard_counts=counts, hs_peers=hs_peers,
+                        emulate=emulate)
+    line = json.dumps(out)
+    print(line)
+    from pathlib import Path
+
+    Path("bench_results").mkdir(exist_ok=True)
+    Path("bench_results/multichip_scaling.json").write_text(line + "\n")
+    if out_path:
+        Path(out_path).write_text(line + "\n")
+    failures = sum(e.get("failures") or 0 for e in out["shards"].values())
+    if failures:
+        print(f"MULTICHIP FAIL: {failures} handshake failure(s) across the "
+              "scaling sweep", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> None:
     from quantum_resistant_p2p_tpu.kem import mlkem
     from quantum_resistant_p2p_tpu.utils.benchmarking import enable_compile_cache, sync, timeit
@@ -188,13 +224,29 @@ if __name__ == "__main__":
     ap.add_argument("--slo", action="store_true",
                     help="latency SLO probe (sequential warm handshakes + "
                          "trips/handshake) instead of the throughput headline")
+    ap.add_argument("--multichip", action="store_true",
+                    help="1->N-chip scaling sweep (encaps/s on a sharded "
+                         "mesh + handshakes/s through the placement "
+                         "scheduler) instead of the single-chip headline")
     ap.add_argument("--out", default=None,
-                    help="also write the JSON line to this path (slo mode)")
+                    help="also write the JSON line to this path "
+                         "(slo/multichip modes)")
     ap.add_argument("--peers", type=int, default=SLO_PEERS,
                     help="handshakes in the slo probe")
     ap.add_argument("--warmup", type=int, default=4,
                     help="untimed warmup handshakes in the slo probe")
+    ap.add_argument("--shards", default="1,2,4,8",
+                    help="comma-separated shard counts for --multichip")
+    ap.add_argument("--hs-peers", type=int, default=32,
+                    help="warm handshakes per shard count in --multichip "
+                         "(0 skips the handshake half of the sweep)")
+    ap.add_argument("--emulate", type=int, default=0,
+                    help="force an N-device virtual CPU platform for "
+                         "--multichip (single-accelerator hosts)")
     args = ap.parse_args()
     if args.slo:
         raise SystemExit(slo_main(args.out, args.peers, args.warmup))
+    if args.multichip:
+        raise SystemExit(multichip_main(args.out, args.shards, args.hs_peers,
+                                        args.emulate))
     main()

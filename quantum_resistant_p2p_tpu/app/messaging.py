@@ -176,6 +176,7 @@ class SecureMessaging:
         max_wait_ms: float = 2.0,
         batch_floor: int = 1,
         mesh_devices: int = 0,
+        shard_devices: int = 0,
         sig_keypair: tuple[bytes, bytes] | None = None,
         breaker_cooloff_s: float = 30.0,
         auto_heal: bool = True,
@@ -187,6 +188,10 @@ class SecureMessaging:
         # multi-chip: tpu-backend providers shard device batches across a
         # mesh of this many chips (Config.mesh_devices; 0 = single device)
         self.mesh_devices = mesh_devices
+        # multi-chip, latency path: the batching queues place each flush
+        # on one of this many shards (provider/scheduler.py; 0/1 = one
+        # logical shard, bit-for-bit the classic single-device behavior)
+        self.shard_devices = shard_devices
         self.kem = kem or get_kem("ML-KEM-768", backend, devices=mesh_devices)
         self.symmetric = symmetric or get_symmetric("AES-256-GCM")
         self.signature = signature or get_signature("ML-DSA-65", backend,
@@ -231,19 +236,30 @@ class SecureMessaging:
             "handshake_giveups", "initiated handshakes that failed finally")
         self.registry.register_collector("queues", self._collect_queues)
         self.registry.register_collector("opcaches", self._collect_opcaches)
+        self._scheduler = None
         if use_batching:
-            from ..provider.batched import BatchedKEM, BatchedSignature, Breaker
+            from ..provider.batched import BatchedKEM, BatchedSignature
+            from ..provider.scheduler import DeviceProgramScheduler
 
-            # one breaker across KEM and signature queues: they share the
-            # device, so either discovering slowness shields both
-            self._queue_breaker = Breaker(cooloff_s=breaker_cooloff_s)
+            # the device-program scheduler: the placement axis every queue
+            # flush routes through.  One shard (the default) IS the old
+            # one-breaker world — shard 0's breaker doubles as the legacy
+            # _queue_breaker handle, so either path discovering slowness
+            # shields its sibling queues exactly as before; with
+            # shard_devices > 1 each shard gets its own breaker + heal
+            # cycle and a sick chip quarantines one shard, not the fleet.
+            self._scheduler = DeviceProgramScheduler(
+                shards=shard_devices, cooloff_s=breaker_cooloff_s,
+                registry=self.registry,
+            )
+            self._queue_breaker = self._scheduler.shards[0].breaker
             self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms,
                                     fallback=self._cpu_fallback_kem(),
-                                    breaker=self._queue_breaker,
+                                    scheduler=self._scheduler,
                                     bucket_floor=batch_floor)
             self._bsig = BatchedSignature(self.signature, max_batch, max_wait_ms,
                                           fallback=self._cpu_fallback_sig(),
-                                          breaker=self._queue_breaker,
+                                          scheduler=self._scheduler,
                                           bucket_floor=batch_floor)
             self._bfused = self._make_fused()
             self._spawn_warmup()
@@ -790,16 +806,23 @@ class SecureMessaging:
             max_wait_ms=max_wait_ms,
             fallback_kem=self._cpu_fallback_kem(),
             fallback_sig=self._cpu_fallback_sig(),
-            breaker=self._queue_breaker,
+            scheduler=self._scheduler,
             bucket_floor=self._batch_floor,
         )
 
     def _trips_now(self) -> int:
         """Serial dispatch steps (device + fallback) so far on the breaker
-        the live queues actually share (swarm clients share another stack's
-        queues, so the facade's breaker is the truthful one)."""
-        b = self._bkem.breaker if self._bkem is not None else None
-        return (b.device_trips + b.fallback_trips) if b is not None else 0
+        (or placement axis) the live queues actually share — swarm clients
+        share another stack's queues, so the facade's scheduler/breaker is
+        the truthful one.  Under a scheduler trips sum across every
+        shard's breaker (docs/dispatch_budget.md per-shard ledger)."""
+        if self._bkem is None:
+            return 0
+        sched = getattr(self._bkem, "scheduler", None)
+        if sched is not None:
+            return sched.total_trips()
+        b = self._bkem.breaker
+        return b.device_trips + b.fallback_trips
 
     def _collect_queues(self) -> dict[str, Any]:
         """Registry collector: the queue/breaker counters this engine's
@@ -813,12 +836,36 @@ class SecureMessaging:
         if self._bfused is not None:
             out["fused_queue"] = self._bfused.stats()
         b = self._bkem.breaker
-        out["device_trips"] = b.device_trips
-        out["fallback_trips"] = b.fallback_trips
-        out["breaker_trips"] = b.trips
-        out["breaker_state"] = b.state
-        out["breaker_opens"] = b.opens
-        out["breaker_closes"] = b.closes
+        sched = getattr(self._bkem, "scheduler", None)
+        if sched is not None:
+            # legacy keys stay truthful across the placement axis: trips
+            # and open/close counters SUM over every shard's breaker, and
+            # breaker_state reports the WORST shard — a dashboard/alert
+            # keyed on the documented legacy keys must fire when ANY
+            # shard degrades, not only shard 0.  (Mesh-of-1: one shard,
+            # so every value is identical to the old single breaker's.)
+            out["device_trips"] = sum(
+                s.breaker.device_trips for s in sched.shards)
+            out["fallback_trips"] = sum(
+                s.breaker.fallback_trips for s in sched.shards)
+            out["breaker_trips"] = sum(s.breaker.trips for s in sched.shards)
+            severity = {"closed": 0, "half_open": 1, "open": 2,
+                        "quarantined": 3}
+            out["breaker_state"] = max(
+                (s.breaker.state for s in sched.shards),
+                key=lambda st: severity.get(st, 0))
+            out["breaker_opens"] = sum(s.breaker.opens for s in sched.shards)
+            out["breaker_closes"] = sum(s.breaker.closes for s in sched.shards)
+            # the placement axis, per shard (additive key: the legacy
+            # layout above is a compatibility contract, tests/test_obs.py)
+            out["shards"] = sched.stats()
+        else:
+            out["device_trips"] = b.device_trips
+            out["fallback_trips"] = b.fallback_trips
+            out["breaker_trips"] = b.trips
+            out["breaker_state"] = b.state
+            out["breaker_opens"] = b.opens
+            out["breaker_closes"] = b.closes
         # the degradation gauge across every queue of this engine
         # (VERDICT r3: a silently cpu-served "TPU" fleet must be visible)
         total = fb = 0
@@ -1489,7 +1536,7 @@ class SecureMessaging:
 
             self._bkem = BatchedKEM(self.kem, *self._batch_cfg,
                                     fallback=self._cpu_fallback_kem(),
-                                    breaker=self._queue_breaker,
+                                    scheduler=self._scheduler,
                                     bucket_floor=self._batch_floor)
             self._bfused = self._make_fused()
             self._spawn_warmup(kem=True, sig=False)
@@ -1536,7 +1583,7 @@ class SecureMessaging:
 
             self._bsig = BatchedSignature(self.signature, *self._batch_cfg,
                                            fallback=self._cpu_fallback_sig(),
-                                           breaker=self._queue_breaker,
+                                           scheduler=self._scheduler,
                                            bucket_floor=self._batch_floor)
             self._bfused = self._make_fused()
             self._spawn_warmup(kem=False, sig=True)

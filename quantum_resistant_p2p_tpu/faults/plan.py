@@ -179,9 +179,12 @@ class FaultPlan:
             self._record(entry)
         return ("send", payload)
 
-    def device_dispatch(self, label: str, n_items: int) -> None:
-        """May raise FaultInjected (a device fault at the dispatch boundary)."""
-        info = {"op": label, "n_items": n_items}
+    def device_dispatch(self, label: str, n_items: int,
+                        shard: int | None = None) -> None:
+        """May raise FaultInjected (a device fault at the dispatch boundary).
+        ``shard`` is the placement-axis coordinate (provider/scheduler.py)
+        so a plan can kill ONE shard's device: match={"shard": i}."""
+        info = {"op": label, "n_items": n_items, "shard": shard}
         for _i, rule, entry in self._fire("device.dispatch", info,
                                           actions=("raise", "delay")):
             if rule.action == "raise":
@@ -315,10 +318,10 @@ def net_send(sender: str, peer: str, msg_type: str, payload: dict[str, Any]):
     return plan.net_send(sender, peer, msg_type, payload)
 
 
-def device_dispatch(label: str, n_items: int) -> None:
+def device_dispatch(label: str, n_items: int, shard: int | None = None) -> None:
     plan = _ACTIVE
     if plan is not None:
-        plan.device_dispatch(label, n_items)
+        plan.device_dispatch(label, n_items, shard=shard)
 
 
 def poison_results(label: str, results: list[Any]) -> list[Any]:

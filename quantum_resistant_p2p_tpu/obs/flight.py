@@ -177,11 +177,17 @@ class FlightRecorder:
             "metrics": registries,
         }
         if path is not None:
-            Path(path).parent.mkdir(parents=True, exist_ok=True)
-            Path(path).write_text(
+            path = Path(path)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            # atomic publish: dumps are written off-thread while consumers
+            # (operators, tests) poll the directory — a reader must never
+            # observe a half-written bundle
+            tmp = path.with_name(path.name + ".tmp")
+            tmp.write_text(
                 json.dumps(bundle, sort_keys=True, separators=(",", ":"),
                            default=str)
             )
+            os.replace(tmp, path)
         return bundle
 
     def trigger(self, kind: str, **fields: Any) -> None:

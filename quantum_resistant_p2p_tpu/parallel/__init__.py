@@ -13,4 +13,5 @@ from .mesh import (  # noqa: F401
     make_mesh,
     make_sharded_handshake,
     shard_batch,
+    shard_devices,
 )

@@ -44,6 +44,25 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), (BATCH_AXIS,))
 
 
+def shard_devices(n: int | None = None) -> list[jax.Device]:
+    """The first ``n`` devices of the placement axis (default: all).
+
+    The latency-path twin of :func:`make_mesh`: where the mesh shards ONE
+    big batch across chips (GSPMD), the placement axis
+    (provider/scheduler.py) pins each small queue flush WHOLE onto one of
+    these devices.  Raises like make_mesh when fewer devices exist."""
+    devs = jax.devices()
+    if n is not None:
+        if len(devs) < n:
+            raise RuntimeError(
+                f"need {n} devices, have {len(devs)} "
+                f"(set XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+                f"JAX_PLATFORMS=cpu before importing jax to emulate)"
+            )
+        devs = devs[:n]
+    return list(devs)
+
+
 def shard_batch(mesh: Mesh, *arrays: jax.Array):
     """Place arrays with their leading (batch) dim sharded across the mesh."""
     sharding = NamedSharding(mesh, P(BATCH_AXIS))

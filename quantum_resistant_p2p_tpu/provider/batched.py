@@ -87,7 +87,39 @@ class QueueStats:
         }
 
 
-class Breaker:
+class CoalescingHub:
+    """Shared flush-coalescing machinery: the queues registered on one hub
+    (a :class:`Breaker`, or the placement scheduler) flush in the same
+    scheduling window, so independent KEM/SIG batches go in flight
+    together instead of serialising one timer window apart.  Only queues
+    that already hold items are touched: nothing flushes emptier/earlier
+    than it would have on its own timer."""
+
+    def _init_coalescer(self) -> None:
+        #: weak: a hot-swapped facade's dead queues must not linger
+        import weakref
+
+        self._queues: weakref.WeakSet = weakref.WeakSet()
+        self._coalescing = False
+
+    def register_queue(self, queue: "OpQueue") -> None:
+        self._queues.add(queue)
+
+    def coalesce(self, origin: "OpQueue") -> None:
+        """Flush every sibling queue with pending items in the SAME
+        scheduling window as ``origin``'s flush."""
+        if self._coalescing:
+            return
+        self._coalescing = True
+        try:
+            for q in list(self._queues):
+                if q is not origin and q._items:
+                    q._flush_local()
+        finally:
+            self._coalescing = False
+
+
+class Breaker(CoalescingHub):
     """Shared circuit breaker for one device's dispatch path — a full
     closed -> open -> half-open state machine (the r3 self-healing fix:
     the old open/closed breaker let one transient device fault pin a fleet
@@ -138,6 +170,10 @@ class Breaker:
         self.base_cooloff_s = cooloff_s
         self.cooloff_s = cooloff_s  # current (grows exponentially while open)
         self.cooloff_max_s = cooloff_max_s
+        #: placement identity ("shard<i>" when owned by a scheduler shard):
+        #: rides in logs and flight events so a degraded SHARD is
+        #: distinguishable from a degraded fleet
+        self.label = ""
         self.state = "closed"
         self.trips = 0
         #: open/close transition counters (metrics; every transition also
@@ -156,12 +192,8 @@ class Breaker:
         self._probe_in_flight = False
         self._executor = None
         self._warmup_executor = None
-        #: queues sharing this breaker, for cross-queue coalesced flushes
-        #: (weak: a hot-swapped facade's dead queues must not linger)
-        import weakref
-
-        self._queues: weakref.WeakSet = weakref.WeakSet()
-        self._coalescing = False
+        # queues sharing this breaker coalesce their flushes (CoalescingHub)
+        self._init_coalescer()
 
     def is_open(self) -> bool:
         """True while no regular device dispatch may proceed."""
@@ -169,6 +201,20 @@ class Breaker:
             if self.state == "quarantined":
                 return True
             return self.state == "open" and time.monotonic() < self._open_until
+
+    def probe_ready(self) -> bool:
+        """True when the next :meth:`acquire_dispatch` would route a canary
+        probe (open past the cool-off, or half-open with no probe in
+        flight).  The placement policy (provider/scheduler.py) routes one
+        flush back to such a shard so it can heal — without this, a
+        multi-shard plane would starve open shards of the probe traffic
+        the half-open state machine needs."""
+        with self._lock:
+            if self._probe_in_flight or self.state == "quarantined":
+                return False
+            if self.state == "half_open":
+                return True
+            return self.state == "open" and time.monotonic() >= self._open_until
 
     def _set_state(self, new: str, why: str = "") -> None:
         """Transition + loud log + structured flight-recorder event (the
@@ -210,7 +256,7 @@ class Breaker:
                 else "breaker_quarantined" if new == "quarantined"
                 else "breaker_transition",
                 state=new, prev=old, why=why, cooloff_s=round(self.cooloff_s, 3),
-                opens=self.opens, closes=self.closes,
+                opens=self.opens, closes=self.closes, shard=self.label or None,
             )
 
     def trip(self) -> None:
@@ -296,31 +342,6 @@ class Breaker:
             if claim == "probe":
                 self._probe_in_flight = False
 
-    def register_queue(self, queue: "OpQueue") -> None:
-        self._queues.add(queue)
-
-    def coalesce(self, origin: "OpQueue") -> None:
-        """Flush every sibling queue with pending items in the SAME
-        scheduling window as ``origin``'s flush.
-
-        The queues share one device, but their dispatches run on the
-        2-thread device executor — flushing siblings now (instead of
-        letting each ride out its own max_wait timer) puts independent KEM
-        and SIG batches in flight TOGETHER, so a handshake step's unrelated
-        ops overlap instead of serialising one timer window apart.  Only
-        queues that already hold items are touched: nothing flushes
-        emptier/earlier than it would have on its own timer.
-        """
-        if self._coalescing:
-            return
-        self._coalescing = True
-        try:
-            for q in list(self._queues):
-                if q is not origin and q._items:
-                    q._flush_local()
-        finally:
-            self._coalescing = False
-
     @property
     def device_executor(self):
         if self._executor is None:
@@ -381,9 +402,14 @@ class OpQueue:
         breaker: Breaker | None = None,
         bucket_floor: int = 1,
         label: str = "",
+        scheduler=None,
     ):
         #: queue name at the fault-injection boundary (faults/) and in logs
         self.label = label
+        #: placement axis (provider.scheduler.DeviceProgramScheduler):
+        #: every flush is placed on one of its shards, each with its OWN
+        #: breaker + executors.  None = the classic single-breaker path.
+        self.scheduler = scheduler
         self.batch_fn = batch_fn
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
@@ -406,8 +432,16 @@ class OpQueue:
         #: clears a stuck _warming flag so warm-ups are retried (see
         #: _run_batch); generous — first compiles take minutes on a tunnel
         self.warmup_watchdog_s = 600.0
-        self.breaker = breaker if breaker is not None else Breaker()
-        self.breaker.register_queue(self)
+        if scheduler is not None:
+            # shard 0's breaker doubles as the compat handle (legacy stats
+            # readers); claims are taken per-PLACED-shard in _run_batch
+            self.breaker = (breaker if breaker is not None
+                            else scheduler.shards[0].breaker)
+            self._coalescer = scheduler
+        else:
+            self.breaker = breaker if breaker is not None else Breaker()
+            self._coalescer = self.breaker
+        self._coalescer.register_queue(self)
         #: pow2 sizes whose device program has completed at least once; a
         #: cold bucket's ops are served by the fallback while the compile
         #: runs in the background (never hostage to a compile).  Guarded by
@@ -450,11 +484,13 @@ class OpQueue:
         return await fut
 
     def _flush_soon(self) -> None:
-        """Flush this queue, then coalesce sibling queues sharing the breaker
-        into the same scheduling window (Breaker.coalesce) so independent
-        KEM/SIG batches go in flight together."""
+        """Flush this queue, then coalesce sibling queues sharing the
+        breaker/scheduler into the same scheduling window so independent
+        KEM/SIG batches go in flight together (under a scheduler, each
+        coalesced flush is then PLACED independently — siblings can land
+        on different shards and run in parallel)."""
         self._flush_local()
-        self.breaker.coalesce(self)
+        self._coalescer.coalesce(self)
 
     def _flush_local(self) -> None:
         """Detach pending items synchronously (so late submits can't bloat a
@@ -481,19 +517,24 @@ class OpQueue:
                 "batch dispatch task failed", exc_info=task.exception()
             )
 
-    def _trip_breaker(self, reason: str, dt: float, claim: str = "device") -> None:
+    def _trip_breaker(self, reason: str, dt: float, claim: str = "device",
+                      breaker: Breaker | None = None) -> None:
+        breaker = breaker if breaker is not None else self.breaker
         self.stats.breaker_trips += 1
-        self.breaker.record_failure(claim)
+        breaker.record_failure(claim)
         logging.getLogger(__name__).warning(
-            "batch queue %s: device dispatch %s (%.1fs); serving from cpu "
-            "fallback for %.0fs", self.label or "?", reason, dt,
-            self.breaker.cooloff_s,
+            "batch queue %s%s: device dispatch %s (%.1fs); serving from cpu "
+            "fallback for %.0fs", self.label or "?",
+            f" [{breaker.label}]" if breaker.label else "", reason, dt,
+            breaker.cooloff_s,
         )
 
-    async def _run_fallback(self, items: list[Any]) -> list[Any]:
+    async def _run_fallback(self, items: list[Any],
+                            breaker: Breaker | None = None) -> list[Any]:
+        breaker = breaker if breaker is not None else self.breaker
         self.stats.fallback_flushes += 1
         self.stats.fallback_ops += len(items)
-        self.breaker.fallback_trips += 1
+        breaker.fallback_trips += 1
         loop = asyncio.get_running_loop()
         parent = obs_trace.current()
         return await loop.run_in_executor(
@@ -502,48 +543,107 @@ class OpQueue:
         )
 
     def _traced_call(self, fn, span_name: str, route: str, parent,
-                     items: list[Any]) -> list[Any]:
+                     items: list[Any], shard=None) -> list[Any]:
         """Run one dispatch callable inside a span, ON the worker thread —
         so the span measures the actual device/fallback time and carries
         the worker's thread lane in the flame graph.  ``parent`` is the
         loop-side context captured before the executor hop (contextvars do
-        not cross ``run_in_executor``)."""
-        with obs_trace.span(span_name, parent=parent, op=self.label,
-                            n=len(items), route=route):
+        not cross ``run_in_executor``).  With a ``shard``, the call runs
+        under that shard's placement context (Shard.run_placed) and the
+        span carries the shard index — the flame graph shows which chip
+        served each dispatch."""
+        attrs = {"op": self.label, "n": len(items), "route": route}
+        if shard is not None:
+            attrs["shard"] = shard.index
+        with obs_trace.span(span_name, parent=parent, **attrs):
+            if shard is not None:
+                return shard.run_placed(fn, items)
             return fn(items)
 
-    def _count_trip(self) -> None:
+    def _count_trip(self, breaker: Breaker | None = None) -> None:
         """One serial device round trip (device or warmup executor): the
-        per-handshake SLO currency (docs/dispatch_budget.md)."""
+        per-handshake SLO currency (docs/dispatch_budget.md).  Recorded on
+        the PLACED shard's breaker so per-shard ledgers stay truthful."""
         self.stats.device_trips += 1
-        self.breaker.device_trips += 1
+        (breaker if breaker is not None else self.breaker).device_trips += 1
 
-    def _device_call(self, items: list[Any]) -> list[Any]:
+    def _device_call(self, items: list[Any], shard_index: int | None = None) -> list[Any]:
         """The device dispatch boundary: the explicit fault-injection hook
         (faults/) wraps the real batch fn — a raise here IS a device fault
-        and is handled (breaker + fallback) exactly like one."""
-        _faults.device_dispatch(self.label, len(items))
+        and is handled (breaker + fallback) exactly like one.  The shard
+        index rides into the fault-match info so chaos plans can kill ONE
+        shard's device (match={"shard": i})."""
+        _faults.device_dispatch(self.label, len(items), shard=shard_index)
         return _faults.poison_results(self.label, self.batch_fn(items))
 
     def _warm_call(self, items: list[Any]) -> list[Any]:
         """The warm-up boundary (fault scope "warmup": a killed warm-up
-        thread surfaces as this call raising)."""
+        thread surfaces as this call raising).  Under a scheduler the warm
+        runs on every CLOSED shard (``scheduler.warmable_shards``) — a
+        sick shard's hung device must not block warm-marking for the
+        healthy plane; it cold-compiles inside its first placed flush
+        after healing, absorbed by the slow-trip machinery."""
         _faults.warmup(self.label)
+        if self.scheduler is not None:
+            warm = self.scheduler.warmable_shards()
+            if warm:
+                out = None
+                for sh in warm:
+                    out = sh.run_placed(self.batch_fn, items)
+                return out
         return self.batch_fn(items)
 
-    async def _run_batch(self, items: list[Any]) -> list[Any]:
+    def _claim(self):
+        """The placement step: -> (shard | None, claim, breaker).  With a
+        scheduler, the flush is placed on a shard (load-aware, probe-first,
+        quarantine-aware) and the claim is taken on THAT shard's breaker;
+        without one, the classic single-breaker claim."""
+        if self.scheduler is not None:
+            shard = self.scheduler.place()
+            return shard, shard.breaker.acquire_dispatch(), shard.breaker
+        return None, self.breaker.acquire_dispatch(), self.breaker
+
+    async def _run_batch(self, items: list[Any], flush_span=None) -> list[Any]:
         """Device path with watchdog + breaker; falls back to cpu when the
-        device is slow, hung, or raising."""
+        device is slow, hung, or raising.  Each flush is placed whole on
+        one shard (when a scheduler is armed) — a flush never splits
+        across shards, so results stay bit-exact vs. the single path."""
         loop = asyncio.get_running_loop()
         if self.fallback_fn is None:
-            self._count_trip()
-            return await loop.run_in_executor(
-                None, self._traced_call, self._device_call, "device.dispatch",
-                "direct", obs_trace.current(), items,
-            )
-        claim = self.breaker.acquire_dispatch()
+            shard = self.scheduler.place() if self.scheduler is not None else None
+            if flush_span is not None and shard is not None:
+                flush_span.set_attr("shard", shard.index)
+            try:
+                self._count_trip(shard.breaker if shard is not None else None)
+                return await loop.run_in_executor(
+                    shard.breaker.device_executor if shard is not None else None,
+                    self._traced_call, self._direct_fn(shard),
+                    "device.dispatch", "direct", obs_trace.current(), items,
+                    shard,
+                )
+            finally:
+                if shard is not None:
+                    self.scheduler.done(shard)
+        shard, claim, breaker = self._claim()
+        if flush_span is not None and shard is not None:
+            flush_span.set_attr("shard", shard.index)
+        try:
+            return await self._run_claimed(loop, items, shard, claim, breaker)
+        finally:
+            if shard is not None:
+                self.scheduler.done(shard)
+
+    def _direct_fn(self, shard):
+        """Bind the shard index into the fault-hooked device call (the
+        callable crosses run_in_executor positionally)."""
+        if shard is None:
+            return self._device_call
+        return functools.partial(self._device_call, shard_index=shard.index)
+
+    async def _run_claimed(self, loop, items: list[Any], shard, claim: str,
+                           breaker: Breaker) -> list[Any]:
         if claim == "fallback":
-            return await self._run_fallback(items)
+            return await self._run_fallback(items, breaker)
         bucket = max(self.bucket_floor, _next_pow2(len(items)))
         scale = max(1.0, bucket / self.degrade_ref_batch)
         with self._warm_lock:
@@ -557,11 +657,11 @@ class OpQueue:
             # live ops hostage to a compile: serve them from the cpu NOW and
             # warm the bucket in the background (the nice-19 1-thread warmup
             # pool serialises compiles; the device takes over once warm).
-            self.breaker.release(claim)  # nothing dispatches on this claim
+            breaker.release(claim)  # nothing dispatches on this claim
             if start_warm:
-                self._count_trip()
+                self._count_trip(breaker)
                 warm = loop.run_in_executor(
-                    self.breaker.warmup_executor, self._traced_call,
+                    breaker.warmup_executor, self._traced_call,
                     self._warm_call, "device.dispatch", "warmup",
                     obs_trace.current(), items,
                 )
@@ -600,15 +700,17 @@ class OpQueue:
                         )
 
                 loop.call_later(self.warmup_watchdog_s, _unstick)
-            return await self._run_fallback(items)
+            return await self._run_fallback(items, breaker)
         t0 = time.perf_counter()
-        self._count_trip()
-        # Dedicated 2-thread device pool: an abandoned hung dispatch can never
-        # starve the default executor that the cpu fallback runs on.
+        self._count_trip(breaker)
+        # Dedicated 2-thread device pool PER BREAKER (per shard, under a
+        # scheduler — placed flushes on different shards genuinely run in
+        # parallel): an abandoned hung dispatch can never starve the
+        # default executor that the cpu fallback runs on.
         device = loop.run_in_executor(
-            self.breaker.device_executor, self._traced_call,
-            self._device_call, "device.dispatch", claim,
-            obs_trace.current(), items,
+            breaker.device_executor, self._traced_call,
+            self._direct_fn(shard), "device.dispatch", claim,
+            obs_trace.current(), items, shard,
         )
         try:
             results = await asyncio.wait_for(
@@ -617,22 +719,23 @@ class OpQueue:
         except asyncio.TimeoutError:
             # The device call cannot be cancelled (it is a thread); abandon it
             # to finish in the background and serve these ops from the cpu.
-            self._trip_breaker("timed out", time.perf_counter() - t0, claim)
+            self._trip_breaker("timed out", time.perf_counter() - t0, claim,
+                               breaker)
             device.add_done_callback(lambda f: f.exception())  # reap quietly
-            return await self._run_fallback(items)
+            return await self._run_fallback(items, breaker)
         except Exception as exc:  # qrlint: disable=broad-except  — the failure is recorded to the breaker and logged by _trip_breaker, then served from the fallback
             # The device dispatch RAISED (worker crash, compile blow-up,
             # injected fault): record it to the breaker and degrade — a
             # raising device must heal through the half-open probe exactly
             # like a slow one, not fail its waiters.
             self._trip_breaker(f"raised {type(exc).__name__}",
-                               time.perf_counter() - t0, claim)
-            return await self._run_fallback(items)
+                               time.perf_counter() - t0, claim, breaker)
+            return await self._run_fallback(items, breaker)
         dt = time.perf_counter() - t0
         if dt > self.degrade_after_s * scale:
-            self._trip_breaker("slow", dt, claim)
+            self._trip_breaker("slow", dt, claim, breaker)
         else:
-            self.breaker.record_success(claim)
+            breaker.record_success(claim)
         return results
 
     async def _dispatch(self, items: list[Any], futs: list[asyncio.Future],
@@ -649,8 +752,10 @@ class OpQueue:
             # handshake's flushes chain under its handshake span.
             with obs_trace.span("queue.flush", op=self.label, n=len(items),
                                 waited_ms=round(
-                                    1e3 * (t0 - first_t), 3)):
-                results = await self._run_batch(items)
+                                    1e3 * (t0 - first_t), 3)) as sp:
+                # _run_batch stamps the placed shard onto this span, so the
+                # flame graph's flush lane names the chip that served it
+                results = await self._run_batch(items, sp)
             dt = time.perf_counter() - t0
             self.stats.total_dispatch_s += dt
             self.stats.dispatch_hist.record(dt)
@@ -692,11 +797,11 @@ def _run_valid(items, is_valid, dispatch, invalid_result, floor=1):
 
 
 def _make_queues(algo, fallback, breaker, max_batch, max_wait_ms,
-                 batch_meths, degrade_opts, bucket_floor=1):
-    """Build one OpQueue per batch method, wiring the shared breaker and the
-    fallback partials (used by both facades below).  The device path pads to
-    ``bucket_floor``; the cpu fallback keeps floor 1 (padding would only add
-    serial native work)."""
+                 batch_meths, degrade_opts, bucket_floor=1, scheduler=None):
+    """Build one OpQueue per batch method, wiring the shared breaker (or the
+    placement scheduler) and the fallback partials (used by both facades
+    below).  The device path pads to ``bucket_floor``; the cpu fallback
+    keeps floor 1 (padding would only add serial native work)."""
     out = []
     for meth in batch_meths:
         fb = functools.partial(meth, fallback, 1) if fallback is not None else None
@@ -704,19 +809,46 @@ def _make_queues(algo, fallback, breaker, max_batch, max_wait_ms,
         out.append(
             OpQueue(functools.partial(meth, algo, bucket_floor), max_batch,
                     max_wait_ms, fallback_fn=fb, breaker=breaker,
-                    bucket_floor=bucket_floor,
+                    bucket_floor=bucket_floor, scheduler=scheduler,
                     label=f"{algo.name}.{op}", **degrade_opts)
         )
     return out
 
 
-def _facade_breaker(breaker, cooloff_s):
+def _facade_breaker(breaker, cooloff_s, scheduler=None):
+    if scheduler is not None:
+        if breaker is not None or cooloff_s is not None:
+            raise ValueError("pass either scheduler or breaker/cooloff_s — "
+                             "a scheduler owns one breaker per shard")
+        return scheduler.shards[0].breaker  # the compat/metrics handle
     if breaker is not None:
         if cooloff_s is not None:
             raise ValueError("pass either breaker or cooloff_s, not both "
                              "(an explicit breaker carries its own cool-off)")
         return breaker
     return Breaker(cooloff_s if cooloff_s is not None else 30.0)
+
+
+def _shard_placements(scheduler):
+    """Placement contexts a facade warmup must compile under: one per
+    CLOSED shard (jit caches are per device — a program warmed only on
+    shard 0 would cold-compile inside shard 3's first live dispatch; a
+    sick shard is skipped so its hung device cannot stall the sweep), or
+    one null context for the classic single-device path (also the
+    no-healthy-shard fallback: compiling the default-device program keeps
+    the warmup contract's shape, and every claim routes to the cpu
+    fallback until a shard heals anyway)."""
+    import contextlib
+
+    if scheduler is None:
+        yield contextlib.nullcontext()
+        return
+    warm = scheduler.warmable_shards()
+    if not warm:
+        yield contextlib.nullcontext()
+        return
+    for sh in warm:
+        yield sh.placement()
 
 
 class BatchedKEM:
@@ -733,18 +865,23 @@ class BatchedKEM:
                  breaker: Breaker | None = None,
                  cooloff_s: float | None = None,
                  bucket_floor: int = 1,
+                 scheduler=None,
                  **degrade_opts):
         self.algo = algo
         self.fallback = fallback
         self.name = algo.name
         self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
+        #: placement axis shared with the sibling facades (None = classic)
+        self.scheduler = scheduler
         # one breaker across keygen/encaps/decaps: the device is shared, so
-        # any op discovering slowness shields the others immediately
-        self.breaker = _facade_breaker(breaker, cooloff_s)
+        # any op discovering slowness shields the others immediately (per
+        # SHARD under a scheduler — each shard carries its own)
+        self.breaker = _facade_breaker(breaker, cooloff_s, scheduler)
         self._kg, self._enc, self._dec = _make_queues(
-            algo, fallback, self.breaker, max_batch, max_wait_ms,
+            algo, fallback, None if scheduler is not None else self.breaker,
+            max_batch, max_wait_ms,
             (self._kg_batch, self._enc_batch, self._dec_batch), degrade_opts,
-            self.bucket_floor,
+            self.bucket_floor, scheduler,
         )
 
     @staticmethod
@@ -794,22 +931,34 @@ class BatchedKEM:
         Single-key encaps batches (every handshake; swarm hot peers) take
         the operand-cache fast path — different jit programs on miss
         (``_enc_cold``) and hit (``_enc_pre``) — so each size additionally
-        runs a same-key pair of encaps calls to compile both."""
+        runs a same-key pair of encaps calls to compile both.
+
+        Under a scheduler every size compiles on EVERY shard (jit caches
+        are per device; the opcache partitions per shard) before the
+        bucket is marked warm — a warm bucket means warm wherever the
+        placement policy can put a flush."""
+        for placement in _shard_placements(self.scheduler):
+            with placement:
+                for n in sizes:
+                    self._warm_one(n)
         for n in sizes:
-            # compile the shape the live bucket will use
             n2 = max(self.bucket_floor, _next_pow2(n))
-            pks, sks = self.algo.generate_keypair_batch(n2)
-            # distinct keys: at n2 > 1 this compiles the mixed-key sliced
-            # program; at n2 == 1 a single row takes the same opcache path
-            # live batch-1 encaps always takes, so nothing is missed
-            cts, _ = self.algo.encapsulate_batch(pks)
-            self.algo.decapsulate_batch(sks, cts)
-            if getattr(self.algo, "opcache", None) is not None:
-                same = np.repeat(np.asarray(pks)[:1], n2, axis=0)
-                self.algo.encapsulate_batch(same)  # cache miss: _enc_cold
-                self.algo.encapsulate_batch(same)  # cache hit:  _enc_pre
             for q in (self._kg, self._enc, self._dec):
                 q.mark_warm(n2)  # runs on the warmup thread: locked handoff
+
+    def _warm_one(self, n: int) -> None:
+        # compile the shape the live bucket will use
+        n2 = max(self.bucket_floor, _next_pow2(n))
+        pks, sks = self.algo.generate_keypair_batch(n2)
+        # distinct keys: at n2 > 1 this compiles the mixed-key sliced
+        # program; at n2 == 1 a single row takes the same opcache path
+        # live batch-1 encaps always takes, so nothing is missed
+        cts, _ = self.algo.encapsulate_batch(pks)
+        self.algo.decapsulate_batch(sks, cts)
+        if getattr(self.algo, "opcache", None) is not None:
+            same = np.repeat(np.asarray(pks)[:1], n2, axis=0)
+            self.algo.encapsulate_batch(same)  # cache miss: _enc_cold
+            self.algo.encapsulate_batch(same)  # cache hit:  _enc_pre
 
     async def generate_keypair(self) -> tuple[bytes, bytes]:
         return await self._kg.submit(None)
@@ -841,16 +990,19 @@ class BatchedSignature:
                  breaker: Breaker | None = None,
                  cooloff_s: float | None = None,
                  bucket_floor: int = 1,
+                 scheduler=None,
                  **degrade_opts):
         self.algo = algo
         self.fallback = fallback
         self.name = algo.name
         self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
-        self.breaker = _facade_breaker(breaker, cooloff_s)
+        self.scheduler = scheduler
+        self.breaker = _facade_breaker(breaker, cooloff_s, scheduler)
         self._sign, self._verify = _make_queues(
-            algo, fallback, self.breaker, max_batch, max_wait_ms,
+            algo, fallback, None if scheduler is not None else self.breaker,
+            max_batch, max_wait_ms,
             (self._sign_batch, self._verify_batch), degrade_opts,
-            self.bucket_floor,
+            self.bucket_floor, scheduler,
         )
 
     @staticmethod
@@ -902,30 +1054,40 @@ class BatchedSignature:
         (``*_pre``) — so each size runs twice with a key fresh to the
         cache: the first call compiles the cold program, the second the
         hit program.  Otherwise a "warm" bucket's first cache hit cold-jits
-        inside a live device dispatch and trips the breaker."""
-        have_cache = getattr(self.algo, "opcache", None) is not None
+        inside a live device dispatch and trips the breaker.
+
+        Under a scheduler every size compiles on EVERY shard before the
+        bucket is marked warm (see BatchedKEM.warmup)."""
+        for placement in _shard_placements(self.scheduler):
+            with placement:
+                for n in sizes:
+                    self._warm_one(n)
         for n in sizes:
-            # fresh key per size: the opcache persists across sizes, and a
-            # cached key would skip the cold-program compile for this shape
-            pk, sk = self.algo.generate_keypair()
-            # compile the shape the live bucket will use
             n2 = max(self.bucket_floor, _next_pow2(n))
-            sks = np.stack([np.frombuffer(sk, np.uint8)] * n2)
-            pks = np.stack([np.frombuffer(pk, np.uint8)] * n2)
-            reps = 2 if have_cache else 1
-            for _ in range(reps):
-                sigs = self.algo.sign_batch(sks, [b"warmup"] * n2)
-            for _ in range(reps):
-                self.algo.verify_batch(pks, [b"warmup"] * n2, sigs)
-            if have_cache and n2 > 1:
-                # distinct keys: compile the MIXED-key programs that the
-                # same-key stacks above divert away from (live flushes
-                # coalescing >= 2 clients' ops carry distinct keys)
-                pks_d, sks_d = self.algo.generate_keypair_batch(n2)
-                sigs_d = self.algo.sign_batch(sks_d, [b"warmup"] * n2)
-                self.algo.verify_batch(pks_d, [b"warmup"] * n2, sigs_d)
             for q in (self._sign, self._verify):
                 q.mark_warm(n2)  # runs on the warmup thread: locked handoff
+
+    def _warm_one(self, n: int) -> None:
+        have_cache = getattr(self.algo, "opcache", None) is not None
+        # fresh key per size: the opcache persists across sizes, and a
+        # cached key would skip the cold-program compile for this shape
+        pk, sk = self.algo.generate_keypair()
+        # compile the shape the live bucket will use
+        n2 = max(self.bucket_floor, _next_pow2(n))
+        sks = np.stack([np.frombuffer(sk, np.uint8)] * n2)
+        pks = np.stack([np.frombuffer(pk, np.uint8)] * n2)
+        reps = 2 if have_cache else 1
+        for _ in range(reps):
+            sigs = self.algo.sign_batch(sks, [b"warmup"] * n2)
+        for _ in range(reps):
+            self.algo.verify_batch(pks, [b"warmup"] * n2, sigs)
+        if have_cache and n2 > 1:
+            # distinct keys: compile the MIXED-key programs that the
+            # same-key stacks above divert away from (live flushes
+            # coalescing >= 2 clients' ops carry distinct keys)
+            pks_d, sks_d = self.algo.generate_keypair_batch(n2)
+            sigs_d = self.algo.sign_batch(sks_d, [b"warmup"] * n2)
+            self.algo.verify_batch(pks_d, [b"warmup"] * n2, sigs_d)
 
     async def sign(self, secret_key: bytes, message: bytes) -> bytes:
         return await self._sign.submit((secret_key, message))
@@ -970,20 +1132,22 @@ class BatchedFused:
     def __init__(self, fused, pk_off: int, ct_off: int, max_batch: int = 4096,
                  max_wait_ms: float = 2.0, fallback_kem=None, fallback_sig=None,
                  breaker: Breaker | None = None, cooloff_s: float | None = None,
-                 bucket_floor: int = 1, **degrade_opts):
+                 bucket_floor: int = 1, scheduler=None, **degrade_opts):
         self.fused = fused
         self.name = fused.name
         self.pk_off = pk_off
         self.ct_off = ct_off
         self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
-        self.breaker = _facade_breaker(breaker, cooloff_s)
+        self.scheduler = scheduler
+        self.breaker = _facade_breaker(breaker, cooloff_s, scheduler)
         self.fallback_kem = fallback_kem
         self.fallback_sig = fallback_sig
         have_fb = fallback_kem is not None and fallback_sig is not None
         self._kg, self._enc, self._dec = (
             OpQueue(batch_fn, max_batch, max_wait_ms,
                     fallback_fn=(fb if have_fb else None),
-                    breaker=self.breaker, bucket_floor=self.bucket_floor,
+                    breaker=None if scheduler is not None else self.breaker,
+                    bucket_floor=self.bucket_floor, scheduler=scheduler,
                     label=f"{fused.name}.{op}", **degrade_opts)
             for batch_fn, fb, op in (
                 (self._kg_batch, self._kg_fallback, "keygen_sign"),
@@ -1180,9 +1344,13 @@ class BatchedFused:
         Sizes are raised to the facade's bucket floor FIRST — the fused
         capability compiles exactly the shapes it is handed, and live
         flushes pad to the floor, so compiling un-raised sizes would mark
-        buckets warm that were never compiled."""
+        buckets warm that were never compiled.  Under a scheduler the
+        composite programs compile on every shard before marking."""
         buckets = sorted({max(self.bucket_floor, _next_pow2(n)) for n in sizes})
-        self.fused.warmup(tuple(buckets), pk_off=self.pk_off, ct_off=self.ct_off)
+        for placement in _shard_placements(self.scheduler):
+            with placement:
+                self.fused.warmup(tuple(buckets), pk_off=self.pk_off,
+                                  ct_off=self.ct_off)
         for q in (self._kg, self._enc, self._dec):
             for b in buckets:
                 q.mark_warm(b)  # runs on the warmup thread: locked handoff

@@ -350,10 +350,16 @@ def gate_facades(*facades) -> list[HealthVerdict]:
         have_fb = (getattr(facade, "fallback", None) is not None
                    or getattr(facade, "fallback_kem", None) is not None)
         if have_fb:
-            facade.breaker.quarantine(
-                f"{verdict.family} failed the device-health gate: "
-                f"{verdict.detail}"
-            )
+            why = (f"{verdict.family} failed the device-health gate: "
+                   f"{verdict.detail}")
+            sched = getattr(facade, "scheduler", None)
+            if sched is not None:
+                # the verdict is about the device PROGRAMS, which every
+                # shard runs: quarantine the whole placement axis, not
+                # just the shard-0 compat breaker
+                sched.quarantine_all(why)
+            else:
+                facade.breaker.quarantine(why)
     return out
 
 

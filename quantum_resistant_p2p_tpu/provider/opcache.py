@@ -27,28 +27,61 @@ a lock across a compile.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import hashlib
 import threading
 from collections import OrderedDict
 from typing import Any
 
+#: the placement-axis coordinate of the CURRENT dispatch (set by
+#: Shard.placement on the dispatching thread).  Cached pytrees live on one
+#: chip; feeding shard i's device arrays to a program placed on shard j
+#: would force a cross-chip transfer (or fail on committed operands), so
+#: cache keys are namespaced by this scope — the opcache state partitions
+#: across the device mesh.  Default 0 = the single-device world.
+_SHARD: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "qrp2p_opcache_shard", default=0
+)
+
+
+@contextlib.contextmanager
+def shard_scope(index: int):
+    """Namespace opcache lookups/inserts to placement shard ``index`` for
+    the duration of the block (entered on the dispatch worker thread by
+    ``provider.scheduler.Shard.placement``)."""
+    token = _SHARD.set(index)
+    try:
+        yield
+    finally:
+        _SHARD.reset(token)
+
+
+def current_shard() -> int:
+    """The active placement scope (tests; diagnostics)."""
+    return _SHARD.get()
+
 
 class DeviceOperandCache:
-    """Content-hash-keyed LRU of per-key device operand pytrees."""
+    """Content-hash-keyed LRU of per-key device operand pytrees,
+    partitioned by placement shard (see :func:`shard_scope`)."""
 
     def __init__(self, capacity: int = 8):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._entries: OrderedDict[tuple[str, bytes], Any] = OrderedDict()
+        self._entries: OrderedDict[tuple[str, int, bytes], Any] = OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
     @staticmethod
-    def _key(kind: str, key_bytes: bytes) -> tuple[str, bytes]:
-        return (kind, hashlib.sha256(key_bytes).digest())
+    def _key(kind: str, key_bytes: bytes) -> tuple[str, int, bytes]:
+        # the shard coordinate keeps per-chip device state per chip; LRU
+        # pressure is shared (one capacity across shards, matching the
+        # single HBM budget the cache models per process)
+        return (kind, _SHARD.get(), hashlib.sha256(key_bytes).digest())
 
     def lookup(self, kind: str, key_bytes: bytes) -> Any | None:
         """Cached state or None.  Deliberately a lookup/put split, not a

@@ -487,6 +487,59 @@ def test_cross_thread_state_suppressed():
     assert [s.rule for s in suppressed] == ["cross-thread-state"]
 
 
+PLACED_SRC = """
+    import threading
+
+    class Queue:
+        def __init__(self):
+            self.served = 0
+            self._lock = threading.Lock()
+
+        def _work(self, items):
+            self.served += len(items)
+            return items
+
+        async def flush(self, shard, items):
+            # the sharded crypto plane's placement boundary: _work runs on
+            # a dispatch worker under the shard's placement context
+            return shard.run_placed(self._work, items)
+
+        async def account(self, items):
+            with self._lock:
+                self.served += len(items)
+    """
+
+
+def test_placement_call_is_a_cross_thread_edge():
+    """qrflow's domain map covers the scheduler surface: a callable handed
+    to ``run_placed`` acquires the executor domain, so unlocked state it
+    shares with the loop is a race — exactly like a pool submission."""
+    findings, _ = lint(PLACED_SRC)
+    assert [f.rule for f in findings] == ["cross-thread-state"]
+    assert "Queue.served" in findings[0].message
+    assert "executor" in findings[0].message
+
+
+def test_placement_edge_lock_guarded_is_clean():
+    clean = PLACED_SRC.replace(
+        "        def _work(self, items):\n"
+        "            self.served += len(items)\n",
+        "        def _work(self, items):\n"
+        "            with self._lock:\n"
+        "                self.served += len(items)\n",
+    )
+    assert "cross-thread-state" not in rule_ids(clean)
+
+
+def test_placement_edge_suppressed():
+    findings, suppressed = lint(PLACED_SRC.replace(
+        "            self.served += len(items)\n            return items",
+        "            self.served += len(items)  # qrlint: disable=cross-thread-state — advisory load counter; a lost increment is acceptable\n            return items",
+    ))
+    assert "cross-thread-state" not in {f.rule for f in findings}
+    assert "cross-thread-state" in {s.rule for s in suppressed}
+
+
 def test_init_writes_are_construction_not_sharing():
     assert rule_ids(
         """

@@ -517,6 +517,57 @@ def test_dispatch_except_no_breaker_clean_when_recorded_or_reraised():
     assert ids == []
 
 
+def test_dispatch_except_no_breaker_covers_placed_dispatches():
+    """Trigger (sharded crypto plane): ``run_placed`` is the scheduler's
+    placement boundary — one placed device program — so an except that
+    swallows its failure without recording to the PLACED shard's breaker
+    leaves that shard's degrade/heal machinery blind."""
+    ids = [i for i in rule_ids(
+        """
+        class Q:
+            def run(self, shard, items):
+                try:
+                    return shard.run_placed(self.batch_fn, items)
+                except Exception:
+                    return None
+        """
+    ) if i == "dispatch-except-no-breaker"]
+    assert ids == ["dispatch-except-no-breaker"]
+
+
+def test_dispatch_except_no_breaker_placed_clean_when_shard_breaker_records():
+    """Clean twin: recording the failure to the per-shard breaker (the
+    object run_placed's shard carries) satisfies the rule."""
+    ids = [i for i in rule_ids(
+        """
+        class Q:
+            def run(self, shard, items):
+                try:
+                    return shard.run_placed(self.batch_fn, items)
+                except Exception:
+                    shard.breaker.record_failure("device")
+                    return None
+        """
+    ) if i == "dispatch-except-no-breaker"]
+    assert ids == []
+
+
+def test_dispatch_except_no_breaker_placed_suppression():
+    findings, suppressed = lint(
+        """
+        class Q:
+            def run(self, shard, items):
+                try:
+                    return shard.run_placed(self.batch_fn, items)
+                except Exception:  # qrlint: disable=dispatch-except-no-breaker, broad-except
+                    return None
+        """
+    )
+    assert [f.rule for f in findings] == []
+    assert sorted(s.rule for s in suppressed) == [
+        "broad-except", "dispatch-except-no-breaker"]
+
+
 def test_dispatch_except_no_breaker_suppression():
     findings, suppressed = lint(
         """

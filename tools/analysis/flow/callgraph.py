@@ -537,6 +537,16 @@ class CallGraph:
             for target in resolve_ref(call.args[0]):
                 self._add_edge(fn, target, call, "executor")
             return
+        if leaf == "run_placed" and call.args:
+            # the sharded crypto plane's placement boundary
+            # (provider/scheduler.py Shard.run_placed): the callable it is
+            # handed executes on a dispatch worker under the shard's
+            # placement context — an executor-domain edge, exactly like a
+            # pool submission (the cross-thread-state pack must see state
+            # the placed callable mutates as worker-owned)
+            for target in resolve_ref(call.args[0]):
+                self._add_edge(fn, target, call, "executor")
+            return
         if leaf in ("call_soon", "call_later", "call_at", "call_soon_threadsafe"):
             idx = 0 if leaf == "call_soon" or leaf == "call_soon_threadsafe" else 1
             if len(call.args) > idx:

@@ -8,7 +8,11 @@ body can run in:
 * ``thread:<n>`` — a named ``threading.Thread`` target (and everything it
   calls): e.g. ``thread:qrp2p-warmup`` for the background warmup.
 * ``executor``   — callables submitted to a ThreadPoolExecutor
-  (``run_in_executor`` / ``.submit``) and their transitive callees.
+  (``run_in_executor`` / ``.submit``) and their transitive callees, plus
+  callables handed to the sharded crypto plane's placement boundary
+  (``Shard.run_placed``, provider/scheduler.py) — a placed device program
+  runs on a dispatch worker under the shard's placement context, so a
+  placement call IS a cross-thread edge.
 
 Domains propagate along plain call/await edges to a fixpoint: a sync
 helper called from both a coroutine and a thread target ends up owning

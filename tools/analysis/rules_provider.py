@@ -223,6 +223,12 @@ class DispatchExceptBreakerRule(Rule):
     ``record_success`` deliberately do NOT count: releasing a claim records
     no outcome and the success path is exactly what a swallowed failure
     must not take.
+
+    The sharded crypto plane (provider/scheduler.py) extends the dispatch
+    surface: ``run_placed(...)`` executes a device program under a shard's
+    placement context, and the per-SHARD breakers it routes outcomes to
+    use the same recording names — so a swallowed placed-dispatch failure
+    on one shard is caught exactly like a single-breaker one.
     """
 
     id = "dispatch-except-no-breaker"
@@ -231,8 +237,10 @@ class DispatchExceptBreakerRule(Rule):
         "failure to the circuit breaker (trip/record_failure/_trip_breaker)"
     )
 
-    #: called-function names that ARE a device dispatch
-    _DISPATCH_CALLEES = {"batch_fn", "_device_call", "_warm_call"}
+    #: called-function names that ARE a device dispatch (run_placed is the
+    #: scheduler's placement boundary: one placed device program)
+    _DISPATCH_CALLEES = {"batch_fn", "_device_call", "_warm_call",
+                         "run_placed"}
     #: executor attributes whose run_in_executor submissions are dispatches
     _DISPATCH_EXECUTORS = {"device_executor", "warmup_executor"}
     #: handler calls that count as recording the FAILURE to the breaker
