@@ -39,6 +39,8 @@ from ..obs import trace as obs_trace
 from ..obs.metrics import Registry
 from ..provider import get_fused, get_kem, get_signature, get_symmetric
 from ..provider.base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricAlgorithm
+from ..provider.batched import (LANE_BULK, LANE_HANDSHAKE, LANE_REKEY,
+                                LaneShed)
 from .message_store import Message
 
 logger = logging.getLogger(__name__)
@@ -66,6 +68,12 @@ REKEY_AFTER_AEAD_FAILURES = 1
 #: legitimately in flight across a rekey (and attacker-sent garbage) must
 #: not force handshake churn — at most one forced handshake per window
 REKEY_COOLDOWN_S = 5.0
+#: how long a completed session keeps its peer on the rekey lane (and
+#: exempt from the handshake budget) after the key is gone, and the cap
+#: on remembered peers (oldest evicted) — bounds both the memory and the
+#: budget-bypass surface of the rekey exemption
+HAD_SESSION_TTL_S = 3600.0
+HAD_SESSION_CAP = 4096
 #: pow2 flush buckets precompiled by the background warmup: bucket 1 (the
 #: sequential-handshake case) plus the first pow-2 buckets a small burst of
 #: concurrent handshakes coalesces into — warming ONLY size 1 (the old
@@ -90,6 +98,10 @@ class RejectReason(str, enum.Enum):
     KEYGEN_ERROR = "keypair_generation_error"
     ENCAPSULATION_ERROR = "encapsulation_error"
     GENERAL_ERROR = "general_error"
+    #: gateway admission control (docs/gateway.md): the responder is over
+    #: its concurrent-handshake budget — a typed, FAST rejection the
+    #: initiator treats as transient (retry with backoff), never a timeout
+    BUSY = "server_busy"
 
 
 def _canonical(data: dict) -> bytes:
@@ -180,6 +192,9 @@ class SecureMessaging:
         sig_keypair: tuple[bytes, bytes] | None = None,
         breaker_cooloff_s: float = 30.0,
         auto_heal: bool = True,
+        autotune: bool | None = None,
+        max_inflight_handshakes: int = 0,
+        bulk_lane_capacity: int = 0,
     ):
         self.node = node
         self.key_storage = key_storage
@@ -234,8 +249,32 @@ class SecureMessaging:
             "outbox_dropped", "parked messages dropped (capacity or give-up)")
         self._ctr_handshake_giveups = self.registry.counter(
             "handshake_giveups", "initiated handshakes that failed finally")
+        # gateway admission counters (docs/gateway.md): every shed is loud
+        self._ctr_handshake_sheds = self.registry.counter(
+            "handshake_sheds", "inbound handshakes rejected over budget")
+        self._ctr_bulk_sheds = self.registry.counter(
+            "bulk_sheds", "bulk sends shed at the bulk-lane bound")
         self.registry.register_collector("queues", self._collect_queues)
         self.registry.register_collector("opcaches", self._collect_opcaches)
+        #: responder-side concurrent-handshake budget (0 = unlimited):
+        #: over it, ke_init draws a typed BUSY rejection instead of joining
+        #: a pile-up that times every initiator out
+        self._hs_budget = max_inflight_handshakes
+        self._responding = 0
+        #: per-queue bulk-lane pending bound (0 = unbounded), applied to
+        #: every facade queue so a bulk flood sheds bulk, not handshakes
+        self._lane_capacity = (
+            {LANE_BULK: bulk_lane_capacity} if bulk_lane_capacity else None
+        )
+        #: peer -> monotonic time of the last COMPLETED session: a recent
+        #: entry makes the peer's next handshake a re-key (top-priority
+        #: lane, exempt from the handshake budget).  Bounded and
+        #: time-limited — an unbounded ever-seen set would grow one entry
+        #: per peer forever AND hand every historical peer a permanent
+        #: budget bypass, defeating admission control in exactly the
+        #: mass-reconnect flood it exists for.
+        self._had_session: dict[str, float] = {}
+        self._autotuner = None
         self._scheduler = None
         if use_batching:
             from ..provider.batched import BatchedKEM, BatchedSignature
@@ -253,15 +292,30 @@ class SecureMessaging:
                 registry=self.registry,
             )
             self._queue_breaker = self._scheduler.shards[0].breaker
+            # the adaptive batch/flush autotuner (provider/autotune.py):
+            # replaces the static flush policy on the hot path when armed;
+            # autotune=None reads the QRP2P_AUTOTUNE env default, and OFF
+            # leaves every queue reading its static constants bit-for-bit
+            from ..provider.autotune import (Autotuner,
+                                             autotune_enabled_default)
+
+            enabled = (autotune_enabled_default() if autotune is None
+                       else autotune)
+            if enabled:
+                self._autotuner = Autotuner(registry=self.registry,
+                                            scheduler=self._scheduler)
             self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms,
                                     fallback=self._cpu_fallback_kem(),
                                     scheduler=self._scheduler,
-                                    bucket_floor=batch_floor)
+                                    bucket_floor=batch_floor,
+                                    lane_capacity=self._lane_capacity)
             self._bsig = BatchedSignature(self.signature, max_batch, max_wait_ms,
                                           fallback=self._cpu_fallback_sig(),
                                           scheduler=self._scheduler,
-                                          bucket_floor=batch_floor)
+                                          bucket_floor=batch_floor,
+                                          lane_capacity=self._lane_capacity)
             self._bfused = self._make_fused()
+            self._attach_tuners()
             self._spawn_warmup()
 
         # per-peer protocol state.  raw_secrets values are bytearrays so
@@ -378,27 +432,62 @@ class SecureMessaging:
 
     # -- async crypto helpers: route through the batch queue when enabled ----
 
-    async def _kem_keygen(self) -> tuple[bytes, bytes]:
+    def _attach_tuners(self) -> None:
+        """(Re-)attach the autotuner to every live facade queue — called at
+        construction and after every hot-swap facade rebuild (the rebuilt
+        queues are fresh objects; attach is idempotent per queue)."""
+        if self._autotuner is not None:
+            self._autotuner.attach_facades(self._bkem, self._bsig,
+                                           self._bfused)
+
+    def _is_rekey(self, peer_id: str) -> bool:
+        """True while ``peer_id`` has a RECENT completed session (within
+        HAD_SESSION_TTL_S): its next handshake is a re-key — top-priority
+        lane, exempt from the handshake budget.  The table is pruned here
+        (TTL + size cap), so stale peers age back to stranger status and
+        the exemption never becomes a permanent budget bypass."""
+        now = time.monotonic()
+        t = self._had_session.get(peer_id)
+        if t is not None and now - t > HAD_SESSION_TTL_S:
+            del self._had_session[peer_id]
+            t = None
+        if len(self._had_session) > HAD_SESSION_CAP:
+            for pid, ts in sorted(self._had_session.items(),
+                                  key=lambda kv: kv[1])[: HAD_SESSION_CAP // 2]:
+                del self._had_session[pid]
+        return t is not None
+
+    def _hs_lane(self, peer_id: str) -> int:
+        """Handshake priority lane for ``peer_id``: a peer with a recent
+        completed session is RE-KEYING (top priority — an established
+        session must never lose its key behind a flood of strangers); a
+        fresh (or long-gone) peer rides the new-handshake lane."""
+        return LANE_REKEY if self._is_rekey(peer_id) else LANE_HANDSHAKE
+
+    async def _kem_keygen(self, lane: int = LANE_HANDSHAKE) -> tuple[bytes, bytes]:
         if self._bkem is not None:
-            return await self._bkem.generate_keypair()
+            return await self._bkem.generate_keypair(lane)
         return self.kem.generate_keypair()
 
-    async def _kem_encaps(self, pk: bytes) -> tuple[bytes, bytes]:
+    async def _kem_encaps(self, pk: bytes,
+                          lane: int = LANE_HANDSHAKE) -> tuple[bytes, bytes]:
         if self._bkem is not None:
-            return await self._bkem.encapsulate(pk)
+            return await self._bkem.encapsulate(pk, lane)
         return self.kem.encapsulate(pk)
 
-    async def _kem_decaps(self, sk: bytes, ct: bytes) -> bytes:
+    async def _kem_decaps(self, sk: bytes, ct: bytes,
+                          lane: int = LANE_HANDSHAKE) -> bytes:
         if self._bkem is not None:
-            return await self._bkem.decapsulate(sk, ct)
+            return await self._bkem.decapsulate(sk, ct, lane)
         return self.kem.decapsulate(sk, ct)
 
-    async def _sign(self, message: bytes) -> bytes:
+    async def _sign(self, message: bytes, lane: int = LANE_HANDSHAKE) -> bytes:
         if self._bsig is not None:
-            return await self._bsig.sign(self._sig_keypair[1], message)
+            return await self._bsig.sign(self._sig_keypair[1], message, lane)
         return self.signature.sign(self._sig_keypair[1], message)
 
-    async def _verify(self, sig_algo: str, pk: bytes, message: bytes, sig: bytes) -> bool | None:
+    async def _verify(self, sig_algo: str, pk: bytes, message: bytes, sig: bytes,
+                      lane: int = LANE_HANDSHAKE) -> bool | None:
         """False on verification failure, None for an unknown/unsupported
         signature algorithm (the caller maps None to ALGORITHM_MISMATCH, the
         reference's typed rejection, rather than INVALID_SIGNATURE).  Never
@@ -415,8 +504,22 @@ class SecureMessaging:
                 return False
         try:
             if self._bsig is not None:
-                return await self._bsig.verify(pk, message, sig)
+                return await self._bsig.verify(pk, message, sig, lane)
             return self.signature.verify(pk, message, sig)
+        except LaneShed:
+            if lane != LANE_BULK:
+                # a capped handshake/rekey lane (not reachable through
+                # this engine's own knobs, which bound only bulk) must
+                # surface as a typed shed, never as a signature verdict —
+                # _check_common maps it to RejectReason.BUSY
+                raise
+            # inbound bulk shed at its lane bound: loud and counted — the
+            # caller still sees False (the message is dropped), so its
+            # "verification failed" log line follows this shed line
+            self._ctr_bulk_sheds.inc()
+            logger.warning("inbound bulk-lane verify shed (%d total)",
+                           self._ctr_bulk_sheds.value)
+            return False
         except Exception:  # qrlint: disable=broad-except  — verify contract: malformed attacker input maps to False, never an exception
             return False
 
@@ -643,7 +746,11 @@ class SecureMessaging:
             status = await self._initiate_once(peer_id)
             if status == "ok":
                 return True
-            transient = status in ("timeout", RejectReason.INVALID_SIGNATURE.value)
+            # BUSY is the gateway's typed load-shed: the responder is over
+            # its admission budget NOW but will drain — retry with backoff
+            # exactly like a transient network fault
+            transient = status in ("timeout", RejectReason.INVALID_SIGNATURE.value,
+                                   RejectReason.BUSY.value)
             if not transient or attempt == retries or not self.node.is_connected(peer_id):
                 if status != "already_in_flight":
                     # final failure: a flight-recorder trigger (auto-dumps a
@@ -686,6 +793,9 @@ class SecureMessaging:
 
         message_id = str(uuid.uuid4())
         trips0 = self._trips_now()
+        # priority lane for every queued op of THIS handshake: top priority
+        # when re-keying an established peer, middle for a fresh one
+        lane = self._hs_lane(peer_id)
         ke_data = {
             "message_id": message_id,
             "kem": self.kem.name,
@@ -707,19 +817,19 @@ class SecureMessaging:
             if len(template) <= self._bfused.fused.init_template_len:
                 try:
                     pk, sk, sig = await self._bfused.keygen_sign(
-                        self._sig_keypair[1], template
+                        self._sig_keypair[1], template, lane
                     )
                 except Exception:
                     logger.exception("fused keygen_sign failed; per-op fallback")
                     pk = None
         if pk is None:
             try:
-                pk, sk = await self._kem_keygen()
+                pk, sk = await self._kem_keygen(lane)
             except Exception:
                 logger.exception("ephemeral keygen failed")
                 return RejectReason.KEYGEN_ERROR.value
             ke_data["public_key"] = pk.hex()
-            sig = await self._sign(_canonical(ke_data))
+            sig = await self._sign(_canonical(ke_data), lane)
         else:
             ke_data["public_key"] = pk.hex()
         self._ephemeral[message_id] = (peer_id, bytearray(sk))
@@ -808,6 +918,7 @@ class SecureMessaging:
             fallback_sig=self._cpu_fallback_sig(),
             scheduler=self._scheduler,
             bucket_floor=self._batch_floor,
+            lane_capacity=self._lane_capacity,
         )
 
     def _trips_now(self) -> int:
@@ -916,6 +1027,21 @@ class SecureMessaging:
             "outbox_dropped": self._ctr_outbox_dropped.value,
             "handshake_giveups": self._ctr_handshake_giveups.value,
         }
+        # the gateway section (docs/gateway.md; CLI /metrics): admission-
+        # control state and the autotuner's live decisions — additive key,
+        # same compatibility contract as "resilience"
+        out["gateway"] = {
+            "max_peers": self.node.max_peers,
+            "connection_sheds": self.node.sheds,
+            "busy_rejects": self.node.busy_rejects,
+            "handshake_budget": self._hs_budget,
+            "handshakes_in_flight": self._responding,
+            "handshake_sheds": self._ctr_handshake_sheds.value,
+            "bulk_sheds": self._ctr_bulk_sheds.value,
+            "autotune": (self._autotuner.snapshot()
+                         if self._autotuner is not None
+                         else {"enabled": False}),
+        }
         return out
 
     def _spawn_warmup(self, kem: bool = True, sig: bool = True) -> None:
@@ -1000,9 +1126,16 @@ class SecureMessaging:
         )
 
     async def _check_common(self, peer_id: str, data: dict, sig: bytes, sig_pk: bytes,
-                            sig_algo: str) -> RejectReason | None:
+                            sig_algo: str,
+                            lane: int = LANE_HANDSHAKE) -> RejectReason | None:
         """Signature + identity + replay-window checks shared by init/response."""
-        ok = await self._verify(sig_algo, sig_pk, _canonical(data), sig)
+        try:
+            ok = await self._verify(sig_algo, sig_pk, _canonical(data), sig,
+                                    lane)
+        except LaneShed:
+            # handshake-lane shed (a hand-capped lane): a typed, transient
+            # BUSY — disjoint from any signature verdict
+            return RejectReason.BUSY
         if ok is None:
             return RejectReason.ALGORITHM_MISMATCH
         if not ok:
@@ -1022,19 +1155,54 @@ class SecureMessaging:
         return None
 
     async def _handle_ke_init(self, peer_id: str, msg: dict) -> None:
-        """Responder: verify, encapsulate, derive, reply (reference: :695-905)."""
+        """Responder: verify, encapsulate, derive, reply (reference: :695-905).
+
+        Admission control first: over the concurrent-handshake budget, the
+        init draws a typed BUSY rejection — a fast, retryable shed instead
+        of joining a pile-up that times every initiator out.  Re-keys of
+        established peers are EXEMPT from the budget (they ride the top
+        priority lane; shedding them would cost a live session)."""
         data = msg.get("ke_data") or {}
         message_id = data.get("message_id", "?")
-        with obs_trace.span("handshake.respond", peer=peer_id[:8],
-                            kem=self.kem.name):
-            await self._handle_ke_init_inner(peer_id, msg, data, message_id)
+        if (
+            self._hs_budget
+            and self._responding >= self._hs_budget
+            and not self._is_rekey(peer_id)
+        ):
+            self._shed_handshake(peer_id)
+            await self._reject(peer_id, message_id, RejectReason.BUSY)
+            return
+        self._responding += 1
+        try:
+            with obs_trace.span("handshake.respond", peer=peer_id[:8],
+                                kem=self.kem.name):
+                await self._handle_ke_init_inner(peer_id, msg, data, message_id)
+        finally:
+            self._responding -= 1
+
+    def _shed_handshake(self, peer_id: str) -> None:
+        self._ctr_handshake_sheds.inc()
+        n = self._ctr_handshake_sheds.value
+        if n == 1 or n % 64 == 0:
+            logger.warning(
+                "handshake budget reached (%d in flight, max %d): shedding "
+                "ke_init from %s (%d shed so far)",
+                self._responding, self._hs_budget, peer_id[:8], n,
+            )
+            obs_flight.record(
+                "load_shed", where="handshake", peer=peer_id[:8],
+                in_flight=self._responding, budget=self._hs_budget, sheds=n,
+            )
 
     async def _handle_ke_init_inner(self, peer_id: str, msg: dict, data: dict,
                                     message_id: str) -> None:
-        if await self._fused_handle_ke_init(peer_id, msg, data, message_id):
+        lane = self._hs_lane(peer_id)
+        if await self._fused_handle_ke_init(peer_id, msg, data, message_id,
+                                            lane):
             return
         err = await self._check_common(peer_id, data, msg.get("sig", b""),
-                                 msg.get("sig_pk", b""), msg.get("sig_algo", ""))
+                                 msg.get("sig_pk", b""), msg.get("sig_algo", ""),
+                                 lane)
         if err is not None:
             await self._reject(peer_id, message_id, err)
             return
@@ -1042,7 +1210,8 @@ class SecureMessaging:
             await self._reject(peer_id, message_id, RejectReason.ALGORITHM_MISMATCH)
             return
         try:
-            ct, secret = await self._kem_encaps(bytes.fromhex(data["public_key"]))
+            ct, secret = await self._kem_encaps(bytes.fromhex(data["public_key"]),
+                                                lane)
         except Exception:
             logger.exception("encapsulation failed")
             await self._reject(peer_id, message_id, RejectReason.ENCAPSULATION_ERROR)
@@ -1054,7 +1223,7 @@ class SecureMessaging:
             "recipient": peer_id,
             "timestamp": time.time(),
         }
-        sig = await self._sign(_canonical(resp))
+        sig = await self._sign(_canonical(resp), lane)
         await self._respond_established(peer_id, secret, resp, sig)
 
     async def _respond_established(self, peer_id: str, secret: bytes,
@@ -1077,7 +1246,8 @@ class SecureMessaging:
         )
 
     async def _fused_handle_ke_init(self, peer_id: str, msg: dict, data: dict,
-                                    message_id: str) -> bool:
+                                    message_id: str,
+                                    lane: int = LANE_HANDSHAKE) -> bool:
         """Composite responder step: verify(init) + encaps + sign(response)
         in ONE device trip.  True = handled (replied or rejected); False =
         not applicable (no capability, algorithm/shape mismatch, composite
@@ -1116,7 +1286,7 @@ class SecureMessaging:
         try:
             ok, ct, secret, sig = await f.encaps_verify_sign(
                 peer_pk, sig_pk, _canonical(data), sig_in,
-                self._sig_keypair[1], template,
+                self._sig_keypair[1], template, lane,
             )
         except Exception:
             logger.exception("fused encaps_verify_sign failed; per-op fallback")
@@ -1143,8 +1313,9 @@ class SecureMessaging:
     async def _handle_ke_response_inner(self, peer_id: str, msg: dict,
                                         data: dict, message_id: str,
                                         entry) -> None:
+        lane = self._hs_lane(peer_id)
         fused = await self._fused_handle_ke_response(
-            peer_id, msg, data, message_id, entry
+            peer_id, msg, data, message_id, entry, lane
         )
         if fused is _HANDLED:
             return
@@ -1152,7 +1323,8 @@ class SecureMessaging:
             secret, sig = fused
         else:
             err = await self._check_common(peer_id, data, msg.get("sig", b""),
-                                     msg.get("sig_pk", b""), msg.get("sig_algo", ""))
+                                     msg.get("sig_pk", b""), msg.get("sig_algo", ""),
+                                     lane)
             if err is not None:
                 self._fail_pending(message_id, err.value)
                 return
@@ -1161,7 +1333,8 @@ class SecureMessaging:
                 # this await, _cleanup_exchange wipes the stored bytearray —
                 # which must not zero the operand mid-decapsulation
                 secret = await self._kem_decaps(bytes(entry[1]),
-                                                bytes.fromhex(data["ciphertext"]))
+                                                bytes.fromhex(data["ciphertext"]),
+                                                lane)
             except Exception:
                 logger.exception("decapsulation failed")
                 self._fail_pending(message_id, "decapsulation_error")
@@ -1185,7 +1358,7 @@ class SecureMessaging:
             "timestamp": time.time(),
         }
         if sig is None:
-            sig = await self._sign(_canonical(confirm))
+            sig = await self._sign(_canonical(confirm), lane)
         else:
             # the fused step signed the confirm transcript it was handed
             confirm = self._fused_confirm.pop(message_id)
@@ -1205,7 +1378,8 @@ class SecureMessaging:
             fut.set_result(True)
 
     async def _fused_handle_ke_response(self, peer_id: str, msg: dict,
-                                        data: dict, message_id: str, entry):
+                                        data: dict, message_id: str, entry,
+                                        lane: int = LANE_HANDSHAKE):
         """Composite initiator step: verify(response) + decaps +
         sign(confirm transcript) in ONE device trip.  Returns
         (shared_secret, confirm_sig) on success; ``_HANDLED`` when the
@@ -1245,7 +1419,7 @@ class SecureMessaging:
             # await must not zero the composite dispatch's operand
             ok, secret, sig = await f.decaps_verify_sign(
                 bytes(entry[1]), ct, sig_pk, _canonical(data), sig_in,
-                self._sig_keypair[1], _canonical(confirm),
+                self._sig_keypair[1], _canonical(confirm), lane,
             )
         except Exception:
             logger.exception("fused decaps_verify_sign failed; per-op fallback")
@@ -1319,6 +1493,9 @@ class SecureMessaging:
         secret's lifetime)."""
         _wipe(self.raw_secrets.get(peer_id))
         self.raw_secrets[peer_id] = bytearray(secret)
+        # this peer now has a completed session: its NEXT handshake (for
+        # HAD_SESSION_TTL_S) is a re-key on the top-priority lane
+        self._had_session[peer_id] = time.monotonic()
 
     def _save_peer_key(self, peer_id: str, secret: bytes) -> None:
         if self.key_storage is not None and getattr(self.key_storage, "is_unlocked", False):
@@ -1374,7 +1551,18 @@ class SecureMessaging:
             "message": message.to_dict(),
             "sig_algo": self.signature.name,
         }
-        sig = await self._sign(_canonical(package["message"]))
+        try:
+            # bulk lane: under a flood with a bulk bound armed, this send
+            # is SHED here (loud, counted) — rekey/handshake ops sharing
+            # the queue are untouched
+            sig = await self._sign(_canonical(package["message"]), LANE_BULK)
+        except LaneShed:
+            self._ctr_bulk_sheds.inc()
+            logger.warning(
+                "bulk send to %s shed at the bulk-lane bound (%d total)",
+                peer_id[:8], self._ctr_bulk_sheds.value,
+            )
+            return False
         package["sig"] = sig.hex()
         package["sig_pk"] = self._sig_keypair[0].hex()
         ad = _canonical(
@@ -1457,12 +1645,14 @@ class SecureMessaging:
         except (ValueError, KeyError, TypeError):
             logger.warning("malformed secure message from %s", peer_id[:8])
             return
-        # Verify signature over the message body.
+        # Verify signature over the message body (bulk lane: inbound bulk
+        # verification must not starve handshake ops either).
         if not await self._verify(
             package.get("sig_algo", ""),
             bytes.fromhex(package.get("sig_pk", "")),
             _canonical(package["message"]),
             bytes.fromhex(package.get("sig", "")),
+            LANE_BULK,
         ):
             logger.warning("signature verification failed from %s", peer_id[:8])
             return
@@ -1537,8 +1727,10 @@ class SecureMessaging:
             self._bkem = BatchedKEM(self.kem, *self._batch_cfg,
                                     fallback=self._cpu_fallback_kem(),
                                     scheduler=self._scheduler,
-                                    bucket_floor=self._batch_floor)
+                                    bucket_floor=self._batch_floor,
+                                    lane_capacity=self._lane_capacity)
             self._bfused = self._make_fused()
+            self._attach_tuners()
             self._spawn_warmup(kem=True, sig=False)
         peers = list(self.shared_keys)
         self.shared_keys.clear()
@@ -1563,6 +1755,7 @@ class SecureMessaging:
             # the AEAD name sits BEFORE public_key in the canonical init
             # JSON, so the fused facade's baked-in pk offset just moved
             self._bfused = self._make_fused()
+            self._attach_tuners()
             self._spawn_warmup(kem=False, sig=False)
         for peer_id, secret in self.raw_secrets.items():
             self.shared_keys[peer_id] = derive_message_key(
@@ -1584,8 +1777,10 @@ class SecureMessaging:
             self._bsig = BatchedSignature(self.signature, *self._batch_cfg,
                                            fallback=self._cpu_fallback_sig(),
                                            scheduler=self._scheduler,
-                                           bucket_floor=self._batch_floor)
+                                           bucket_floor=self._batch_floor,
+                                           lane_capacity=self._lane_capacity)
             self._bfused = self._make_fused()
+            self._attach_tuners()
             self._spawn_warmup(kem=False, sig=True)
         self._sig_keypair = self._load_or_generate_sig_keypair()
         self._log("crypto_settings_changed", component="signature", algorithm=name)

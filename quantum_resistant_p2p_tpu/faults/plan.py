@@ -180,11 +180,15 @@ class FaultPlan:
         return ("send", payload)
 
     def device_dispatch(self, label: str, n_items: int,
-                        shard: int | None = None) -> None:
+                        shard: int | None = None,
+                        lane: str | None = None) -> None:
         """May raise FaultInjected (a device fault at the dispatch boundary).
         ``shard`` is the placement-axis coordinate (provider/scheduler.py)
-        so a plan can kill ONE shard's device: match={"shard": i}."""
-        info = {"op": label, "n_items": n_items, "shard": shard}
+        so a plan can kill ONE shard's device: match={"shard": i}; ``lane``
+        is the flush's priority lane name ("rekey"/"handshake"/"bulk",
+        provider/batched.py) so a gateway chaos plan can target one lane's
+        flushes: match={"lane": "bulk"}."""
+        info = {"op": label, "n_items": n_items, "shard": shard, "lane": lane}
         for _i, rule, entry in self._fire("device.dispatch", info,
                                           actions=("raise", "delay")):
             if rule.action == "raise":
@@ -318,10 +322,11 @@ def net_send(sender: str, peer: str, msg_type: str, payload: dict[str, Any]):
     return plan.net_send(sender, peer, msg_type, payload)
 
 
-def device_dispatch(label: str, n_items: int, shard: int | None = None) -> None:
+def device_dispatch(label: str, n_items: int, shard: int | None = None,
+                    lane: str | None = None) -> None:
     plan = _ACTIVE
     if plan is not None:
-        plan.device_dispatch(label, n_items, shard=shard)
+        plan.device_dispatch(label, n_items, shard=shard, lane=lane)
 
 
 def poison_results(label: str, results: list[Any]) -> list[Any]:

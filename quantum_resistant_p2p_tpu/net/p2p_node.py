@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
 
 from ..faults import plan as _faults
+from ..obs import flight as obs_flight
 from ..obs import trace as obs_trace
 
 logger = logging.getLogger(__name__)
@@ -68,6 +69,8 @@ class P2PNode:
         port: int = 8000,
         key_storage=None,
         chunk_size: int = 64 * 1024,
+        max_peers: int = 0,
+        accept_backlog: int = 256,
     ):
         if node_id is None:
             from .identity import load_or_generate_node_id
@@ -77,6 +80,25 @@ class P2PNode:
         self.host = host
         self.port = port
         self.chunk_size = chunk_size
+        #: connection budget (admission control, docs/gateway.md): inbound
+        #: peers beyond this many live connections are SHED at the hello —
+        #: a typed ``__busy__`` reply then close, counted loudly — instead
+        #: of admitted into a node already past its serving capacity.
+        #: 0 = unlimited (the default; every pre-gateway caller).
+        self.max_peers = max_peers
+        #: kernel accept backlog for the listening socket: bounds the
+        #: not-yet-accepted connection queue during an arrival storm (the
+        #: kernel-side half of the backpressure story)
+        self.accept_backlog = accept_backlog
+        #: inbound connections shed over the budget (the gateway gauge)
+        self.sheds = 0
+        #: peers admitted but not yet registered (the hello reply awaits
+        #: between the budget check and registration): counted against
+        #: the budget so a storm of concurrent hellos cannot all pass the
+        #: check before any of them registers
+        self._admitting: set[str] = set()
+        #: dials WE made that a remote shed with ``__busy__``
+        self.busy_rejects = 0
         self._server: asyncio.Server | None = None
         self._peers: dict[str, _Peer] = {}
         self._read_tasks: dict[str, asyncio.Task] = {}
@@ -96,7 +118,10 @@ class P2PNode:
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._on_inbound, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._on_inbound, self.host, self.port,
+            backlog=self.accept_backlog,
+        )
         self._running = True
         actual = self._server.sockets[0].getsockname()[1] if self._server.sockets else self.port
         self.port = actual
@@ -225,6 +250,15 @@ class P2PNode:
                 {"type": "__hello__", "node_id": self.node_id, "listen_port": self.port},
             )
             hello = await asyncio.wait_for(self._read_plain_frame(reader), HELLO_TIMEOUT)
+            if hello.get("type") == "__busy__":
+                # the remote gateway shed this dial (connection budget):
+                # a TYPED fast failure — retryable once load drains, and
+                # counted so a storm driver can report client-side sheds
+                self.busy_rejects += 1
+                logger.warning("peer %s:%s is at capacity (shed our dial)",
+                               host, port)
+                writer.close()
+                return None, True
             if hello.get("type") != "__hello__":
                 raise ValueError("bad hello")
         except Exception as e:
@@ -242,19 +276,62 @@ class P2PNode:
             hello = await asyncio.wait_for(self._read_plain_frame(reader), HELLO_TIMEOUT)
             if hello.get("type") != "__hello__":
                 raise ValueError("bad hello")
-            await self._send_frame(
-                writer,
-                asyncio.Lock(),
-                {"type": "__hello__", "node_id": self.node_id, "listen_port": self.port},
-            )
+            peer_id = str(hello.get("node_id", ""))
+            if not peer_id:
+                raise ValueError("bad hello")
+            known = peer_id in self._peers or peer_id in self._admitting
+            if (
+                self.max_peers
+                and not known
+                and len(self._peers) + len(self._admitting) >= self.max_peers
+            ):
+                # Admission control: over the connection budget, shed LOUDLY
+                # with a typed reply (the dialer sees a fast, retryable
+                # "busy", never a timeout).  A reconnect of an already-
+                # registered peer replaces its socket and is never shed.
+                # In-flight admissions (_admitting) count against the
+                # budget: the hello reply below AWAITS, so without the
+                # reservation a storm of concurrent hellos would all pass
+                # this check before any of them registers.
+                await self._shed_inbound(writer, addr)
+                return
+            self._admitting.add(peer_id)
+            try:
+                await self._send_frame(
+                    writer,
+                    asyncio.Lock(),
+                    {"type": "__hello__", "node_id": self.node_id, "listen_port": self.port},
+                )
+            finally:
+                self._admitting.discard(peer_id)
         except Exception as e:
             logger.warning("inbound hello from %s failed: %s", addr, e)
             writer.close()
             return
-        peer_id = hello["node_id"]
         self._register_peer(
             peer_id, reader, writer, addr[0], int(hello.get("listen_port", addr[1]))
         )
+
+    async def _shed_inbound(self, writer: asyncio.StreamWriter, addr) -> None:
+        """Refuse one over-budget inbound connection: typed ``__busy__``
+        reply, loud (rate-limited) log line, flight-recorder event."""
+        self.sheds += 1
+        if self.sheds == 1 or self.sheds % 64 == 0:
+            logger.warning(
+                "connection budget reached (%d peers, max %d): shedding "
+                "inbound connection from %s (%d shed so far)",
+                len(self._peers), self.max_peers, addr, self.sheds,
+            )
+            obs_flight.record(
+                "load_shed", where="connection", node=self.node_id[:8],
+                peers=len(self._peers), max_peers=self.max_peers,
+                sheds=self.sheds,
+            )
+        try:
+            await self._send_frame(writer, asyncio.Lock(), {"type": "__busy__"})
+        except (ConnectionError, OSError):
+            pass  # the dialer is gone; the shed stands either way
+        writer.close()
 
     def _register_peer(self, peer_id, reader, writer, host, port) -> None:
         old = self._peers.pop(peer_id, None)

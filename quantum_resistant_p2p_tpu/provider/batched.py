@@ -36,6 +36,29 @@ from ..obs.metrics import LatencyHistogram
 from .base import (KeyExchangeAlgorithm, SignatureAlgorithm,
                    next_pow2 as _next_pow2, pad_rows as _pad_rows)
 
+#: priority lanes, highest priority first (lowest value wins the flush
+#: order): re-keys of live sessions must never starve behind a bulk
+#: flood, and fresh handshakes sit between the two (docs/gateway.md).
+#: Lane tags ride each queued op; the flush drain takes ops in
+#: (lane, arrival) order, so with single-lane traffic (every pre-gateway
+#: caller) the drain is bit-for-bit the old insertion-order slice.
+LANE_REKEY, LANE_HANDSHAKE, LANE_BULK = 0, 1, 2
+LANE_NAMES = {LANE_REKEY: "rekey", LANE_HANDSHAKE: "handshake",
+              LANE_BULK: "bulk"}
+
+
+class LaneShed(RuntimeError):
+    """A lane hit its pending-depth bound and this op was shed (loudly) —
+    admission control at the queue: bounded memory, and a bulk flood
+    degrades BULK, not the rekey/handshake lanes sharing the queue."""
+
+    def __init__(self, label: str, lane: int, depth: int):
+        super().__init__(
+            f"queue {label}: {LANE_NAMES.get(lane, lane)} lane shed at "
+            f"depth {depth}"
+        )
+        self.lane = lane
+
 
 @dataclass
 class QueueStats:
@@ -58,8 +81,17 @@ class QueueStats:
     device_trips: int = 0
     #: per-flush batch sizes, most recent last (bounded)
     batch_sizes: list[int] = field(default_factory=list)
-    #: per-flush dispatch latency percentiles (obs.metrics)
+    #: per-flush dispatch latency percentiles (obs.metrics) — measured
+    #: from the event loop, so queue-wait/executor contention included
     dispatch_hist: LatencyHistogram = field(default_factory=LatencyHistogram)
+    #: ON-WORKER batch-fn latency (the device program itself, no executor
+    #: queueing): what the autotuner's amortization window keys on — the
+    #: loop-side number would feed back (contention -> wider window ->
+    #: more contention)
+    device_hist: LatencyHistogram = field(default_factory=LatencyHistogram)
+    #: ops submitted / shed per priority lane (lane tag -> count)
+    lane_ops: dict = field(default_factory=dict)
+    lane_sheds: dict = field(default_factory=dict)
     BATCH_SIZE_HISTORY = 1024
 
     def as_dict(self) -> dict[str, Any]:
@@ -74,6 +106,10 @@ class QueueStats:
             ),
             "p50_dispatch_ms": round(1e3 * (h.percentile(50) or 0.0), 3),
             "p99_dispatch_ms": round(1e3 * (h.percentile(99) or 0.0), 3),
+            "p50_device_ms": round(
+                1e3 * (self.device_hist.percentile(50) or 0.0), 3),
+            "p99_device_ms": round(
+                1e3 * (self.device_hist.percentile(99) or 0.0), 3),
             "fallback_ops": self.fallback_ops,
             "fallback_flushes": self.fallback_flushes,
             "breaker_trips": self.breaker_trips,
@@ -84,6 +120,12 @@ class QueueStats:
                 round((self.ops - self.fallback_ops) / self.ops, 4)
                 if self.ops else None
             ),
+            # additive keys (the legacy layout above is a compatibility
+            # contract): per-lane submit/shed counts, by lane name
+            "lanes": {LANE_NAMES.get(k, str(k)): v
+                      for k, v in sorted(self.lane_ops.items())},
+            "lane_sheds": {LANE_NAMES.get(k, str(k)): v
+                           for k, v in sorted(self.lane_sheds.items())},
         }
 
 
@@ -403,6 +445,7 @@ class OpQueue:
         bucket_floor: int = 1,
         label: str = "",
         scheduler=None,
+        lane_capacity: dict[int, int] | None = None,
     ):
         #: queue name at the fault-injection boundary (faults/) and in logs
         self.label = label
@@ -454,8 +497,23 @@ class OpQueue:
         self._warm_buckets: set[int] = set()
         self._warming: set[int] = set()
         self.stats = QueueStats()
+        #: per-lane pending-depth bounds (lane tag -> max pending); an op
+        #: submitted to a full lane is SHED (LaneShed, loud) instead of
+        #: growing the queue without bound — None/absent = unbounded
+        self.lane_capacity = lane_capacity
+        #: adaptive flush policy (provider/autotune.py QueueTuner): when
+        #: attached, overrides the flush-at threshold and timer window on
+        #: the hot path; None (the default, and QRP2P_AUTOTUNE=0) reads
+        #: the static constructor values — bit-for-bit the old behavior
+        self.tuner = None
         self._items: list[Any] = []
         self._futures: list[asyncio.Future] = []
+        #: lane tag per pending item (parallel to _items), plus O(1)
+        #: pending counts per lane — the capacity check runs on EVERY
+        #: capped-lane submit, and a list scan there would make a
+        #: saturated queue quadratic across a burst
+        self._lane_tags: list[int] = []
+        self._lane_pending: dict[int, int] = {}
         self._timer: asyncio.TimerHandle | None = None
         self._first_enqueue_t = 0.0
         #: strong refs to in-flight dispatch tasks: the loop holds only weak
@@ -470,16 +528,62 @@ class OpQueue:
             self._warming.discard(bucket)
             self._warm_buckets.add(bucket)
 
-    async def submit(self, item: Any) -> Any:
+    def _wait_s(self) -> float:
+        """Flush-timer window: the tuner's adaptive window when attached
+        and past its cold start, else the static constructor value
+        (bit-for-bit the old path)."""
+        if self.tuner is None:
+            return self.max_wait_s
+        w = self.tuner.wait_s()
+        return self.max_wait_s if w is None else w
+
+    def _flush_at(self) -> int:
+        """Pending-op count that triggers an immediate flush: the tuner's
+        chosen bucket when attached and decided, else ``max_batch`` (the
+        old path: flush on the timer or a full batch).  A bucket of 1 is
+        NOT an early trigger — flushing every submit solo would shatter
+        the coalescing the (short) window still provides; at bucket 1 the
+        window is the whole policy."""
+        if self.tuner is None:
+            return self.max_batch
+        b = self.tuner.flush_at()
+        if b is None or b <= 1:
+            return self.max_batch
+        return min(self.max_batch, b)
+
+    def _shed(self, lane: int) -> None:
+        n = self.stats.lane_sheds.get(lane, 0) + 1
+        self.stats.lane_sheds[lane] = n
+        # loud but bounded: a bulk flood must not turn the log/flight ring
+        # into a wall of identical shed lines
+        if n == 1 or n % 128 == 0:
+            logging.getLogger(__name__).warning(
+                "queue %s: %s lane at capacity (%d pending); op shed "
+                "(%d total)", self.label or "?", LANE_NAMES.get(lane, lane),
+                self.lane_capacity.get(lane), n,
+            )
+            obs_flight.record(
+                "load_shed", where="lane", queue=self.label,
+                lane=LANE_NAMES.get(lane, str(lane)), sheds=n,
+            )
+        raise LaneShed(self.label, lane, self.lane_capacity.get(lane, 0))
+
+    async def submit(self, item: Any, lane: int = LANE_HANDSHAKE) -> Any:
         loop = asyncio.get_running_loop()
+        cap = (self.lane_capacity or {}).get(lane)
+        if cap is not None and self._lane_pending.get(lane, 0) >= cap:
+            self._shed(lane)
         fut: asyncio.Future = loop.create_future()
         self._items.append(item)
         self._futures.append(fut)
+        self._lane_tags.append(lane)
+        self._lane_pending[lane] = self._lane_pending.get(lane, 0) + 1
         self.stats.ops += 1
+        self.stats.lane_ops[lane] = self.stats.lane_ops.get(lane, 0) + 1
         if len(self._items) == 1:
             self._first_enqueue_t = time.perf_counter()
-            self._timer = loop.call_later(self.max_wait_s, self._flush_soon)
-        if len(self._items) >= self.max_batch:
+            self._timer = loop.call_later(self._wait_s(), self._flush_soon)
+        if len(self._items) >= self._flush_at():
             self._flush_soon()
         return await fut
 
@@ -492,6 +596,44 @@ class OpQueue:
         self._flush_local()
         self._coalescer.coalesce(self)
 
+    def _take_batch(self) -> tuple[list[Any], list[asyncio.Future], int]:
+        """Detach up to ``max_batch`` pending ops in (lane, arrival) order.
+
+        With single-lane traffic (every caller that never passes ``lane``)
+        the priority sort degenerates to the old insertion-order slice —
+        the drain is bit-for-bit the pre-lane behavior.  Under mixed-lane
+        load, a flush that cannot carry everything takes rekeys first,
+        then handshakes, then bulk: a bulk flood defers bulk, never the
+        rekey lane (the starvation bound, tests/test_gateway.py).
+        Returns (items, futures, flush_lane) — flush_lane is the highest-
+        priority lane aboard, stamped on the ``queue.flush`` span."""
+        n = len(self._items)
+        k = min(self.max_batch, n)
+        if len(set(self._lane_tags)) <= 1:
+            items = self._items[:k]
+            futs = self._futures[:k]
+            lane = self._lane_tags[0] if self._lane_tags else LANE_HANDSHAKE
+            del self._items[:k], self._futures[:k], self._lane_tags[:k]
+            if self._lane_tags:
+                self._lane_pending[lane] = len(self._lane_tags)
+            else:
+                self._lane_pending.clear()
+            return items, futs, lane
+        order = sorted(range(n), key=lambda i: (self._lane_tags[i], i))
+        take = order[:k]
+        taken = set(take)
+        items = [self._items[i] for i in take]
+        futs = [self._futures[i] for i in take]
+        lane = min(self._lane_tags[i] for i in take)
+        for i in take:
+            self._lane_pending[self._lane_tags[i]] -= 1
+        self._items = [x for i, x in enumerate(self._items) if i not in taken]
+        self._futures = [x for i, x in enumerate(self._futures)
+                         if i not in taken]
+        self._lane_tags = [x for i, x in enumerate(self._lane_tags)
+                           if i not in taken]
+        return items, futs, lane
+
     def _flush_local(self) -> None:
         """Detach pending items synchronously (so late submits can't bloat a
         batch past max_batch) and dispatch them as a task."""
@@ -500,11 +642,9 @@ class OpQueue:
             self._timer = None
         loop = asyncio.get_running_loop()
         while self._items:
-            items = self._items[: self.max_batch]
-            futs = self._futures[: self.max_batch]
-            del self._items[: self.max_batch]
-            del self._futures[: self.max_batch]
-            task = loop.create_task(self._dispatch(items, futs, self._first_enqueue_t))
+            items, futs, lane = self._take_batch()
+            task = loop.create_task(
+                self._dispatch(items, futs, self._first_enqueue_t, lane))
             self._dispatch_tasks.add(task)
             task.add_done_callback(self._reap_dispatch)
 
@@ -556,9 +696,19 @@ class OpQueue:
         if shard is not None:
             attrs["shard"] = shard.index
         with obs_trace.span(span_name, parent=parent, **attrs):
-            if shard is not None:
-                return shard.run_placed(fn, items)
-            return fn(items)
+            t0 = time.perf_counter()
+            try:
+                if shard is not None:
+                    return shard.run_placed(fn, items)
+                return fn(items)
+            finally:
+                if route not in ("fallback", "warmup"):
+                    # on-worker DEVICE-program time (no executor queueing):
+                    # the autotuner's amortization signal.  Fallback and
+                    # warmup-compile durations must not pollute it — a
+                    # recovery phase would otherwise tune its windows to
+                    # cpu/compile time instead of device time
+                    self.stats.device_hist.record(time.perf_counter() - t0)
 
     def _count_trip(self, breaker: Breaker | None = None) -> None:
         """One serial device round trip (device or warmup executor): the
@@ -567,13 +717,18 @@ class OpQueue:
         self.stats.device_trips += 1
         (breaker if breaker is not None else self.breaker).device_trips += 1
 
-    def _device_call(self, items: list[Any], shard_index: int | None = None) -> list[Any]:
+    def _device_call(self, items: list[Any], shard_index: int | None = None,
+                     lane: int | None = None) -> list[Any]:
         """The device dispatch boundary: the explicit fault-injection hook
         (faults/) wraps the real batch fn — a raise here IS a device fault
         and is handled (breaker + fallback) exactly like one.  The shard
-        index rides into the fault-match info so chaos plans can kill ONE
-        shard's device (match={"shard": i})."""
-        _faults.device_dispatch(self.label, len(items), shard=shard_index)
+        index and the flush's priority lane ride into the fault-match info
+        so chaos plans can kill ONE shard's device (match={"shard": i}) or
+        target one lane's flushes (match={"lane": "bulk"})."""
+        _faults.device_dispatch(
+            self.label, len(items), shard=shard_index,
+            lane=LANE_NAMES.get(lane) if lane is not None else None,
+        )
         return _faults.poison_results(self.label, self.batch_fn(items))
 
     def _warm_call(self, items: list[Any]) -> list[Any]:
@@ -603,7 +758,8 @@ class OpQueue:
             return shard, shard.breaker.acquire_dispatch(), shard.breaker
         return None, self.breaker.acquire_dispatch(), self.breaker
 
-    async def _run_batch(self, items: list[Any], flush_span=None) -> list[Any]:
+    async def _run_batch(self, items: list[Any], flush_span=None,
+                         lane: int | None = None) -> list[Any]:
         """Device path with watchdog + breaker; falls back to cpu when the
         device is slow, hung, or raising.  Each flush is placed whole on
         one shard (when a scheduler is armed) — a flush never splits
@@ -617,7 +773,7 @@ class OpQueue:
                 self._count_trip(shard.breaker if shard is not None else None)
                 return await loop.run_in_executor(
                     shard.breaker.device_executor if shard is not None else None,
-                    self._traced_call, self._direct_fn(shard),
+                    self._traced_call, self._direct_fn(shard, lane),
                     "device.dispatch", "direct", obs_trace.current(), items,
                     shard,
                 )
@@ -628,20 +784,25 @@ class OpQueue:
         if flush_span is not None and shard is not None:
             flush_span.set_attr("shard", shard.index)
         try:
-            return await self._run_claimed(loop, items, shard, claim, breaker)
+            return await self._run_claimed(loop, items, shard, claim, breaker,
+                                           lane)
         finally:
             if shard is not None:
                 self.scheduler.done(shard)
 
-    def _direct_fn(self, shard):
-        """Bind the shard index into the fault-hooked device call (the
-        callable crosses run_in_executor positionally)."""
-        if shard is None:
+    def _direct_fn(self, shard, lane: int | None = None):
+        """Bind the shard index and flush lane into the fault-hooked device
+        call (the callable crosses run_in_executor positionally)."""
+        if shard is None and lane is None:
             return self._device_call
-        return functools.partial(self._device_call, shard_index=shard.index)
+        return functools.partial(
+            self._device_call,
+            shard_index=shard.index if shard is not None else None, lane=lane,
+        )
 
     async def _run_claimed(self, loop, items: list[Any], shard, claim: str,
-                           breaker: Breaker) -> list[Any]:
+                           breaker: Breaker,
+                           lane: int | None = None) -> list[Any]:
         if claim == "fallback":
             return await self._run_fallback(items, breaker)
         bucket = max(self.bucket_floor, _next_pow2(len(items)))
@@ -709,7 +870,7 @@ class OpQueue:
         # default executor that the cpu fallback runs on.
         device = loop.run_in_executor(
             breaker.device_executor, self._traced_call,
-            self._direct_fn(shard), "device.dispatch", claim,
+            self._direct_fn(shard, lane), "device.dispatch", claim,
             obs_trace.current(), items, shard,
         )
         try:
@@ -739,7 +900,7 @@ class OpQueue:
         return results
 
     async def _dispatch(self, items: list[Any], futs: list[asyncio.Future],
-                        first_t: float) -> None:
+                        first_t: float, lane: int = LANE_HANDSHAKE) -> None:
         self.stats.flushes += 1
         self.stats.max_batch_seen = max(self.stats.max_batch_seen, len(items))
         self.stats.batch_sizes.append(len(items))
@@ -751,14 +912,19 @@ class OpQueue:
             # task was scheduled — i.e. the FIRST enqueuer's span — so a
             # handshake's flushes chain under its handshake span.
             with obs_trace.span("queue.flush", op=self.label, n=len(items),
+                                lane=LANE_NAMES.get(lane, str(lane)),
                                 waited_ms=round(
                                     1e3 * (t0 - first_t), 3)) as sp:
                 # _run_batch stamps the placed shard onto this span, so the
                 # flame graph's flush lane names the chip that served it
-                results = await self._run_batch(items, sp)
+                results = await self._run_batch(items, sp, lane)
             dt = time.perf_counter() - t0
             self.stats.total_dispatch_s += dt
             self.stats.dispatch_hist.record(dt)
+            if self.tuner is not None:
+                # the autotuner steps on flush completion (no background
+                # task): cheap cadence check, decisions off the hot path
+                self.tuner.maybe_step()
             for f, r in zip(futs, results):
                 if f.cancelled():
                     continue
@@ -797,7 +963,8 @@ def _run_valid(items, is_valid, dispatch, invalid_result, floor=1):
 
 
 def _make_queues(algo, fallback, breaker, max_batch, max_wait_ms,
-                 batch_meths, degrade_opts, bucket_floor=1, scheduler=None):
+                 batch_meths, degrade_opts, bucket_floor=1, scheduler=None,
+                 lane_capacity=None):
     """Build one OpQueue per batch method, wiring the shared breaker (or the
     placement scheduler) and the fallback partials (used by both facades
     below).  The device path pads to ``bucket_floor``; the cpu fallback
@@ -810,6 +977,7 @@ def _make_queues(algo, fallback, breaker, max_batch, max_wait_ms,
             OpQueue(functools.partial(meth, algo, bucket_floor), max_batch,
                     max_wait_ms, fallback_fn=fb, breaker=breaker,
                     bucket_floor=bucket_floor, scheduler=scheduler,
+                    lane_capacity=lane_capacity,
                     label=f"{algo.name}.{op}", **degrade_opts)
         )
     return out
@@ -866,6 +1034,7 @@ class BatchedKEM:
                  cooloff_s: float | None = None,
                  bucket_floor: int = 1,
                  scheduler=None,
+                 lane_capacity: dict[int, int] | None = None,
                  **degrade_opts):
         self.algo = algo
         self.fallback = fallback
@@ -881,7 +1050,7 @@ class BatchedKEM:
             algo, fallback, None if scheduler is not None else self.breaker,
             max_batch, max_wait_ms,
             (self._kg_batch, self._enc_batch, self._dec_batch), degrade_opts,
-            self.bucket_floor, scheduler,
+            self.bucket_floor, scheduler, lane_capacity,
         )
 
     @staticmethod
@@ -960,14 +1129,16 @@ class BatchedKEM:
             self.algo.encapsulate_batch(same)  # cache miss: _enc_cold
             self.algo.encapsulate_batch(same)  # cache hit:  _enc_pre
 
-    async def generate_keypair(self) -> tuple[bytes, bytes]:
-        return await self._kg.submit(None)
+    async def generate_keypair(self, lane: int = LANE_HANDSHAKE) -> tuple[bytes, bytes]:
+        return await self._kg.submit(None, lane)
 
-    async def encapsulate(self, public_key: bytes) -> tuple[bytes, bytes]:
-        return await self._enc.submit(public_key)
+    async def encapsulate(self, public_key: bytes,
+                          lane: int = LANE_HANDSHAKE) -> tuple[bytes, bytes]:
+        return await self._enc.submit(public_key, lane)
 
-    async def decapsulate(self, secret_key: bytes, ciphertext: bytes) -> bytes:
-        return await self._dec.submit((secret_key, ciphertext))
+    async def decapsulate(self, secret_key: bytes, ciphertext: bytes,
+                          lane: int = LANE_HANDSHAKE) -> bytes:
+        return await self._dec.submit((secret_key, ciphertext), lane)
 
     def stats(self) -> dict[str, Any]:
         return {
@@ -991,6 +1162,7 @@ class BatchedSignature:
                  cooloff_s: float | None = None,
                  bucket_floor: int = 1,
                  scheduler=None,
+                 lane_capacity: dict[int, int] | None = None,
                  **degrade_opts):
         self.algo = algo
         self.fallback = fallback
@@ -1002,7 +1174,7 @@ class BatchedSignature:
             algo, fallback, None if scheduler is not None else self.breaker,
             max_batch, max_wait_ms,
             (self._sign_batch, self._verify_batch), degrade_opts,
-            self.bucket_floor, scheduler,
+            self.bucket_floor, scheduler, lane_capacity,
         )
 
     @staticmethod
@@ -1089,11 +1261,13 @@ class BatchedSignature:
             sigs_d = self.algo.sign_batch(sks_d, [b"warmup"] * n2)
             self.algo.verify_batch(pks_d, [b"warmup"] * n2, sigs_d)
 
-    async def sign(self, secret_key: bytes, message: bytes) -> bytes:
-        return await self._sign.submit((secret_key, message))
+    async def sign(self, secret_key: bytes, message: bytes,
+                   lane: int = LANE_HANDSHAKE) -> bytes:
+        return await self._sign.submit((secret_key, message), lane)
 
-    async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
-        return await self._verify.submit((public_key, message, signature))
+    async def verify(self, public_key: bytes, message: bytes, signature: bytes,
+                     lane: int = LANE_HANDSHAKE) -> bool:
+        return await self._verify.submit((public_key, message, signature), lane)
 
     def stats(self) -> dict[str, Any]:
         return {
@@ -1132,7 +1306,8 @@ class BatchedFused:
     def __init__(self, fused, pk_off: int, ct_off: int, max_batch: int = 4096,
                  max_wait_ms: float = 2.0, fallback_kem=None, fallback_sig=None,
                  breaker: Breaker | None = None, cooloff_s: float | None = None,
-                 bucket_floor: int = 1, scheduler=None, **degrade_opts):
+                 bucket_floor: int = 1, scheduler=None,
+                 lane_capacity: dict[int, int] | None = None, **degrade_opts):
         self.fused = fused
         self.name = fused.name
         self.pk_off = pk_off
@@ -1148,6 +1323,7 @@ class BatchedFused:
                     fallback_fn=(fb if have_fb else None),
                     breaker=None if scheduler is not None else self.breaker,
                     bucket_floor=self.bucket_floor, scheduler=scheduler,
+                    lane_capacity=lane_capacity,
                     label=f"{fused.name}.{op}", **degrade_opts)
             for batch_fn, fb, op in (
                 (self._kg_batch, self._kg_fallback, "keygen_sign"),
@@ -1318,24 +1494,26 @@ class BatchedFused:
 
     # -- async surface ------------------------------------------------------
 
-    async def keygen_sign(self, sig_sk: bytes, template: bytes):
+    async def keygen_sign(self, sig_sk: bytes, template: bytes,
+                          lane: int = LANE_HANDSHAKE):
         """-> (kem_pk, kem_sk, sig) for the init step, one device trip."""
-        return await self._kg.submit((sig_sk, template))
+        return await self._kg.submit((sig_sk, template), lane)
 
     async def encaps_verify_sign(self, peer_pk: bytes, peer_sig_pk: bytes,
                                  msg_in: bytes, sig_in: bytes, sig_sk: bytes,
-                                 template: bytes):
+                                 template: bytes, lane: int = LANE_HANDSHAKE):
         """-> (ok, ct, shared_secret, sig) for the response step."""
         return await self._enc.submit(
-            (peer_pk, peer_sig_pk, msg_in, sig_in, sig_sk, template)
+            (peer_pk, peer_sig_pk, msg_in, sig_in, sig_sk, template), lane
         )
 
     async def decaps_verify_sign(self, kem_sk: bytes, ct: bytes,
                                  peer_sig_pk: bytes, msg_in: bytes,
-                                 sig_in: bytes, sig_sk: bytes, msg_out: bytes):
+                                 sig_in: bytes, sig_sk: bytes, msg_out: bytes,
+                                 lane: int = LANE_HANDSHAKE):
         """-> (ok, shared_secret, sig) for the confirm step."""
         return await self._dec.submit(
-            (kem_sk, ct, peer_sig_pk, msg_in, sig_in, sig_sk, msg_out)
+            (kem_sk, ct, peer_sig_pk, msg_in, sig_in, sig_sk, msg_out), lane
         )
 
     def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:

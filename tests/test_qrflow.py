@@ -540,6 +540,66 @@ def test_placement_edge_suppressed():
     assert "cross-thread-state" in {s.rule for s in suppressed}
 
 
+GAUGE_SRC = """
+    import threading
+
+    class Tuner:
+        def __init__(self, registry):
+            self.bucket = 1
+            self.window = 0.5
+            self._lock = threading.Lock()
+            registry.gauge("bucket").set_fn(self._read_bucket)
+
+        def _read_bucket(self):
+            # scrape-side callback: runs on whatever thread snapshots
+            self.bucket = max(1, self.bucket)
+            return self.bucket
+
+        async def step(self):
+            self.bucket = self.bucket * 2
+            with self._lock:
+                self.window = 0.001
+    """
+
+
+def test_gauge_set_fn_callback_is_a_cross_thread_edge():
+    """The autotuner surface (ISSUE 8): a callable handed to a gauge's
+    ``set_fn`` runs at snapshot/scrape/flight-dump time on whatever thread
+    asks — the domains map treats it as executor-owned, so unlocked tuner
+    state it shares with the loop-side stepper is a race."""
+    findings, _ = lint(GAUGE_SRC)
+    assert [f.rule for f in findings] == ["cross-thread-state"]
+    assert "Tuner.bucket" in findings[0].message
+    assert "executor" in findings[0].message
+
+
+def test_gauge_set_fn_lock_guarded_is_clean():
+    clean = GAUGE_SRC.replace(
+        "            self.bucket = max(1, self.bucket)\n"
+        "            return self.bucket",
+        "            with self._lock:\n"
+        "                self.bucket = max(1, self.bucket)\n"
+        "                return self.bucket",
+    ).replace(
+        "            self.bucket = self.bucket * 2\n",
+        "            with self._lock:\n"
+        "                self.bucket = self.bucket * 2\n",
+    )
+    assert "cross-thread-state" not in rule_ids(clean)
+
+
+def test_gauge_set_fn_edge_suppressed():
+    findings, suppressed = lint(GAUGE_SRC.replace(
+        "            self.bucket = max(1, self.bucket)",
+        "            self.bucket = max(1, self.bucket)  # qrlint: disable=cross-thread-state — scrape-side clamp of an int is advisory; torn reads acceptable",
+    ).replace(
+        "            self.bucket = self.bucket * 2",
+        "            self.bucket = self.bucket * 2  # qrlint: disable=cross-thread-state — scrape-side clamp of an int is advisory; torn reads acceptable",
+    ))
+    assert "cross-thread-state" not in {f.rule for f in findings}
+    assert "cross-thread-state" in {s.rule for s in suppressed}
+
+
 def test_init_writes_are_construction_not_sharing():
     assert rule_ids(
         """

@@ -584,6 +584,53 @@ def test_dispatch_except_no_breaker_suppression():
         "broad-except", "dispatch-except-no-breaker"]
 
 
+def test_dispatch_except_no_breaker_covers_lane_drain_dispatch():
+    """Trigger (gateway lanes, ISSUE 8): a lane-priority drain helper that
+    dispatches its drained batch is still a device dispatch — an except
+    swallowing its failure without recording to the breaker hides a
+    degraded lane exactly like any other flush."""
+    ids = [i for i in rule_ids(
+        """
+        class LaneQueue:
+            def drain_and_dispatch(self, lane):
+                items = [it for it, ln in self._pending if ln == lane]
+                try:
+                    return self.batch_fn(items)
+                except Exception:
+                    return [None] * len(items)   # lane silently degraded
+        """
+    ) if i == "dispatch-except-no-breaker"]
+    assert ids == ["dispatch-except-no-breaker"]
+
+
+def test_dispatch_except_no_breaker_lane_drain_clean_and_suppressed():
+    clean = """
+        class LaneQueue:
+            def drain_and_dispatch(self, lane):
+                items = [it for it, ln in self._pending if ln == lane]
+                try:
+                    return self.batch_fn(items)
+                except Exception:
+                    self.breaker.record_failure("device")
+                    return [None] * len(items)
+        """
+    assert "dispatch-except-no-breaker" not in rule_ids(clean)
+    findings, suppressed = lint(
+        """
+        class LaneQueue:
+            def drain_and_dispatch(self, lane):
+                items = [it for it, ln in self._pending if ln == lane]
+                try:
+                    return self.batch_fn(items)
+                except Exception:  # qrlint: disable=dispatch-except-no-breaker, broad-except
+                    return [None] * len(items)
+        """
+    )
+    assert [f.rule for f in findings] == []
+    assert sorted(s.rule for s in suppressed) == [
+        "broad-except", "dispatch-except-no-breaker"]
+
+
 # -- engine mechanics ---------------------------------------------------------
 
 

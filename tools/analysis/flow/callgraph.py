@@ -547,6 +547,15 @@ class CallGraph:
             for target in resolve_ref(call.args[0]):
                 self._add_edge(fn, target, call, "executor")
             return
+        if leaf == "set_fn" and call.args:
+            # lazy-gauge callbacks (obs/metrics.py Gauge.set_fn): evaluated
+            # at snapshot/scrape/flight-dump time on WHATEVER thread asks —
+            # an executor-domain edge, so state a gauge callback touches
+            # (e.g. the autotuner's decision state, provider/autotune.py)
+            # counts as cross-thread in the race pack
+            for target in resolve_ref(call.args[0]):
+                self._add_edge(fn, target, call, "executor")
+            return
         if leaf in ("call_soon", "call_later", "call_at", "call_soon_threadsafe"):
             idx = 0 if leaf == "call_soon" or leaf == "call_soon_threadsafe" else 1
             if len(call.args) > idx:

@@ -25,3 +25,10 @@ echo "qrlint clean"
 # analysis must also pass (every suppression carries a justification).
 python -m tools.analysis.flow.run quantum_resistant_p2p_tpu
 echo "qrflow clean"
+
+# Gateway storm smoke (docs/gateway.md): a fast 48-session storm through
+# the real TCP transport + protocol engine + autotuner must complete with
+# zero failed handshakes (stdlib providers — no accelerator, no OpenSSL).
+python -m tools.swarm_bench --storm --peers 48 --concurrency 48 \
+    --rekey-every 2 --seed 11 >/dev/null
+echo "storm smoke ok (48 sessions, 0 failures)"

@@ -30,6 +30,7 @@ SECTIONS: dict[str, list[str]] = {
         "quantum_resistant_p2p_tpu.provider.symmetric",
         "quantum_resistant_p2p_tpu.provider.batched",
         "quantum_resistant_p2p_tpu.provider.scheduler",
+        "quantum_resistant_p2p_tpu.provider.autotune",
         "quantum_resistant_p2p_tpu.provider.opcache",
         "quantum_resistant_p2p_tpu.provider.health",
         "quantum_resistant_p2p_tpu.faults.plan",

@@ -300,6 +300,433 @@ def write_obs_artifacts(stats: dict, out_dir: str | Path,
     return stats["obs"]
 
 
+# -- storm workload (ISSUE 8: the sustained-traffic serving tier) -------------
+#
+# The swarm bench above measures a fixed wave of handshakes; the STORM mode
+# measures the GATEWAY under sustained concurrent load: thousands of live
+# sessions arriving at a configurable rate, holding their connections,
+# mixing re-keys and bulk traffic, and churning — driven through the real
+# net/p2p_node TCP transport and the full SecureMessaging protocol engine
+# (admission control, priority lanes, and the batch autotuner all live).
+#
+# Crypto providers: ``--providers stdlib`` (the default for storms) runs
+# hash-based toy KEM/SIG/AEAD — the same pattern the faults/scheduler test
+# suites use — so the storm measures the SERVING LOOP (transport, protocol,
+# queues, batching, admission) rather than raw crypto throughput, and runs
+# on images without the OpenSSL wheel.  ``--providers real`` drives
+# ML-KEM-768 + ML-DSA-65 through the same storm for hardware environments.
+# The emitted JSON carries the provider set honestly.
+
+
+class _StormAEAD:
+    """Stdlib encrypt-then-MAC AEAD (HMAC-SHA256 over a SHA-256 keystream)
+    — bench-only: lets the FULL handshake (incl. the ke_test AEAD probe)
+    and bulk messaging run on images without the ``cryptography`` wheel.
+    Mirrors the test suites' ToyAEAD; never registered as a provider."""
+
+    name = "STORM-AEAD"
+    display_name = "STORM-AEAD (bench-only stdlib)"
+    key_size = 32
+    nonce_size = 16
+
+    @staticmethod
+    def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+        import hashlib
+
+        out = b""
+        ctr = 0
+        while len(out) < n:
+            out += hashlib.sha256(key + nonce + ctr.to_bytes(8, "big")).digest()
+            ctr += 1
+        return out[:n]
+
+    def encrypt(self, key, plaintext, associated_data=None):
+        import hashlib
+        import hmac
+        import os
+
+        nonce = os.urandom(self.nonce_size)
+        ct = bytes(a ^ b for a, b in
+                   zip(plaintext, self._keystream(key, nonce, len(plaintext))))
+        tag = hmac.new(key, nonce + ct + (associated_data or b""),
+                       hashlib.sha256).digest()
+        return nonce + ct + tag
+
+    def decrypt(self, key, data, associated_data=None):
+        import hashlib
+        import hmac
+
+        if len(data) < self.nonce_size + 32:
+            raise ValueError("ciphertext too short")
+        nonce, ct, tag = (data[: self.nonce_size], data[self.nonce_size:-32],
+                          data[-32:])
+        want = hmac.new(key, nonce + ct + (associated_data or b""),
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise ValueError("authentication failed")
+        return bytes(a ^ b for a, b in
+                     zip(ct, self._keystream(key, nonce, len(ct))))
+
+
+_STORM_REGISTERED = False
+
+
+def _register_storm_providers() -> None:
+    """Register the stdlib STORM-KEM/STORM-SIG toys for BOTH backends (the
+    'tpu' registration rides the device-path queue machinery; 'cpu' arms
+    the degrade fallback) — idempotent."""
+    global _STORM_REGISTERED
+    if _STORM_REGISTERED:
+        return
+    import hashlib
+    import hmac
+    import os
+
+    from quantum_resistant_p2p_tpu.provider.base import (
+        KeyExchangeAlgorithm, SignatureAlgorithm)
+    from quantum_resistant_p2p_tpu.provider.registry import (
+        register_kem, register_signature)
+
+    class StormKEM(KeyExchangeAlgorithm):
+        name = "STORM-KEM"
+        display_name = "STORM-KEM (bench-only stdlib)"
+        public_key_len = 32
+        secret_key_len = 32
+        ciphertext_len = 32
+        shared_secret_len = 32
+
+        def __init__(self, backend="cpu"):
+            self.backend = backend
+
+        def generate_keypair(self):
+            sk = os.urandom(32)
+            return hashlib.sha256(b"pk" + sk).digest(), sk
+
+        def encapsulate(self, public_key):
+            ct = os.urandom(32)
+            return ct, hashlib.sha256(public_key + ct).digest()
+
+        def decapsulate(self, secret_key, ciphertext):
+            pk = hashlib.sha256(b"pk" + secret_key).digest()
+            return hashlib.sha256(pk + ciphertext).digest()
+
+    class StormSig(SignatureAlgorithm):
+        name = "STORM-SIG"
+        display_name = "STORM-SIG (bench-only stdlib)"
+        public_key_len = 32
+        secret_key_len = 32
+        signature_len = 32
+
+        def __init__(self, backend="cpu"):
+            self.backend = backend
+
+        def generate_keypair(self):
+            sk = os.urandom(32)
+            return hashlib.sha256(b"pk" + sk).digest(), sk
+
+        def sign(self, secret_key, message):
+            pk = hashlib.sha256(b"pk" + secret_key).digest()
+            return hashlib.sha256(b"sig" + pk + message).digest()
+
+        def verify(self, public_key, message, signature):
+            return hmac.compare_digest(
+                signature,
+                hashlib.sha256(b"sig" + public_key + message).digest())
+
+    register_kem("STORM-KEM", lambda backend, devices=0: StormKEM(backend),
+                 ("cpu", "tpu"))
+    register_signature("STORM-SIG",
+                       lambda backend, devices=0: StormSig(backend),
+                       ("cpu", "tpu"))
+    _STORM_REGISTERED = True
+
+
+def _raise_fd_limit(need: int) -> None:
+    """A 10k-session storm needs ~2 fds per session in one process: lift
+    the soft RLIMIT_NOFILE to the hard cap (best-effort)."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < need:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(max(need, soft), hard), hard))
+    except (ImportError, ValueError, OSError):  # pragma: no cover
+        pass
+
+
+def _percentile(sorted_vals: list, p: float):
+    if not sorted_vals:
+        return None
+    return round(
+        sorted_vals[min(len(sorted_vals) - 1,
+                        max(0, int(len(sorted_vals) * p / 100.0)))], 4)
+
+
+async def run_storm(sessions: int = 1000, providers: str = "stdlib",
+                    arrival_rate: float = 0.0, concurrency: int = 512,
+                    msgs_per_session: int = 2, rekey_every: int = 0,
+                    churn_fraction: float = 0.0, seed: int = 0,
+                    max_batch: int = 4096, max_wait_ms: float = 3.0,
+                    autotune: bool = True, hub_max_peers: int = 0,
+                    handshake_budget: int = 0, bulk_lane_capacity: int = 0,
+                    shard_devices: int = 0, ke_timeout: float = 120.0,
+                    prewarm: bool = True, prewarm_cap: int = 256,
+                    fault_rules=None) -> dict:
+    """Sustained-traffic storm: ``sessions`` live peers through one hub.
+
+    Each session (seeded, reproducible): dial (busy-shed retries included)
+    -> authenticated handshake -> ``msgs_per_session`` bulk messages, with
+    a forced RE-KEY every ``rekey_every`` messages and, with probability
+    ``churn_fraction``, one churn cycle (drop the TCP session, redial,
+    re-handshake).  ``arrival_rate`` > 0 paces session starts (sessions/s,
+    uniform); 0 launches everything behind the ``concurrency`` gate.
+
+    Returns one JSON-ready dict: handshakes/s, p50/p99 split by first
+    handshake vs rekey lane, shed counters (connection / handshake /
+    bulk), device_served_fraction, and the autotuner's decisions.
+    ``fault_rules`` (faults/) arms a seeded chaos plan around the measured
+    window — plan.injected rides along, byte-reproducible given the seed.
+    """
+    import random
+
+    from quantum_resistant_p2p_tpu.app import messaging as _messaging
+    from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging
+    from quantum_resistant_p2p_tpu.faults import FaultPlan
+    from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode
+    from quantum_resistant_p2p_tpu.provider import get_kem, get_signature
+
+    _raise_fd_limit(4 * sessions + 64)
+    old_timeout = _messaging.KEY_EXCHANGE_TIMEOUT
+    _messaging.KEY_EXCHANGE_TIMEOUT = ke_timeout
+    if providers == "stdlib":
+        _register_storm_providers()
+        kem_name, sig_name = "STORM-KEM", "STORM-SIG"
+    else:
+        kem_name, sig_name = "ML-KEM-768", "ML-DSA-65"
+        from quantum_resistant_p2p_tpu.utils.benchmarking import (
+            enable_compile_cache)
+
+        enable_compile_cache()
+
+    rng = random.Random(seed)
+    aead = _StormAEAD()
+    # everything below runs under one finally: an exception escaping a
+    # session task (or Ctrl-C) must still restore the module-global
+    # protocol timeout and close every socket -- bench.py's storm
+    # ratchet runs four storms in one process
+    clients: list[SecureMessaging] = []
+    hub_node = proto = None
+    try:
+        gateway_kw = dict(
+            use_batching=True, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            autotune=autotune, shard_devices=shard_devices,
+        )
+        hub_node = P2PNode(node_id="hub", host="127.0.0.1", port=0,
+                           max_peers=hub_max_peers)
+        await hub_node.start()
+        hub = SecureMessaging(
+            hub_node, kem=get_kem(kem_name, "tpu"), symmetric=aead,
+            signature=get_signature(sig_name, "tpu"),
+            max_inflight_handshakes=handshake_budget,
+            bulk_lane_capacity=bulk_lane_capacity, **gateway_kw,
+        )
+        received = 0
+
+        def on_msg(peer_id, message):
+            nonlocal received
+            if not message.is_system:
+                received += 1
+
+        hub.register_message_listener(on_msg)
+
+        # one shared client-side batching plane (the proto pattern above):
+        # every client coalesces into the same queues / autotuner
+        proto = SecureMessaging(
+            P2PNode(node_id="proto", host="127.0.0.1", port=0),
+            kem=get_kem(kem_name, "tpu"), symmetric=aead,
+            signature=get_signature(sig_name, "tpu"), **gateway_kw,
+        )
+        await hub.wait_ready()
+        await proto.wait_ready()
+
+        if prewarm:
+            # warm every pow2 flush bucket a live storm can hit (up to the
+            # cap) on BOTH planes — the run_swarm --prewarm lesson: without
+            # this the burst lands on cold buckets and the degrade path
+            # quietly serves the storm from the fallback
+            sizes, b = [], 1
+            limit = min(max_batch, max(concurrency, 1), prewarm_cap)
+            while b <= limit:
+                sizes.append(b)
+                b *= 2
+            loop = asyncio.get_running_loop()
+            facades = [proto._bkem, proto._bsig, hub._bkem, hub._bsig]
+            facades += [f for f in (proto._bfused, hub._bfused) if f is not None]
+            for facade in facades:
+                await loop.run_in_executor(None, facade.warmup, tuple(sizes))
+
+        n_keys = sessions
+        kp_pks, kp_sks = proto.signature.generate_keypair_batch(n_keys)
+
+        first_lat: list[float] = []
+        rekey_lat: list[float] = []
+        churns = rekeys = 0
+        failures = 0
+        sem = asyncio.Semaphore(concurrency)
+
+        def make_client(i: int) -> SecureMessaging:
+            node = P2PNode(node_id=f"peer{i:05d}", host="127.0.0.1", port=0)
+            sm = SecureMessaging(
+                node, kem=proto.kem, symmetric=proto.symmetric,
+                signature=proto.signature,
+                sig_keypair=(bytes(kp_pks[i]), bytes(kp_sks[i])))
+            sm._bkem, sm._bsig, sm._bfused = proto._bkem, proto._bsig, proto._bfused
+            sm.use_batching = True
+            clients.append(sm)
+            return sm
+
+        async def handshake(sm, bucket: list[float]) -> bool:
+            nonlocal failures
+            t0 = time.perf_counter()
+            ok = await sm.initiate_key_exchange("hub")
+            bucket.append(time.perf_counter() - t0)
+            if not ok:
+                failures += 1
+            return ok
+
+        async def one_session(i: int, start_at: float, t_origin: float,
+                              srng: random.Random) -> None:
+            nonlocal churns, rekeys, failures
+            delay = start_at - (time.perf_counter() - t_origin)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            async with sem:
+                sm = make_client(i)
+                if await sm.node.connect_to_peer("127.0.0.1", hub_node.port,
+                                                 retries=4) != "hub":
+                    failures += 1
+                    return
+                if not await handshake(sm, first_lat):
+                    return
+                for k in range(msgs_per_session):
+                    await sm.send_message("hub", b"storm payload %d/%d" % (i, k))
+                    if rekey_every and (k + 1) % rekey_every == 0:
+                        # forced re-key: drop the session key and run the
+                        # 5-message handshake again — rides the REKEY lane on
+                        # both sides (sm and hub have completed a session)
+                        sm.shared_keys.pop("hub", None)
+                        sm.ke_state["hub"] = _messaging.KeyExchangeState.NONE
+                        rekeys += 1
+                        if not await handshake(sm, rekey_lat):
+                            return
+                if churn_fraction and srng.random() < churn_fraction:
+                    # churn: drop the TCP session entirely, redial, re-key
+                    await sm.node.disconnect_from_peer("hub")
+                    churns += 1
+                    if await sm.node.connect_to_peer("127.0.0.1", hub_node.port,
+                                                     retries=4) == "hub":
+                        await handshake(sm, rekey_lat)
+                    else:
+                        failures += 1
+
+        # seeded arrival schedule + per-session RNGs: the offered-load trace
+        # is a pure function of (seed, sessions, arrival_rate)
+        offsets = []
+        t = 0.0
+        for _ in range(sessions):
+            if arrival_rate > 0:
+                t += rng.uniform(0.0, 2.0 / arrival_rate)  # mean 1/rate
+            offsets.append(t)
+        session_rngs = [random.Random(rng.getrandbits(64)) for _ in range(sessions)]
+
+        plan = FaultPlan(seed, list(fault_rules)) if fault_rules else None
+        ctx = plan.activate() if plan is not None else None
+        if ctx is not None:
+            ctx.__enter__()
+        t_origin = time.perf_counter()
+        try:
+            await asyncio.gather(*(
+                one_session(i, offsets[i], t_origin, session_rngs[i])
+                for i in range(sessions)))
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+        elapsed = time.perf_counter() - t_origin
+
+        hub_metrics = hub.metrics()
+        proto_metrics = proto.metrics()
+
+    finally:
+        _messaging.KEY_EXCHANGE_TIMEOUT = old_timeout
+        for sm in clients:
+            await sm.node.stop()
+        if hub_node is not None:
+            await hub_node.stop()
+        if proto is not None:
+            await proto.node.stop()
+
+    total_hs = len(first_lat) + len(rekey_lat)
+    total_ops = fb_ops = 0
+    for m in (hub_metrics, proto_metrics):
+        for fam in ("kem_queue", "sig_queue", "fused_queue"):
+            for q in m.get(fam, {}).values():
+                total_ops += q["ops"]
+                fb_ops += q["fallback_ops"]
+    f_sorted, r_sorted = sorted(first_lat), sorted(rekey_lat)
+    client_busy = sum(sm.node.busy_rejects for sm in clients)
+    out = {
+        "workload": "storm",
+        "sessions": sessions,
+        "providers": ("stdlib-toy (serving-loop workload; PQ crypto "
+                      "benched by --slo/raw-ops)" if providers == "stdlib"
+                      else f"{kem_name}+{sig_name}"),
+        "aead": aead.name,
+        "seed": seed,
+        "arrival_rate": arrival_rate,
+        "concurrency": concurrency,
+        "msgs_per_session": msgs_per_session,
+        "rekey_every": rekey_every,
+        "churn_fraction": churn_fraction,
+        "autotune": autotune,
+        "shard_devices": shard_devices,
+        "elapsed_s": round(elapsed, 3),
+        "failures": failures,
+        "handshakes": total_hs,
+        "handshakes_per_s": round(total_hs / elapsed, 2) if elapsed else None,
+        "msgs_received": received,
+        "msgs_per_s": round(received / elapsed, 2) if elapsed else None,
+        "p50_handshake_s": _percentile(f_sorted, 50),
+        "p99_handshake_s": _percentile(f_sorted, 99),
+        "rekeys": rekeys,
+        "p50_rekey_s": _percentile(r_sorted, 50),
+        "p99_rekey_s": _percentile(r_sorted, 99),
+        "churns": churns,
+        "device_served_fraction": (
+            round((total_ops - fb_ops) / total_ops, 4) if total_ops else None),
+        "sheds": {
+            "connection": hub_node.sheds,
+            "client_busy_rejects": client_busy,
+            "handshake": hub_metrics["gateway"]["handshake_sheds"],
+            "bulk_hub": hub_metrics["gateway"]["bulk_sheds"],
+            "bulk_clients": sum(
+                sm._ctr_bulk_sheds.value for sm in clients) if clients else 0,
+        },
+        "gateway_hub": {
+            k: hub_metrics["gateway"][k]
+            for k in ("max_peers", "handshake_budget", "handshake_sheds")},
+        "autotune_hub": hub_metrics["gateway"]["autotune"],
+        "autotune_clients": proto_metrics["gateway"]["autotune"],
+    }
+    if plan is not None:
+        out["chaos"] = {
+            "seed": plan.seed,
+            "injected": len(plan.injected),
+            "first_injected": plan.injected[:8],
+        }
+    return out
+
+
 def _setup_emulated_devices(n: int) -> None:
     """Force an n-device virtual CPU platform (tests/conftest.py's trick)
     for multichip runs on single-accelerator hosts.  Must run before the
@@ -445,7 +872,46 @@ def main(argv=None) -> int:
     ap.add_argument("--obs-dir", default="bench_results",
                     help="directory for the trace-event + metrics-snapshot "
                          "artifacts (slo mode; '' disables)")
+    ap.add_argument("--storm", action="store_true",
+                    help="sustained-traffic storm: --peers concurrent live "
+                         "sessions with arrival pacing, rekey/bulk mix and "
+                         "churn through the gateway (admission control, "
+                         "priority lanes, batch autotuner)")
+    ap.add_argument("--providers", default="stdlib",
+                    choices=("stdlib", "real"),
+                    help="storm crypto: stdlib toys (serving-loop workload, "
+                         "wheel-less images) or ML-KEM-768+ML-DSA-65")
+    ap.add_argument("--arrival-rate", type=float, default=0.0,
+                    help="storm session starts per second (0 = all at once "
+                         "behind --concurrency)")
+    ap.add_argument("--msgs-per-session", type=int, default=2)
+    ap.add_argument("--rekey-every", type=int, default=0,
+                    help="force a re-key every N bulk messages per session")
+    ap.add_argument("--churn", type=float, default=0.0,
+                    help="per-session probability of one churn cycle "
+                         "(drop TCP, redial, re-key)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-autotune", dest="autotune", action="store_false",
+                    default=True, help="storm: pin the static flush policy")
+    ap.add_argument("--hub-max-peers", type=int, default=0)
+    ap.add_argument("--handshake-budget", type=int, default=0)
+    ap.add_argument("--bulk-lane-capacity", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.storm:
+        stats = asyncio.run(run_storm(
+            args.peers, providers=args.providers,
+            arrival_rate=args.arrival_rate, concurrency=args.concurrency,
+            msgs_per_session=args.msgs_per_session,
+            rekey_every=args.rekey_every, churn_fraction=args.churn,
+            seed=args.seed, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms, autotune=args.autotune,
+            hub_max_peers=args.hub_max_peers,
+            handshake_budget=args.handshake_budget,
+            bulk_lane_capacity=args.bulk_lane_capacity,
+            shard_devices=args.shard_devices, ke_timeout=args.ke_timeout,
+        ))
+        print(json.dumps(stats))
+        return 0 if stats["failures"] == 0 else 1
     if args.slo:
         args.concurrency = 1
     stats = asyncio.run(
