@@ -54,8 +54,8 @@ def _rotl(hi, lo, n: int):
         if n == 0:
             return hi, lo
     return (
-        (hi << n) | (lo >> (32 - n)),  # qrlint: disable=int32-narrowing — uint32 lane words: bits shifted past 32 drop by design, the rotation recovers them from the partner word
-        (lo << n) | (hi >> (32 - n)),  # qrlint: disable=int32-narrowing — same wrap-by-design rotation, low word
+        (hi << n) | (lo >> (32 - n)),  # qrkernel: wrapping — uint32 lane words: bits shifted past 32 drop by design, the rotation recovers them from the partner word
+        (lo << n) | (hi >> (32 - n)),  # qrkernel: wrapping — same wrap-by-design rotation, low word
     )
 
 

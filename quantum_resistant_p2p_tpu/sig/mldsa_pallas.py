@@ -53,7 +53,7 @@ def _rej_ntt_tiles(in_hi: list, in_lo: list) -> list:
         byts = block_bytes(sh, sl, RATE_WORDS)
         for t in range(len(byts) // 3):
             b0, b1, b2 = byts[3 * t], byts[3 * t + 1], byts[3 * t + 2]
-            c = (b0 | (b1 << 8) | ((b2 & 0x7F) << 16)).astype(jnp.int32)  # qrlint: disable=int32-narrowing — bytes < 256: the assembled candidate is at most 23 bits
+            c = (b0 | (b1 << 8) | ((b2 & 0x7F) << 16)).astype(jnp.int32)  # 23-bit bound machine-proved by qrkernel's interval analysis
             cand.append(c)
         if blk + 1 < N_SQUEEZE:
             sh, sl = _f1600(sh, sl)
@@ -165,11 +165,15 @@ def _mm_zeta(a, z: int):
     """(a * z) % Q for an int32 tile a in [0, q) and STATIC z in [0, q).
 
     Horner over 8-bit limbs of z keeps every intermediate under 2**31
-    (identical arithmetic to sig/mldsa.py:_mm with b static)."""
+    (identical arithmetic to sig/mldsa.py:_mm with b static).  The limb
+    bounds are machine-checked: qrkernel's interval analysis proves every
+    product/shift below from the two declared contracts."""
+    # qrkernel: assume a in [0, Q) — FIPS 204 §7.5: NTT butterfly operands are mod-q residues (every caller reduces % Q first)
+    # qrkernel: assume z in [0, Q) — zeta table entries are powers of the 512th root of unity mod q
     b2, b1, b0 = z >> 16, (z >> 8) & 0xFF, z & 0xFF
-    r = (a * b2) % Q  # qrlint: disable=int32-narrowing — a < q < 2**23 and b2 = z >> 16 <= 0x7F, so a * b2 < 2**30
-    r = (((r << 8) % Q) + (a * b1) % Q) % Q  # qrlint: disable=int32-narrowing — r < q < 2**23 so r << 8 < 2**31; a * b1 < 2**23 * 2**8 = 2**31
-    r = (((r << 8) % Q) + (a * b0) % Q) % Q  # qrlint: disable=int32-narrowing — same bounds as the previous limb step
+    r = (a * b2) % Q
+    r = (((r << 8) % Q) + (a * b1) % Q) % Q
+    r = (((r << 8) % Q) + (a * b0) % Q) % Q
     return r
 
 

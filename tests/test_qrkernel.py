@@ -1,0 +1,750 @@
+"""qrkernel self-tests: interval/known-bits domain mechanics, per-rule
+trigger/clean/suppressed fixtures (interval proof, wrap-by-design, vmap
+axis loss, grid/BlockSpec mismatch, read-after-donate, recompile hazard),
+the qrlint-deferral contract (a proved site needs no suppression comment),
+the suppression-justification + budget ratchets, SARIF schema validation —
+and the live codebase is violation-free (the third CI ratchet).
+
+Pure AST / abstract interpretation: no jax import anywhere, so this file
+runs on minimal no-jax images.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+import time
+from pathlib import Path
+
+from tools.analysis import default_rules
+from tools.analysis.engine import Engine, FileContext, Project
+from tools.analysis.flow.sarif import check_sarif, to_sarif
+from tools.analysis.kernel import kernel_rules
+from tools.analysis.kernel.absdom import IVal, bitand, bitor, lshift, mul
+from tools.analysis.kernel.interp import FuncVal, Interp
+from tools.analysis.kernel.packs import KernelAnalysis, site_status
+from tools.analysis.kernel.run import main as qrkernel_main
+from tools.analysis.all import main as all_main
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "quantum_resistant_p2p_tpu"
+
+
+def lint(source: str):
+    findings, suppressed = Engine(kernel_rules()).lint_source(textwrap.dedent(source))
+    return findings, suppressed
+
+
+def rule_ids(source: str) -> list[str]:
+    return sorted(f.rule for f in lint(source)[0])
+
+
+def _sites(source: str, path: str = "fixture.py"):
+    interp = Interp()
+    mod = interp.loader.get(path, textwrap.dedent(source))
+    interp.check_paths.add(mod.path)
+    for name in sorted(mod.scope_funcs()):
+        fn = mod.funcs.get(name)
+        if fn is not None:
+            interp.summary(FuncVal(fn, mod))
+    return {line: s for (p, line), s in interp.sites.items() if p == mod.path}
+
+
+# -- abstract domain mechanics ------------------------------------------------
+
+
+def test_interval_and_knownbits_transfer():
+    byte = bitand(IVal(), IVal.const(0xFF))  # x & 0xFF of a TOP value
+    assert (byte.lo, byte.hi) == (0, 0xFF)
+    nib = bitand(IVal(), IVal.const(0xF))
+    hi = lshift(nib, IVal.const(8))
+    assert hi.hi == 0xF00
+    packed = bitor(byte, hi)  # disjoint maybe-bits: exact 12-bit OR
+    assert packed.hi == 0xFFF
+    assert packed.fits("int32") is True
+    prod = mul(IVal.range(0, 2**23 - 1), IVal.range(0, 0x7F))
+    assert prod.fits("int32") is True  # the _mm_zeta limb bound
+    wide = mul(IVal.range(0, 2**23), IVal.range(0, 0x100))
+    assert wide.fits("int32") is None  # reaches 2**31: not provable
+
+
+def test_site_proof_through_helper_and_loop():
+    """The mlkem-shaped pipeline: helper returns bytes, loop assembles
+    12-bit candidates — the shift site is proved without any annotation."""
+    sites = _sites(
+        """
+        import jax.numpy as jnp
+
+        def unpack(words, n):
+            out = []
+            for w in range(n):
+                out.append((words[w] >> 4) & 0xFF)
+            return out
+
+        def sample_tiles(words):
+            byts = unpack(words, 21)
+            cand = []
+            for t in range(56):
+                b0, b1 = byts[2 * t], byts[2 * t + 1]
+                cand.append(b0 | ((b1 & 0xF) << 8))
+            return cand
+        """
+    )
+    assert sites and all(s.proved for s in sites.values())
+    assert max(s.bound for s in sites.values()) <= 0xF00
+
+
+def test_assume_contract_seeds_proof():
+    sites = _sites(
+        """
+        import jax.numpy as jnp
+        Q = 8380417
+
+        def mulmod_tiles(a, z: int):
+            # qrkernel: assume a in [0, Q) — mod-q residue by FIPS 204
+            # qrkernel: assume z in [0, Q) — zeta table entry
+            b2 = z >> 16
+            return (a * b2) % Q
+        """
+    )
+    (site,) = sites.values()
+    assert site.proved and site.bound < 2**31
+
+
+# -- per-rule trigger / clean / suppressed fixtures ---------------------------
+
+
+def test_widened_loop_cannot_claim_stale_proof():
+    """Soundness: a tile value that keeps growing in a symbolic loop is
+    widened — and the site must OBSERVE the widened state, not keep the
+    narrow bound from the first fixpoint passes."""
+    sites = _sites(
+        """
+        import jax.numpy as jnp
+
+        def grow_tiles(a, n: int):
+            x = a & 0xFF
+            for i in range(n):
+                x = x * 2
+            return x
+        """
+    )
+    (site,) = sites.values()
+    assert not site.proved
+
+
+def test_conditional_break_demotes_the_unrolled_proof():
+    """Soundness: a break under an ABSTRACT condition makes the unrolled
+    loop inexact — the loop must fall back to the fixpoint (where growth is
+    widened) instead of keeping the straight-line 'proved' bound."""
+    sites = _sites(
+        """
+        import jax.numpy as jnp
+
+        def f_tiles(a, flag):
+            acc = a & 0x1
+            for i in range(6):
+                if flag:
+                    break
+                acc = acc * 40000
+            y = acc * acc
+            return y
+        """
+    )
+    assert sites
+    assert not all(s.proved for s in sites.values())
+
+
+def test_inplace_list_growth_is_not_proved():
+    """Soundness: fixpoint change detection must see IN-PLACE list mutation
+    (snapshots clone LVals) — a list growing each pass widens instead of
+    'converging' at its first-pass bounds."""
+    sites = _sites(
+        """
+        import jax.numpy as jnp
+
+        def f_tiles(x, n: int):
+            cand = [x & 0x1]
+            for i in range(n):
+                cand.append(cand[-1] * 3)
+            y = cand[-1] * cand[-1]
+            return y
+        """
+    )
+    assert sites
+    assert not all(s.proved for s in sites.values())
+
+
+def test_break_branch_state_joins_into_the_proof():
+    """Soundness: a bound assigned right before a conditional break must be
+    VISIBLE to later sites — the break-path state joins the merge, it is
+    not discarded with the branch."""
+    sites = _sites(
+        """
+        import jax.numpy as jnp
+
+        def f_tiles(x, flag):
+            y = x & 0xF
+            for i in range(24):
+                if flag:
+                    y = x & 0xFFFFFF
+                    break
+                y = y & 0xF
+            return y * y
+        """
+    )
+    assert sites
+    assert not all(s.proved for s in sites.values())
+
+
+def test_descending_symbolic_range_is_not_minus_one():
+    """Soundness: `range(n, 0, -1)` counts DOWN from an unbounded n — the
+    loop variable must not be modeled as a small constant."""
+    sites = _sites(
+        """
+        import jax.numpy as jnp
+
+        def f_tiles(x, n: int):
+            acc = x & 0x3FF
+            for i in range(n, 0, -1):
+                acc = (x & 0x3FF) * i
+            return acc
+        """
+    )
+    assert sites
+    assert not all(s.proved for s in sites.values())
+
+
+def test_nested_function_dataflow_findings_are_deduped():
+    findings, _ = lint(
+        """
+        import functools
+        import jax
+
+        def build():
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(state, x):
+                return state + x
+
+            def drive(state, xs):
+                out = step(state, xs)
+                return state + out
+            return drive
+        """
+    )
+    assert [f.rule for f in findings] == ["kernel-read-after-donate"]
+
+
+def test_float_tiles_are_not_overflow_sites():
+    """Float math rounds, it does not wrap: a float32 kernel multiply must
+    produce NO kernel-int32-overflow finding (the Frodo/SPHINCS+ growth
+    path is float-adjacent)."""
+    assert rule_ids(
+        """
+        import jax.numpy as jnp
+
+        def f_tiles(x):
+            f = x.astype(jnp.float32)
+            return f * f
+        """
+    ) == []
+
+
+def test_int32_overflow_trigger_clean_suppressed():
+    trigger = """
+        import jax.numpy as jnp
+
+        def f_tiles(a, b):
+            return (a * b) % 3329
+        """
+    assert rule_ids(trigger) == ["kernel-int32-overflow"]
+    clean = """
+        import jax.numpy as jnp
+
+        def f_tiles(a, b):
+            return ((a & 0xFF) * (b & 0xFF)) % 3329
+        """
+    assert rule_ids(clean) == []
+    findings, suppressed = lint(
+        trigger.replace(
+            "% 3329",
+            "% 3329  # qrkernel: disable=kernel-int32-overflow — bench-only toy op on small ints"))
+    assert not findings
+    assert [s.rule for s in suppressed] == ["kernel-int32-overflow"]
+
+
+def test_wrapping_annotation_is_policed():
+    justified = """
+        import jax.numpy as jnp
+
+        def rot_tiles(hi):
+            return (hi << 7)  # qrkernel: wrapping — uint32 rotation: dropped bits recovered from the partner word
+        """
+    assert rule_ids(justified) == []
+    unjustified = justified.replace(
+        " — uint32 rotation: dropped bits recovered from the partner word", "")
+    assert rule_ids(unjustified) == ["kernel-unjustified-annotation"]
+
+
+def test_contract_violation_trigger_and_clean():
+    src = """
+        import jax.numpy as jnp
+        Q = 3329
+
+        def mulmod_tiles(a):
+            # qrkernel: assume a in [0, Q) — mod-q residue by protocol
+            return (a * 255) % Q
+
+        def caller_tiles(x):
+            arg = {arg}
+            return mulmod_tiles(arg)
+        """
+    bad = rule_ids(src.format(arg="(x & 0xFF) + 5000"))
+    assert bad == ["kernel-contract-violation"]
+    assert rule_ids(src.format(arg="(x & 0xFF) % Q")) == []
+
+
+def test_shape_mismatch_concrete_and_symbolic():
+    assert rule_ids(
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            y = jnp.zeros((4, 128))
+            return y.reshape(4, 64)
+        """
+    ) == ["kernel-shape-mismatch"]
+    # symbolic batch dim: coefficients differ with equal symbolic factors
+    assert rule_ids(
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            b = x.shape[0]
+            y = jnp.zeros((b, 128))
+            return y.reshape(b, 64)
+        """
+    ) == ["kernel-shape-mismatch"]
+    # consistent symbolic flatten stays silent
+    assert rule_ids(
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            b = x.shape[0]
+            y = jnp.zeros((b, 128))
+            return y.reshape(b * 128)
+        """
+    ) == []
+
+
+def test_unknown_batch_prefix_shapes_stay_silent():
+    """Regression (kem/frodo.py keygen): `batch + (n, m)` with an unknown
+    batch prefix must yield an UNKNOWN shape, not a fabricated rank-1 one —
+    the swapaxes/reshape chain below is exactly the live keygen pattern and
+    must not false-positive."""
+    assert rule_ids(
+        """
+        import jax.numpy as jnp
+
+        def keygen(z, r, n, nbar):
+            batch = z.shape[:-1]
+            st = r.reshape(batch + (nbar, n))
+            s_mat = jnp.swapaxes(st, -1, -2)
+            return st.reshape(batch + (-1,)), s_mat
+        """
+    ) == []
+
+
+def test_vmap_axis_loss_trigger_clean_suppressed():
+    trigger = """
+        import jax
+        import jax.numpy as jnp
+
+        def apply(f):
+            x = jnp.zeros((8, 128))
+            return jax.vmap(f, in_axes=2)(x)
+        """
+    assert rule_ids(trigger) == ["kernel-batch-axis"]
+    assert rule_ids(trigger.replace("in_axes=2", "in_axes=1")) == []
+    findings, suppressed = lint(trigger.replace(
+        "(x)\n",
+        "(x)  # qrkernel: disable=kernel-batch-axis — fixture: axis checked by the caller\n"))
+    assert not findings
+    assert [s.rule for s in suppressed] == ["kernel-batch-axis"]
+
+
+def test_vmap_in_axes_arity_mismatch():
+    assert rule_ids(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def apply(f, a, b):
+            return jax.vmap(f, in_axes=(0, 0, None))(a, b)
+        """
+    ) == ["kernel-batch-axis"]
+
+
+def test_grid_blockspec_divisibility_and_bounds():
+    src = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def k_kernel(o_ref):
+            pass
+
+        def launch():
+            return pl.pallas_call(
+                k_kernel, grid=({grid},),
+                out_specs=pl.BlockSpec(({block}, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((8, 128), "int32"),
+            )()
+        """
+    # 8 not divisible by 3 AND the index map overruns -> two findings
+    ids = rule_ids(src.format(grid=4, block=3))
+    assert ids == ["kernel-grid-blockspec", "kernel-grid-blockspec"]
+    # divisible blocks, in-bounds index map: clean
+    assert rule_ids(src.format(grid=4, block=2)) == []
+    # divisible blocks but a grid that drives the index map out of bounds
+    assert rule_ids(src.format(grid=8, block=2)) == ["kernel-grid-blockspec"]
+
+
+def test_accum_dtype_preferred_element_type_and_store():
+    assert rule_ids(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def f():
+            x = jnp.zeros((8, 8), dtype=jnp.float32)
+            y = jnp.zeros((8, 8), dtype=jnp.float32)
+            return jnp.matmul(x, y, preferred_element_type=jnp.bfloat16)
+        """
+    ) == ["kernel-accum-dtype"]
+    # a kernel storing an int32 value into an out ref declared int16
+    assert rule_ids(
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def acc_kernel(x_ref, o_ref):
+            s = x_ref[0].astype(jnp.int32) * 1
+            o_ref[0] = s
+
+        def launch(x):
+            return pl.pallas_call(
+                acc_kernel, grid=(1,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((8, 128), "int16"),
+            )(x)
+        """
+    ) == ["kernel-accum-dtype"]
+    # matching dtypes: clean
+    assert rule_ids(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def f():
+            x = jnp.zeros((8, 8), dtype=jnp.float32)
+            y = jnp.zeros((8, 8), dtype=jnp.float32)
+            return jnp.matmul(x, y, preferred_element_type=jnp.float32)
+        """
+    ) == []
+
+
+def test_read_after_donate_trigger_clean_suppressed():
+    trigger = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            return state + x
+
+        def drive(state, xs):
+            out = step(state, xs)
+            return state + out
+        """
+    assert rule_ids(trigger) == ["kernel-read-after-donate"]
+    clean = trigger.replace("return state + out", "return out")
+    assert rule_ids(clean) == []
+    rebind = trigger.replace("out = step(state, xs)",
+                             "state = step(state, xs)").replace(
+        "return state + out", "return state")
+    assert rule_ids(rebind) == []
+    findings, suppressed = lint(trigger.replace(
+        "return state + out",
+        "return state + out  # qrkernel: disable=kernel-read-after-donate — fixture: interpret-mode twin, no aliasing"))
+    assert not findings
+    assert [s.rule for s in suppressed] == ["kernel-read-after-donate"]
+
+
+def test_recompile_hazard_trigger_and_clean():
+    trigger = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        def run(data):
+            outs = []
+            for i in range(100):
+                outs.append(f(data[:i]))
+            return outs
+        """
+    assert rule_ids(trigger) == ["kernel-recompile-hazard"]
+    # fixed-shape slice in the loop: no hazard
+    assert rule_ids(trigger.replace("data[:i]", "data[:16]")) == []
+    # loop-derived constructor shape: hazard
+    assert rule_ids(trigger.replace("f(data[:i])", "f(jnp.zeros(i))")) == [
+        "kernel-recompile-hazard"]
+
+
+# -- qrlint deferral ----------------------------------------------------------
+
+
+def test_qrlint_defers_to_proved_sites():
+    """A tile-shift site the interval analysis PROVES needs no suppression:
+    qrlint's int32-narrowing stays silent.  An unprovable twin still fires."""
+    proved = textwrap.dedent(
+        """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def pack_tiles(words):
+            b0 = words[0] & 0xFF
+            b1 = words[1] & 0xFF
+            return b0 | ((b1 & 0xF) << 8)
+        """
+    )
+    findings, _ = Engine(default_rules()).lint_source(proved, "fixture.py")
+    assert "int32-narrowing" not in {f.rule for f in findings}
+    unproved = proved.replace("(b1 & 0xF) << 8", "(b1 + words[2]) << 8")
+    findings, _ = Engine(default_rules()).lint_source(unproved, "fixture2.py")
+    assert "int32-narrowing" in {f.rule for f in findings}
+
+
+def test_qrlint_defers_to_wrapping_annotation():
+    src = textwrap.dedent(
+        """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def rot_tiles(hi, lo, n: int):
+            return (hi << n) | (lo >> (32 - n))  # qrkernel: wrapping — uint32 rotation: dropped bits recovered from partner word
+        """
+    )
+    findings, _ = Engine(default_rules()).lint_source(src, "fixture.py")
+    assert "int32-narrowing" not in {f.rule for f in findings}
+
+
+def test_live_tree_proof_ledger():
+    """The exact sites PR 1 hand-justified are now machine-checked: the
+    mlkem/mldsa byte-assembly shifts and the _mm_zeta Horner limbs are
+    PROVED (with their bounds), the Keccak rotations are wrapping-annotated,
+    and NO int32-narrowing suppression remains in the kernel tree."""
+    mlkem = PACKAGE / "kem" / "mlkem_pallas.py"
+    mldsa = PACKAGE / "sig" / "mldsa_pallas.py"
+    keccak = PACKAGE / "core" / "keccak_pallas.py"
+    st = site_status(str(mlkem), mlkem.read_text(encoding="utf-8"))
+    assert sorted(st.values()).count("proved") == 2
+    st = site_status(str(mldsa), mldsa.read_text(encoding="utf-8"))
+    assert sorted(st.values()).count("proved") >= 4  # candidate + 3 limb lines
+    st = site_status(str(keccak), keccak.read_text(encoding="utf-8"))
+    assert set(st.values()) == {"wrapping"}
+    for f in (mlkem, mldsa, keccak):
+        assert "disable=int32-narrowing" not in f.read_text(encoding="utf-8")
+
+
+def test_live_tree_mm_zeta_bounds_match_the_old_comments():
+    """The machine-computed bounds reproduce the hand-written claims the
+    suppressions used to make: every _mm_zeta limb product stays < 2**31."""
+    mldsa = PACKAGE / "sig" / "mldsa_pallas.py"
+    interp = Interp()
+    mod = interp.loader.get(str(mldsa))
+    interp.check_paths.add(mod.path)
+    for name in sorted(mod.scope_funcs()):
+        fn = mod.funcs.get(name)
+        if fn is not None:
+            interp.summary(FuncVal(fn, mod))
+    limb_sites = [s for (p, _line), s in interp.sites.items()
+                  if p == mod.path and s.op in ("*", "<<") and s.proved]
+    assert limb_sites
+    assert all(s.bound < 2**31 for s in limb_sites)
+
+
+# -- suppression-justification + budget ratchets ------------------------------
+
+
+def test_unjustified_suppression_fires_and_justified_passes():
+    bad = """
+        import jax.numpy as jnp
+
+        def f_tiles(a, b):
+            return (a * b)  # qrkernel: disable=kernel-int32-overflow
+        """
+    assert rule_ids(bad) == ["kernel-unjustified-annotation"]
+    good = bad.replace("disable=kernel-int32-overflow",
+                       "disable=kernel-int32-overflow — fixture: toy op")
+    assert rule_ids(good) == []
+
+
+def test_assume_annotation_requires_justification():
+    bad = """
+        import jax.numpy as jnp
+        Q = 3329
+
+        def f_tiles(a):
+            # qrkernel: assume a in [0, Q)
+            return (a * 255) % Q
+        """
+    assert rule_ids(bad) == ["kernel-unjustified-annotation"]
+
+
+def test_budget_file_matches_live_tree(capsys):
+    """The committed suppression budget equals the live counts: any PR that
+    adds a suppression overruns it and fails the unified driver."""
+    budget = json.loads(
+        (REPO_ROOT / "tools" / "analysis" / "suppression_budget.json")
+        .read_text(encoding="utf-8"))
+    assert set(budget) == {"qrlint", "qrflow", "qrkernel"}
+    assert budget["qrkernel"] == 0  # every kernel site is proved, not waived
+
+
+def test_budget_overrun_fails_loudly(tmp_path, monkeypatch, capsys):
+    from tools.analysis import all as driver
+
+    pkg = tmp_path / "quantum_resistant_p2p_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(textwrap.dedent(
+        """
+        import jax.numpy as jnp
+
+        def f_tiles(a, b):
+            return (a * b)  # qrkernel: disable=kernel-int32-overflow — fixture: toy
+        """
+    ))
+    budget = tmp_path / "budget.json"
+    budget.write_text('{"qrlint": 0, "qrflow": 0, "qrkernel": 0}\n')
+    monkeypatch.setattr(driver, "BUDGET_PATH", budget)
+    monkeypatch.chdir(tmp_path)
+    rc = driver.main(["quantum_resistant_p2p_tpu"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "suppression budget violation" in err
+    assert "kernel-int32-overflow" in err
+    # within budget after re-pinning
+    rc = driver.main(["quantum_resistant_p2p_tpu", "--update-budget"])
+    capsys.readouterr()
+    assert rc == 0
+    rc = driver.main(["quantum_resistant_p2p_tpu"])
+    assert rc == 0
+    # UNDER budget is a violation too (equality pin): removing the
+    # suppression without re-pinning tells the PR to ratchet the file down
+    (pkg / "mod.py").write_text("x = 1\n")
+    rc = driver.main(["quantum_resistant_p2p_tpu"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "--update-budget" in err
+
+
+# -- output formats -----------------------------------------------------------
+
+
+def test_sarif_output_passes_schema_check(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(
+        """
+        import jax.numpy as jnp
+
+        def f_tiles(a, b):
+            return (a * b) % 3329
+
+        def g_tiles(a, b):
+            return (a * b) % 3329  # qrkernel: disable=kernel-int32-overflow — fixture: suppressed on purpose
+        """
+    ))
+    rc = qrkernel_main([str(bad), "--format", "sarif"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert check_sarif(doc) == []
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "qrkernel"
+    live = [r for r in run["results"] if "suppressions" not in r]
+    waived = [r for r in run["results"] if "suppressions" in r]
+    assert [r["ruleId"] for r in live] == ["kernel-int32-overflow"]
+    assert [r["ruleId"] for r in waived] == ["kernel-int32-overflow"]
+
+
+def test_merged_sarif_has_one_run_per_analyzer(tmp_path, capsys):
+    out = tmp_path / "merged.sarif"
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    rc = all_main([str(clean), "--sarif-out", str(out), "--format", "json"])
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(out.read_text(encoding="utf-8"))
+    assert check_sarif(doc) == []
+    names = [r["tool"]["driver"]["name"] for r in doc["runs"]]
+    assert names == ["qrlint", "qrflow", "qrkernel"]
+
+
+def test_cli_json_select_proofs_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\n\n"
+                   "def f_tiles(a, b):\n    return a * b\n")
+    assert qrkernel_main([str(bad)]) == 1
+    capsys.readouterr()
+    rc = qrkernel_main([str(bad), "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["error"] == 1
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "kernel-int32-overflow"
+    assert finding["path"] == str(bad) and finding["line"] == 4
+    assert qrkernel_main([str(bad), "--select", "kernel-batch-axis"]) == 0
+    assert qrkernel_main([str(bad), "--select", "no-such-rule"]) == 2
+    capsys.readouterr()
+    rc = qrkernel_main([str(bad), "--proofs"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "unproven" in out
+
+
+def test_list_rules(capsys):
+    assert qrkernel_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("kernel-int32-overflow", "kernel-contract-violation",
+                "kernel-shape-mismatch", "kernel-batch-axis",
+                "kernel-grid-blockspec", "kernel-accum-dtype",
+                "kernel-read-after-donate", "kernel-recompile-hazard",
+                "kernel-unjustified-annotation"):
+        assert rid in out
+
+
+# -- the CI ratchet -----------------------------------------------------------
+
+
+def test_live_codebase_is_violation_free(capsys):
+    """The whole package passes qrkernel: every kernel site is proved,
+    wrapping-annotated, or fixed.  New violations fail here AND in CI."""
+    rc = qrkernel_main([str(PACKAGE)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"qrkernel found new violations:\n{out}"
+
+
+def test_live_run_is_fast_enough_for_ci():
+    """Summaries are context-insensitive and memoized: the whole package
+    must verify in seconds, not minutes (<30s perf gate)."""
+    contexts = {str(p): FileContext(str(p), p.read_text(encoding="utf-8"))
+                for p in sorted(PACKAGE.rglob("*.py"))}
+    t0 = time.perf_counter()
+    analysis = KernelAnalysis(Project(contexts))
+    dt = time.perf_counter() - t0
+    assert dt < 30.0, f"kernel abstract interpretation took {dt:.1f}s"
+    assert analysis.interp.summaries  # the summary cache is actually in use

@@ -25,6 +25,15 @@ The ``flow`` subpackage (**qrflow**) is the whole-program half built on
 this engine: an interprocedural secret-taint / constant-time analysis and
 a cross-thread shared-state race detector, run as a second CI ratchet —
 ``python -m tools.analysis.flow.run quantum_resistant_p2p_tpu``.
+
+The ``kernel`` subpackage (**qrkernel**) is the device-side half: an
+abstract-interpretation verifier for the JAX/Pallas kernel layer
+(bit-width proofs that replaced the hand-justified int32-narrowing
+suppressions, symbolic shape/batch-axis checks, pallas_call structure,
+donation/recompile hazards), run as the third ratchet —
+``python -m tools.analysis.kernel.run quantum_resistant_p2p_tpu``.
+``python -m tools.analysis.all`` (``qr-analysis``) drives all three with
+one merged SARIF, one exit code, and the suppression-count budget.
 """
 
 from __future__ import annotations

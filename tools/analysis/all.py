@@ -1,0 +1,183 @@
+"""Unified analysis driver — ``python -m tools.analysis.all <targets>``.
+
+Runs all three ratchets in order (qrlint → qrflow → qrkernel) over the same
+targets, emits ONE merged SARIF document (one ``runs[]`` entry per
+analyzer) and returns ONE exit code, so CI needs a single step instead of
+three.  Also asserts the **suppression budget**
+(``tools/analysis/suppression_budget.json``): per-analyzer counts of
+inline suppressions may only go DOWN — a PR that adds an unbudgeted
+suppression fails loudly with the exact locations, and a PR that removes
+one is told to ratchet the budget file.
+
+Exit status: 0 all analyzers clean and within budget, 1 any error-severity
+finding or budget overrun, 2 usage errors.
+
+```
+python -m tools.analysis.all quantum_resistant_p2p_tpu           # all three
+qr-analysis quantum_resistant_p2p_tpu --sarif-out merged.sarif   # CI step
+qr-analysis quantum_resistant_p2p_tpu --update-budget            # re-pin
+```
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import default_rules
+from .engine import Engine, Finding, resolve_target
+from .flow import flow_rules
+from .flow.sarif import to_sarif
+from .kernel import kernel_rules
+
+BUDGET_PATH = Path(__file__).resolve().parent / "suppression_budget.json"
+
+#: (name, rule factory) in ratchet order
+ANALYZERS = (
+    ("qrlint", default_rules),
+    ("qrflow", flow_rules),
+    ("qrkernel", kernel_rules),
+)
+
+
+def _resolve_target(target: str) -> Path:
+    return resolve_target(target, "qr-analysis")
+
+
+def run_all(targets: list[Path]) -> dict[str, tuple[list[Finding], list[Finding], list]]:
+    """{analyzer: (findings, suppressed, rules)} over the shared targets."""
+    out = {}
+    for name, factory in ANALYZERS:
+        rules = factory()
+        findings, suppressed = Engine(rules).lint_paths(targets)
+        out[name] = (findings, suppressed, rules)
+    return out
+
+
+def merged_sarif(results) -> dict:
+    doc = None
+    for name, (findings, suppressed, rules) in results.items():
+        one = to_sarif(findings, suppressed, rules, tool_name=name)
+        if doc is None:
+            doc = one
+        else:
+            doc["runs"].extend(one["runs"])
+    return doc or {"version": "2.1.0", "runs": []}
+
+
+def check_budget(results, budget: dict) -> list[str]:
+    """Budget violations (empty = counts EQUAL the budget).
+
+    The budget is an equality pin, which is what makes it a one-way
+    ratchet: an overrun means an unbudgeted suppression was added (fix the
+    finding, or raise the pin with explicit reviewer sign-off); an
+    *underrun* means suppressions were removed without re-pinning — the PR
+    must run ``--update-budget`` so the headroom can't silently creep back.
+    """
+    problems = []
+    for name, (_findings, suppressed, _rules) in results.items():
+        allowed = budget.get(name)
+        if allowed is None:
+            problems.append(f"{name}: no budget entry — add one to "
+                            f"{BUDGET_PATH.name} (current count: {len(suppressed)})")
+            continue
+        if len(suppressed) > allowed:
+            lines = [f"{name}: {len(suppressed)} suppressions > budget {allowed} "
+                     "— fix the finding instead of waiving it, or (with "
+                     "reviewer sign-off) raise the budget explicitly:"]
+            for s in suppressed:
+                lines.append(f"    {s.path}:{s.line}: [{s.rule}]")
+            problems.append("\n".join(lines))
+        elif len(suppressed) < allowed:
+            problems.append(
+                f"{name}: {len(suppressed)} suppressions < budget {allowed} "
+                "— you removed one (nice): re-pin the ratchet with "
+                "`qr-analysis --update-budget` so the headroom can't be "
+                "spent by a later PR")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="qr-analysis",
+        description=("unified static-analysis driver: qrlint + qrflow + "
+                     "qrkernel, one exit code, one merged SARIF "
+                     "(docs/static_analysis.md)"),
+    )
+    ap.add_argument("targets", nargs="*", default=["quantum_resistant_p2p_tpu"],
+                    help="files, directories, or package names (default: the package)")
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human", help="output format (default: human)")
+    ap.add_argument("--sarif-out", metavar="FILE",
+                    help="also write the merged SARIF document to FILE")
+    ap.add_argument("--no-budget", action="store_true",
+                    help="skip the suppression-budget assertion")
+    ap.add_argument("--update-budget", action="store_true",
+                    help="re-pin suppression_budget.json to the current "
+                         "counts (use after deliberately removing one)")
+    args = ap.parse_args(argv)
+
+    targets = [_resolve_target(t) for t in (args.targets or ["quantum_resistant_p2p_tpu"])]
+    results = run_all(targets)
+
+    if args.sarif_out or args.format == "sarif":
+        doc = merged_sarif(results)
+        if args.sarif_out:
+            Path(args.sarif_out).write_text(json.dumps(doc, indent=2),
+                                            encoding="utf-8")
+        if args.format == "sarif":
+            print(json.dumps(doc, indent=2))
+
+    budget_problems: list[str] = []
+    default_target = args.targets in ([], ["quantum_resistant_p2p_tpu"])
+    if args.update_budget:
+        budget = {name: len(suppressed)
+                  for name, (_f, suppressed, _r) in results.items()}
+        BUDGET_PATH.write_text(json.dumps(budget, indent=2) + "\n",
+                               encoding="utf-8")
+        print(f"qr-analysis: budget re-pinned: {budget}")
+    elif not args.no_budget and default_target:
+        if BUDGET_PATH.is_file():
+            budget = json.loads(BUDGET_PATH.read_text(encoding="utf-8"))
+            budget_problems = check_budget(results, budget)
+        else:
+            # never skip the ratchet silently (e.g. a wheel install that
+            # dropped the json): missing budget is itself a violation
+            budget_problems = [
+                f"budget file missing: {BUDGET_PATH} — re-create it with "
+                "`qr-analysis --update-budget` (or pass --no-budget to "
+                "run without the ratchet)"]
+
+    any_errors = False
+    if args.format == "json":
+        payload = {}
+        for name, (findings, suppressed, _rules) in results.items():
+            payload[name] = {
+                "findings": [f.as_dict() for f in findings],
+                "suppressed": [s.as_dict() for s in suppressed],
+            }
+        payload["budget_violations"] = budget_problems
+        print(json.dumps(payload, indent=2))
+    elif args.format == "human":
+        for name, (findings, suppressed, _rules) in results.items():
+            for f in findings:
+                print(f.format())
+            errs = sum(f.severity == "error" for f in findings)
+            print(f"{name}: {errs} error(s), "
+                  f"{sum(f.severity == 'warning' for f in findings)} warning(s), "
+                  f"{len(suppressed)} suppressed")
+    for name, (findings, _s, _r) in results.items():
+        if any(f.severity == "error" for f in findings):
+            any_errors = True
+    for problem in budget_problems:
+        print(f"qr-analysis: suppression budget violation:\n  {problem}",
+              file=sys.stderr)
+    if budget_problems:
+        any_errors = True
+    return 1 if any_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,11 @@ from typing import Any, Callable, Iterable
 
 SEVERITIES = ("error", "warning")
 
-_SUPPRESS_RE = re.compile(r"#\s*qrlint:\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[\w.,\- ]+)")
+# both comment prefixes share one suppression grammar: `# qrlint: disable=…`
+# (qrlint/qrflow ids) and `# qrkernel: disable=…` (qrkernel ids) — rule ids
+# never collide across the analyzers, so a shared parser is unambiguous
+_SUPPRESS_RE = re.compile(
+    r"#\s*(?:qrlint|qrkernel):\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[\w.,\- ]+)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -273,6 +277,18 @@ class Engine:
     def _run_project(self, project: Project) -> None:
         for rule in self.rules:
             rule.check_project(project)
+
+
+def resolve_target(target: str, prog: str = "qrlint") -> Path:
+    """CLI target resolution shared by every analyzer driver: a path, or a
+    dotted/plain package name relative to cwd."""
+    p = Path(target)
+    if p.exists():
+        return p
+    p = Path(target.replace(".", "/"))
+    if p.exists():
+        return p
+    raise SystemExit(f"{prog}: no such file, directory, or package: {target!r}")
 
 
 # -- shared AST helpers used by the rule packs --------------------------------

@@ -13,19 +13,13 @@ import json
 import sys
 from pathlib import Path
 
-from ..engine import Engine, render_findings
+from ..engine import Engine, render_findings, resolve_target
 from . import flow_rules
 from .sarif import to_sarif
 
 
 def _resolve_target(target: str) -> Path:
-    p = Path(target)
-    if p.exists():
-        return p
-    p = Path(target.replace(".", "/"))
-    if p.exists():
-        return p
-    raise SystemExit(f"qrflow: no such file, directory, or package: {target!r}")
+    return resolve_target(target, "qrflow")
 
 
 def main(argv: list[str] | None = None) -> int:

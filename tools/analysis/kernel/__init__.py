@@ -1,0 +1,44 @@
+"""qrkernel — abstract-interpretation verifier for the JAX/Pallas kernel layer.
+
+The two sibling analyzers stop at the device boundary: qrlint's
+``int32-narrowing`` can only *flag* multiply/shift sites in Pallas tile
+code (PR 1 closed them with hand-written "31-bit bound" suppression
+comments — human claims no tool checks), and qrflow's taint lattice never
+looks inside a jitted program.  qrkernel is the third ratchet: an abstract
+interpreter (pure AST, no jax import — runs on minimal images) over the
+kernel modules with four analyses:
+
+* **value-range / bit-width** (absdom.py + interp.py) — integer interval +
+  known-bits domain propagated through jnp ops, shifts, masks and dtype
+  casts, seeded from byte/modulus facts (``x & 0xFF`` → [0, 255], ML-KEM
+  q=3329, ML-DSA q=8380417) and declared ``# qrkernel: assume`` parameter
+  contracts; proves every flagged multiply/shift fits its dtype and turns
+  wrap-by-design sites (Keccak rotations) into explicit, policed
+  ``# qrkernel: wrapping — why`` annotations instead of disables.
+* **symbolic shape / batch-axis** (shapes.py) — shapes as symbolic product
+  normal forms through reshape/concatenate/matmul/indexing and vmap axis
+  bookkeeping; only provable inconsistencies fire.
+* **Pallas structural** (pallas_checks.py) — grid × BlockSpec divisibility,
+  index-map bounds vs array shape, accumulator-dtype narrowing.
+* **donation / recompile-hazard** (dataflow.py) — reads after a
+  ``donate_argnums`` operand is aliased away; loop-dependent shapes
+  reaching jitted callables (recompile storms).
+
+qrlint's ``int32-narrowing`` rule *defers* to qrkernel's interval results
+in kernel modules (``packs.site_status``), so the old suppression comments
+become machine-checked facts and the live-tree suppression count drops.
+
+Run: ``python -m tools.analysis.kernel.run quantum_resistant_p2p_tpu`` (or
+the ``qrkernel`` console script).  Docs: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+from ..engine import Rule
+
+
+def kernel_rules() -> list[Rule]:
+    """All qrkernel rules, instantiated fresh (rules keep per-run state)."""
+    from .packs import KERNEL_RULES
+
+    return [cls() for cls in KERNEL_RULES]

@@ -1,0 +1,222 @@
+"""Donation-aliasing and recompile-hazard checks (pure AST, no interp).
+
+``kernel-read-after-donate``: an operand handed to a ``donate_argnums``
+position of a jitted callable is *aliased to the output buffer* — XLA may
+overwrite it in place, so any later read of that name sees garbage.  The
+check collects locally-visible donating callables (``f2 = jax.jit(f,
+donate_argnums=(0,))`` or ``@partial(jax.jit, donate_argnums=…)``) and
+flags any load of a donated argument name after the donating call and
+before a rebind, statement-order within the same function.
+
+``kernel-recompile-hazard``: a jitted function called inside a Python loop
+with an argument whose SHAPE depends on the loop state — a loop-bounded
+slice (``x[:i]``) or a constructor (``jnp.zeros(i)``/``arange``/``pad``)
+fed a loop-derived value — compiles a fresh program every iteration: the
+recompile-storm class of perf bug.  Constant shapes in loops are fine and
+stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+@dataclasses.dataclass
+class DfEvent:
+    rule: str
+    node: ast.AST
+    message: str
+
+
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange", "pad", "tile",
+                "repeat", "linspace", "eye"}
+
+
+def _dotted(node) -> str | None:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+    """donate_argnums of a ``jax.jit(...)``/``partial(jax.jit, ...)`` call."""
+    name = _dotted(call.func) or ""
+    inner_jit = name.endswith("jit")
+    if name.split(".")[-1] == "partial" and call.args:
+        inner = _dotted(call.args[0]) or ""
+        inner_jit = inner.endswith("jit")
+    if not inner_jit:
+        return ()
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            out = []
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, int):
+                    out.append(sub.value)
+            return tuple(out)
+    return ()
+
+
+def _jit_decorated(func: ast.FunctionDef) -> bool:
+    for dec in func.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = _dotted(target) or ""
+        inner = ""
+        if isinstance(dec, ast.Call) and dec.args:
+            inner = _dotted(dec.args[0]) or ""
+        if name.endswith("jit") or inner.endswith("jit"):
+            return True
+    return False
+
+
+def analyze_dataflow(tree: ast.Module) -> list[DfEvent]:
+    events: list[DfEvent] = []
+    donating: dict[str, tuple[int, ...]] = {}   # callable name -> positions
+    jitted: set[str] = set()
+
+    for stmt in ast.walk(tree):
+        if isinstance(stmt, ast.FunctionDef):
+            if _jit_decorated(stmt):
+                jitted.add(stmt.name)
+                for dec in stmt.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        pos = _donate_positions(dec)
+                        if pos:
+                            donating[stmt.name] = pos
+        elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            pos = _donate_positions(stmt.value)
+            name = (_dotted(stmt.value.func) or "").split(".")[-1]
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    if pos:
+                        donating[tgt.id] = pos
+                    if name == "jit" or pos:
+                        jitted.add(tgt.id)
+
+    for func in ast.walk(tree):
+        if isinstance(func, ast.FunctionDef):
+            events.extend(_check_read_after_donate(func, donating))
+            events.extend(_check_recompile(func, jitted | set(donating)))
+    return events
+
+
+def _check_read_after_donate(func: ast.FunctionDef,
+                             donating: dict[str, tuple[int, ...]]) -> list[DfEvent]:
+    events: list[DfEvent] = []
+    donated: list[tuple[str, int, str]] = []   # (var, call line, callee)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in donating:
+            for pos in donating[node.func.id]:
+                if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                    donated.append((node.args[pos].id, node.lineno,
+                                    node.func.id))
+    if not donated:
+        return events
+    rebinds: dict[str, list[int]] = {}
+    for node in ast.walk(func):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.For):
+            targets = [node.target]
+        for tgt in targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    rebinds.setdefault(sub.id, []).append(node.lineno)
+    seen: set[tuple[str, int]] = set()
+    for node in ast.walk(func):
+        if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+            continue
+        for var, call_line, callee in donated:
+            if node.id != var or node.lineno <= call_line:
+                continue
+            # a rebind ON the call line is the canonical donation pattern
+            # (`state = step(state, x)`): the store happens after the call
+            rebound = any(call_line <= r <= node.lineno
+                          for r in rebinds.get(var, ()))
+            key = (var, node.lineno)
+            if not rebound and key not in seen:
+                seen.add(key)
+                events.append(DfEvent(
+                    "kernel-read-after-donate", node,
+                    f"{var!r} is read after being donated to {callee}() on "
+                    f"line {call_line}: donate_argnums aliases the operand "
+                    "to the output buffer, so this read sees overwritten "
+                    "memory"))
+    return events
+
+
+def _loop_tainted_names(loop: ast.For) -> set[str]:
+    names = {n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)}
+    grew = True
+    while grew:
+        grew = False
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign):
+                refs = {n.id for n in ast.walk(node.value)
+                        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+                if refs & names:
+                    for tgt in node.targets:
+                        for sub in ast.walk(tgt):
+                            if isinstance(sub, ast.Name) and sub.id not in names:
+                                names.add(sub.id)
+                                grew = True
+    return names
+
+
+def _check_recompile(func: ast.FunctionDef, jitted: set[str]) -> list[DfEvent]:
+    events: list[DfEvent] = []
+    if not jitted:
+        return events
+    for loop in ast.walk(func):
+        if not isinstance(loop, ast.For):
+            continue
+        tainted = _loop_tainted_names(loop)
+        for call in ast.walk(loop):
+            if not (isinstance(call, ast.Call) and isinstance(call.func, ast.Name)
+                    and call.func.id in jitted):
+                continue
+            for arg in call.args:
+                hazard = _shape_depends_on(arg, tainted)
+                if hazard is not None:
+                    events.append(DfEvent(
+                        "kernel-recompile-hazard", call,
+                        f"{call.func.id}() is jitted but called in a loop "
+                        f"with an argument whose shape depends on loop "
+                        f"state ({hazard}): every iteration traces and "
+                        "compiles a fresh program (recompile storm); pad to "
+                        "a fixed shape or lift the call out of the loop"))
+                    break
+    return events
+
+
+def _shape_depends_on(arg: ast.AST, tainted: set[str]) -> str | None:
+    """A description of the loop-dependent shape expression, or None."""
+
+    def refs_tainted(node) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in tainted
+                   for n in ast.walk(node))
+
+    for node in ast.walk(arg):
+        if isinstance(node, ast.Subscript) and isinstance(node.slice, ast.Slice):
+            for bound in (node.slice.lower, node.slice.upper):
+                if bound is not None and refs_tainted(bound):
+                    return "a loop-bounded slice"
+        elif isinstance(node, ast.Call):
+            name = (_dotted(node.func) or "").split(".")[-1]
+            if name in _SHAPE_CTORS:
+                shape_args = list(node.args[:2]) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg in ("shape", "pad_width", "reps", "repeats")]
+                if any(refs_tainted(a) for a in shape_args):
+                    return f"a loop-derived {name}() shape"
+    return None

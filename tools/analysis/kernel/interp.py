@@ -1,0 +1,1514 @@
+"""qrkernel abstract interpreter over the JAX/Pallas kernel modules.
+
+Pure AST + abstract domains (absdom.py) — **no jax import**: the analyzer
+runs on minimal no-jax images, exactly like qrlint/qrflow.  One
+:class:`Interp` is built per run; it loads kernel modules (resolving
+relative imports to sibling files on disk, so ``from ..core.keccak_pallas
+import block_bytes`` summarises across files), evaluates module constants
+(``Q = 3329``, ``BT = _TS * _TL``, ``pow(_N, -1, Q)``), and abstractly
+executes every function of every checked module:
+
+* concrete loops (``range(24)``, concrete-length lists) are unrolled up to
+  :data:`UNROLL_LIMIT` iterations — the same full unroll the real Pallas
+  trace performs; everything else runs to a join fixpoint with widening;
+* calls to project functions use context-insensitive memoized summaries
+  (parameters seeded from ``# qrkernel: assume`` contracts when declared,
+  TOP tiles otherwise), so a summary is sound for every call site;
+* every ``*``/``<<`` whose operands are (derived from) kernel tiles is a
+  **site**: the mathematical interval of the product is recorded and
+  checked against the value's dtype (int32 when unknown — the TPU vreg
+  width).  A site is *proved* when the math provably fits, *wrapping* when
+  the line carries a ``# qrkernel: wrapping — justification`` annotation
+  (Keccak rotations: bits shifted out by design), *unproven* otherwise.
+
+Annotations (both policed for a justification by the rule pack):
+
+``# qrkernel: assume NAME in [LO, HI) — justification``
+    Declares a parameter contract for the enclosing function; LO/HI are
+    expressions over module constants (``[0, Q)``).  The analyzer seeds the
+    parameter from it AND checks every call site whose argument interval is
+    known: an argument provably outside the contract is a
+    ``kernel-contract-violation``.
+
+``# qrkernel: wrapping — justification``
+    Marks the ``*``/``<<`` sites on this line as wrap-by-design.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Any, Callable
+
+from .absdom import (DEFAULT_CHECK_DTYPE, FLOAT_DTYPES, INT_DTYPES, Dim, IVal,
+                     add, bitand, bitor, bitxor, compare, dim_of, floordiv,
+                     invert, join_all, lshift, mod, mul, neg, rshift, sub)
+
+#: concrete loops at or under this trip count are unrolled; larger ones and
+#: symbolic ones run to a join fixpoint instead
+UNROLL_LIMIT = 256
+#: abstract-evaluation steps per function before the analysis of that
+#: function is abandoned (its summary degrades to TOP, its sites to unproven)
+FUNC_BUDGET = 150_000
+#: fixpoint passes before widening kicks in
+FIX_PASSES = 3
+
+_ASSUME_RE = re.compile(
+    r"#\s*qrkernel:\s*assume\s+(?P<name>\w+)\s+in\s+"
+    r"(?P<open>[\[(])\s*(?P<lo>[^,]+?)\s*,\s*(?P<hi>[^\])]+?)\s*(?P<close>[\])])"
+    r"(?P<just>.*)$")
+_WRAPPING_RE = re.compile(r"#\s*qrkernel:\s*wrapping(?P<just>.*)$")
+
+#: function-name suffixes whose parameters are VMEM tiles (qrlint's scoping)
+TILE_FUNC_SUFFIXES = ("_kernel", "_tiles")
+
+
+# -- value classes beyond IVal ------------------------------------------------
+
+
+class LVal:
+    """Abstract list: concrete element vector, or a summarised (elem, len)."""
+
+    __slots__ = ("elems", "elem", "length")
+
+    def __init__(self, elems: list | None = None, elem: Any = None,
+                 length: IVal | None = None):
+        self.elems = elems
+        self.elem = elem
+        self.length = length if length is not None else (
+            IVal.const(len(elems)) if elems is not None else IVal(0, None))
+
+    @property
+    def concrete(self) -> bool:
+        return self.elems is not None
+
+    def join_elem(self) -> Any:
+        """Join of the elements — ``None`` is BOTTOM (an empty list has no
+        elements, so it must be the identity of a join, never TOP: joining
+        the `cand = []` entry state into a loop fixpoint must not destroy
+        the element bounds of everything appended later)."""
+        if self.concrete:
+            if not self.elems:
+                return None
+            out = self.elems[0]
+            for e in self.elems[1:]:
+                out = _join_values(out, e)
+            return out
+        return self.elem
+
+    def summarised(self) -> "LVal":
+        if not self.concrete:
+            return self
+        return LVal(elem=self.join_elem(), length=IVal.const(len(self.elems)))
+
+
+class TVal:
+    __slots__ = ("elems",)
+
+    def __init__(self, elems: tuple):
+        self.elems = tuple(elems)
+
+
+class FuncVal:
+    """A project function (or lambda/closure), optionally with bound args."""
+
+    __slots__ = ("node", "module", "closure", "bound_args", "bound_kwargs",
+                 "jitted", "donate")
+
+    def __init__(self, node, module, closure=None, bound_args=(),
+                 bound_kwargs=None, jitted=False, donate=()):
+        self.node = node
+        self.module = module
+        self.closure = closure
+        self.bound_args = tuple(bound_args)
+        self.bound_kwargs = dict(bound_kwargs or {})
+        self.jitted = jitted
+        self.donate = tuple(donate)
+
+
+class ModRef:
+    __slots__ = ("root",)
+
+    def __init__(self, root: str):
+        self.root = root
+
+
+class BuiltinVal:
+    __slots__ = ("root", "attr")
+
+    def __init__(self, root: str, attr: str):
+        self.root = root
+        self.attr = attr
+
+
+class DtypeVal:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class ConstVal:
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+class SymVal:
+    """A symbolic host int (an unknown array dim) with product algebra."""
+
+    __slots__ = ("dim",)
+
+    def __init__(self, dim: Dim):
+        self.dim = dim
+
+
+class RangeVal:
+    __slots__ = ("start", "stop", "step")
+
+    def __init__(self, start: IVal, stop, step: IVal):
+        self.start = start
+        self.stop = stop  # IVal | SymVal | TOP-ish
+        self.step = step
+
+
+class StructVal:
+    """jax.ShapeDtypeStruct: shape tuple + dtype."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = shape  # tuple[Dim, ...] | None
+        self.dtype = dtype  # str | None
+
+
+class BlockSpecVal:
+    __slots__ = ("block_shape", "index_map")
+
+    def __init__(self, block_shape, index_map):
+        self.block_shape = block_shape  # tuple[Dim, ...] | None
+        self.index_map = index_map      # FuncVal | None
+
+
+class PallasVal:
+    __slots__ = ("kernel", "grid", "in_specs", "out_specs", "out_shape", "node")
+
+    def __init__(self, kernel, grid, in_specs, out_specs, out_shape, node):
+        self.kernel = kernel
+        self.grid = grid
+        self.in_specs = in_specs
+        self.out_specs = out_specs
+        self.out_shape = out_shape
+        self.node = node
+
+
+class VmapVal:
+    __slots__ = ("func", "in_axes", "out_axes", "node")
+
+    def __init__(self, func, in_axes, out_axes, node):
+        self.func = func
+        self.in_axes = in_axes
+        self.out_axes = out_axes
+        self.node = node
+
+
+class ShapeHandle:
+    """``x.shape`` of an array whose rank is unknown: indexing it mints a
+    STABLE symbol per (owner, axis), so ``x.shape[0]`` used twice names the
+    same dim and symbolic reshape consistency checks can still prove
+    coefficient mismatches (``(b, 128) -> (b, 64)``)."""
+
+    __slots__ = ("owner",)
+
+    def __init__(self, owner: str):
+        self.owner = owner
+
+    def dim_at(self, i: int) -> Dim:
+        return Dim.sym(f"{self.owner}.s{i}")
+
+
+TOP = IVal()
+HOST_TOP = IVal()                      # alias for readability: unbounded host int
+TILE_TOP = IVal(tile=True)
+
+
+def _is_top(v) -> bool:
+    return isinstance(v, IVal) and v.lo is None and v.hi is None and not v.dtype
+
+
+# -- environments -------------------------------------------------------------
+
+
+class Env:
+    __slots__ = ("vars", "parent")
+
+    def __init__(self, parent: "Env | None" = None):
+        self.vars: dict[str, Any] = {}
+        self.parent = parent
+
+    def get(self, name: str):
+        env: Env | None = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        return None
+
+    def set(self, name: str, value) -> None:
+        self.vars[name] = value
+
+    def snapshot(self) -> dict[str, Any]:
+        """Deep-enough copy: mutable containers (LVal, TVal-of-LVal) are
+        CLONED, so a later in-place ``append`` cannot silently rewrite the
+        snapshot — fixpoint change detection and branch-state restoration
+        both depend on snapshots being immutable.  A memo preserves
+        aliasing within one snapshot."""
+        memo: dict[int, Any] = {}
+        return {k: _clone_value(v, memo) for k, v in self.vars.items()}
+
+
+def elem_or_top(lv: "LVal"):
+    """An element READ out of a summarised list: bottom (empty) reads as
+    TOP — indexing a possibly-empty list proves nothing."""
+    e = lv.join_elem()
+    return e if e is not None else TOP
+
+
+def _clone_value(v, memo: dict[int, Any] | None = None):
+    if memo is None:
+        memo = {}
+    if isinstance(v, LVal):
+        if id(v) in memo:
+            return memo[id(v)]
+        out = LVal([_clone_value(e, memo) for e in v.elems]) if v.concrete \
+            else LVal(elem=v.elem, length=v.length)
+        memo[id(v)] = out
+        return out
+    if isinstance(v, TVal):
+        if id(v) in memo:
+            return memo[id(v)]
+        out = TVal(tuple(_clone_value(e, memo) for e in v.elems))
+        memo[id(v)] = out
+        return out
+    return v  # IVal & friends are immutable
+
+
+def _join_values(a, b):
+    if a is b:
+        return a
+    if isinstance(a, IVal) and isinstance(b, IVal):
+        return a.join(b)
+    if isinstance(a, LVal) and isinstance(b, LVal):
+        if a.concrete and b.concrete and len(a.elems) == len(b.elems):
+            return LVal([_join_values(x, y) for x, y in zip(a.elems, b.elems)])
+        ea, eb = a.join_elem(), b.join_elem()
+        elem = eb if ea is None else ea if eb is None else _join_values(ea, eb)
+        return LVal(elem=elem, length=a.length.join(b.length))
+    if isinstance(a, TVal) and isinstance(b, TVal) and len(a.elems) == len(b.elems):
+        return TVal(tuple(_join_values(x, y) for x, y in zip(a.elems, b.elems)))
+    if isinstance(a, ConstVal) and isinstance(b, ConstVal) and a.value == b.value:
+        return a
+    if isinstance(a, (FuncVal, DtypeVal, ModRef, BuiltinVal)) and a is b:
+        return a
+    tile = getattr(a, "tile", False) or getattr(b, "tile", False)
+    return IVal(tile=tile)
+
+
+def _same_value(a, b) -> bool:
+    if a is b:
+        return True
+    if isinstance(a, IVal) and isinstance(b, IVal):
+        return a == b
+    if isinstance(a, LVal) and isinstance(b, LVal):
+        if a.concrete and b.concrete and len(a.elems) == len(b.elems):
+            return all(_same_value(x, y) for x, y in zip(a.elems, b.elems))
+        if not a.concrete and not b.concrete:
+            return _same_value(a.join_elem(), b.join_elem()) and a.length == b.length
+        return False
+    if isinstance(a, TVal) and isinstance(b, TVal) and len(a.elems) == len(b.elems):
+        return all(_same_value(x, y) for x, y in zip(a.elems, b.elems))
+    return False
+
+
+# -- module model -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Assume:
+    func: str
+    param: str
+    lo: int | None
+    hi: int | None
+    lineno: int
+    justified: bool
+    text: str
+
+
+class Module:
+    """Parsed kernel module: constants, functions, imports, annotations."""
+
+    def __init__(self, path: str, source: str, loader: "Loader"):
+        self.path = path
+        self.source = source
+        self.loader = loader
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.funcs: dict[str, ast.FunctionDef] = {}
+        self.imports: dict[str, tuple[str, str]] = {}   # name -> (filepath, orig)
+        self.roots: dict[str, str] = {}                 # alias -> builtin root
+        self.env = Env()
+        self.assumes: dict[str, dict[str, Assume]] = {}  # funcname -> param -> Assume
+        self.assume_list: list[Assume] = []
+        self.wrapping: dict[int, tuple[bool, str]] = {}  # lineno -> (justified, text)
+        self._scope: set[str] | None = None
+        self._collect()
+        self._parse_annotations()
+
+    # -- construction -------------------------------------------------------
+
+    _ROOT_ALIASES = {
+        "jax.numpy": "jnp", "numpy": "np", "jax": "jax", "jax.lax": "lax",
+        "jax.experimental.pallas": "pl", "functools": "functools",
+        "math": "math", "jax.experimental": "jax.experimental",
+    }
+
+    def _collect(self) -> None:
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.FunctionDef):
+                self.funcs[stmt.name] = stmt
+                self.env.set(stmt.name, FuncVal(stmt, self))
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        self.funcs[f"{stmt.name}.{sub.name}"] = sub
+            elif isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    root = self._ROOT_ALIASES.get(alias.name)
+                    if root:
+                        self.roots[name] = root
+            elif isinstance(stmt, ast.ImportFrom):
+                self._import_from(stmt)
+        # module constants: evaluated AFTER functions/imports are visible
+        interp = self.loader.interp
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)) and interp is not None:
+                try:
+                    interp.exec_stmt(stmt, self.env, self, Frame())
+                except _Budget:
+                    pass
+
+    def _import_from(self, stmt: ast.ImportFrom) -> None:
+        modname = stmt.module or ""
+        full = self._ROOT_ALIASES.get(modname)
+        if full:
+            for alias in stmt.names:
+                name = alias.asname or alias.name
+                # `from jax.experimental import pallas as pl`
+                sub = self._ROOT_ALIASES.get(f"{modname}.{alias.name}")
+                self.roots[name] = sub or full
+            return
+        target = self.loader.resolve(self.path, modname, stmt.level)
+        if target is None:
+            return
+        for alias in stmt.names:
+            name = alias.asname or alias.name
+            self.imports[name] = (target, alias.name)
+
+    def _parse_annotations(self) -> None:
+        spans = [(f, f.lineno, f.end_lineno or f.lineno)
+                 for f in ast.walk(self.tree) if isinstance(f, ast.FunctionDef)]
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _WRAPPING_RE.search(line)
+            if m:
+                just = m.group("just") or ""
+                self.wrapping[lineno] = (bool(re.search(r"\w", just)), line.strip())
+            m = _ASSUME_RE.search(line)
+            if not m:
+                continue
+            func = None
+            best = None
+            for f, start, end in spans:
+                if start <= lineno <= end and (best is None or end - start < best):
+                    func, best = f, end - start
+            if func is None:
+                continue
+            lo = self._eval_bound(m.group("lo"))
+            hi = self._eval_bound(m.group("hi"))
+            if hi is not None and m.group("close") == ")":
+                hi -= 1
+            just = m.group("just") or ""
+            assume = Assume(func.name, m.group("name"), lo, hi, lineno,
+                            bool(re.search(r"\w", just)), line.strip())
+            self.assumes.setdefault(func.name, {})[assume.param] = assume
+            self.assume_list.append(assume)
+
+    def _eval_bound(self, text: str) -> int | None:
+        try:
+            expr = ast.parse(text.strip(), mode="eval").body
+        except SyntaxError:
+            return None
+        interp = self.loader.interp
+        if interp is None:
+            return None
+        try:
+            v = interp.eval(expr, self.env, self)
+        except _Budget:
+            return None
+        if isinstance(v, IVal) and v.is_const:
+            return v.lo
+        return None
+
+    # -- scope: tile functions + their transitively-called local helpers ----
+
+    def scope_funcs(self) -> set[str]:
+        if self._scope is not None:
+            return self._scope
+        tile = {n for n, f in self.funcs.items()
+                if f.name.endswith(TILE_FUNC_SUFFIXES)}
+        grew = True
+        while grew:
+            grew = False
+            called: set[str] = set()
+            for name in tile:
+                for call in ast.walk(self.funcs[name]):
+                    if isinstance(call, ast.Call) and isinstance(call.func, ast.Name):
+                        called.add(call.func.id)
+            for name in called:
+                if name in self.funcs and name not in tile:
+                    tile.add(name)
+                    grew = True
+        self._scope = tile
+        return tile
+
+
+class Loader:
+    """Loads/caches kernel modules; resolves relative imports to files."""
+
+    def __init__(self):
+        self.modules: dict[str, Module] = {}
+        self.interp: "Interp | None" = None
+
+    def get(self, path: str, source: str | None = None) -> Module | None:
+        key = str(Path(path))
+        if key in self.modules:
+            return self.modules[key]
+        if source is None:
+            p = Path(path)
+            if not p.is_file():
+                return None
+            try:
+                source = p.read_text(encoding="utf-8")
+            except (OSError, UnicodeDecodeError):
+                return None
+        try:
+            mod = Module(key, source, self)
+        except SyntaxError:
+            return None
+        self.modules[key] = mod
+        return mod
+
+    def resolve(self, from_path: str, modname: str, level: int) -> str | None:
+        if level == 0:
+            return None  # absolute project imports: not needed by kernel code
+        base = Path(from_path).parent
+        for _ in range(level - 1):
+            base = base.parent
+        parts = modname.split(".") if modname else []
+        cand = base.joinpath(*parts)
+        for p in (cand.with_suffix(".py"), cand / "__init__.py"):
+            if p.is_file():
+                return str(p)
+        return None
+
+
+# -- interpreter --------------------------------------------------------------
+
+
+class _Budget(Exception):
+    pass
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+
+
+@dataclasses.dataclass
+class Site:
+    lineno: int
+    op: str
+    proved: bool = True
+    wrapping: bool = False
+    bound: int | None = None
+    detail: str = ""
+
+    def absorb(self, math: IVal, ok: bool | None, op: str) -> None:
+        self.op = op
+        if ok is not True:
+            self.proved = False
+        hi = math.effective_hi()
+        if hi is not None:
+            self.bound = hi if self.bound is None else max(self.bound, hi)
+        elif ok is not True:
+            self.bound = None
+
+
+@dataclasses.dataclass
+class Event:
+    rule: str
+    path: str
+    node: ast.AST
+    message: str
+
+
+class Frame:
+    __slots__ = ("ret", "returned", "store_hook")
+
+    def __init__(self, store_hook: Callable | None = None):
+        self.ret = None
+        self.returned = False
+        self.store_hook = store_hook
+
+    def add_return(self, value) -> None:
+        self.ret = value if self.ret is None else _join_values(self.ret, value)
+
+
+class Interp:
+    """One abstract-interpretation run over a set of kernel modules."""
+
+    def __init__(self, loader: Loader | None = None):
+        self.loader = loader or Loader()
+        self.loader.interp = self
+        self.summaries: dict[tuple[str, int], Any] = {}
+        self.in_progress: set[tuple[str, int]] = set()
+        self.sites: dict[tuple[str, int], Site] = {}
+        self.events: list[Event] = []
+        self.steps = 0
+        self.limit = 0
+        #: (module path, function) currently being analysed, for site scoping
+        self._stack: list[tuple[Module, str, bool]] = []
+        self.check_paths: set[str] = set()
+        #: set when a break/continue fires under an ABSTRACT condition: the
+        #: innermost loop consumes it (save/reset/restore discipline) and
+        #: falls back from exact unrolling to the join fixpoint
+        self._loop_escape = False
+        #: joined env snapshots taken AT those conditional exit points —
+        #: the innermost loop joins them into its post-loop state, so a
+        #: bound assigned right before a `break` survives even though the
+        #: rest of the body (which may re-narrow it) never runs on that path
+        self._escape_env: dict[str, Any] | None = None
+
+    # -- public entry points ------------------------------------------------
+
+    def analyze_module(self, path: str, source: str | None = None) -> Module | None:
+        mod = self.loader.get(path, source)
+        if mod is None:
+            return None
+        self.check_paths.add(mod.path)
+        for name, func in list(mod.funcs.items()):
+            self.summary(FuncVal(func, mod))
+        return mod
+
+    # -- summaries ----------------------------------------------------------
+
+    def summary(self, fv: FuncVal):
+        """Context-insensitive summary: analyse once with contract/TOP seeds."""
+        key = (fv.module.path, id(fv.node))
+        if key in self.summaries:
+            return self.summaries[key]
+        if key in self.in_progress:
+            return TILE_TOP
+        self.in_progress.add(key)
+        saved_steps, saved_limit = self.steps, self.limit
+        self.steps, self.limit = 0, FUNC_BUDGET
+        saved_sites = dict(self.sites)
+        saved_events = list(self.events)
+        try:
+            result = self._run_function(fv)
+        except _Budget:
+            # partial analysis could claim unsound proofs: demote every site
+            # this pass touched, drop its events
+            for k, site in self.sites.items():
+                if k not in saved_sites or saved_sites[k] is not site:
+                    site.proved = False
+                    site.detail = "analysis budget exhausted"
+            del self.events[len(saved_events):]
+            result = TILE_TOP
+        finally:
+            self.in_progress.discard(key)
+            self.steps, self.limit = saved_steps, saved_limit
+        self.summaries[key] = result
+        return result
+
+    def _run_function(self, fv: FuncVal, args: tuple = (), kwargs=None,
+                      store_hook: Callable | None = None):
+        func = fv.node
+        mod = fv.module
+        env = Env(fv.closure if fv.closure is not None else mod.env)
+        assumes = mod.assumes.get(getattr(func, "name", ""), {})
+        params = self._params(func)
+        bound = list(fv.bound_args) + list(args)
+        kwargs = {**fv.bound_kwargs, **(kwargs or {})}
+        for i, p in enumerate(params):
+            if i < len(bound):
+                val = bound[i]
+            elif p.arg in kwargs:
+                val = kwargs[p.arg]
+            else:
+                val = self._seed_param(p, assumes.get(p.arg))
+            env.set(p.arg, val)
+        in_scope = (getattr(func, "name", "").endswith(TILE_FUNC_SUFFIXES)
+                    or getattr(func, "name", "") in mod.scope_funcs())
+        self._stack.append((mod, getattr(func, "name", "<lambda>"), in_scope))
+        frame = Frame(store_hook)
+        try:
+            if isinstance(func, ast.Lambda):
+                frame.add_return(self.eval(func.body, env, mod))
+            else:
+                self.exec_block(func.body, env, mod, frame)
+        except (_Break, _Continue):
+            pass  # malformed top-level exit: never escape a function frame
+        finally:
+            self._stack.pop()
+        return frame.ret if frame.ret is not None else ConstVal(None)
+
+    @staticmethod
+    def _params(func) -> list[ast.arg]:
+        a = func.args
+        return [*a.posonlyargs, *a.args, *a.kwonlyargs]
+
+    def _seed_param(self, p: ast.arg, assume: Assume | None):
+        if assume is not None:
+            return IVal.range(assume.lo, assume.hi, None, tile=True)
+        ann = p.annotation
+        if isinstance(ann, ast.Name) and ann.id in ("int", "bool", "float", "str"):
+            return HOST_TOP  # host scalar by annotation (qrlint's exemption)
+        return TILE_TOP
+
+    # -- statements ---------------------------------------------------------
+
+    def _tick(self) -> None:
+        self.steps += 1
+        if self.limit and self.steps > self.limit:
+            raise _Budget()
+
+    def exec_block(self, stmts, env: Env, mod: Module, frame: Frame) -> None:
+        for stmt in stmts:
+            if frame.returned:
+                return
+            self.exec_stmt(stmt, env, mod, frame)
+
+    def exec_stmt(self, stmt, env: Env, mod: Module, frame: Frame) -> None:
+        self._tick()
+        if isinstance(stmt, ast.Assign):
+            value = self.eval(stmt.value, env, mod)
+            for tgt in stmt.targets:
+                self.assign(tgt, value, env, mod, frame)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.assign(stmt.target, self.eval(stmt.value, env, mod), env,
+                            mod, frame)
+        elif isinstance(stmt, ast.AugAssign):
+            cur = self.eval(stmt.target, env, mod)
+            rhs = self.eval(stmt.value, env, mod)
+            value = self._binop(stmt.op, cur, rhs, stmt, env, mod)
+            self.assign(stmt.target, value, env, mod, frame)
+        elif isinstance(stmt, ast.Return):
+            frame.add_return(self.eval(stmt.value, env, mod)
+                             if stmt.value is not None else ConstVal(None))
+            frame.returned = True
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env, mod)
+        elif isinstance(stmt, ast.If):
+            self._exec_if(stmt, env, mod, frame)
+        elif isinstance(stmt, ast.For):
+            self._exec_for(stmt, env, mod, frame)
+        elif isinstance(stmt, ast.While):
+            self._exec_while(stmt, env, mod, frame)
+        elif isinstance(stmt, ast.FunctionDef):
+            env.set(stmt.name, FuncVal(stmt, mod, closure=env))
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                val = self.eval(item.context_expr, env, mod)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, val, env, mod, frame)
+            self.exec_block(stmt.body, env, mod, frame)
+        elif isinstance(stmt, ast.Try):
+            before = env.snapshot()
+            self.exec_block(stmt.body, env, mod, frame)
+            body_vars = env.snapshot()
+            for handler in stmt.handlers:
+                env.vars.update(before)
+                self.exec_block(handler.body, env, mod, Frame())
+                for k, v in env.snapshot().items():
+                    if k in body_vars:
+                        body_vars[k] = _join_values(body_vars[k], v)
+            env.vars.update(body_vars)
+            self.exec_block(stmt.finalbody, env, mod, frame)
+        elif isinstance(stmt, ast.Raise):
+            frame.returned = True
+        elif isinstance(stmt, ast.Break):
+            raise _Break()
+        elif isinstance(stmt, ast.Continue):
+            raise _Continue()
+        elif isinstance(stmt, (ast.Assert, ast.Pass, ast.Import,
+                               ast.ImportFrom, ast.Global, ast.Nonlocal,
+                               ast.Delete, ast.ClassDef)):
+            pass  # no abstract effect (asserts could refine; stay sound)
+
+    # -- control flow -------------------------------------------------------
+
+    def _exec_if(self, stmt: ast.If, env: Env, mod: Module, frame: Frame) -> None:
+        test = self.eval(stmt.test, env, mod)
+        if isinstance(test, IVal) and test.is_const:
+            branch = stmt.body if test.lo else stmt.orelse
+            self.exec_block(branch, env, mod, frame)
+            return
+        before = env.snapshot()
+        then_frame = Frame(frame.store_hook)
+        try:
+            self.exec_block(stmt.body, env, mod, then_frame)
+        except (_Break, _Continue):
+            # a CONDITIONAL loop exit: signal the innermost loop (its exact
+            # unroll is no longer exact), stash the state AT the exit point
+            # (it joins the loop's post-state — the rest of the body never
+            # runs on this path and may re-narrow what it assigned), and
+            # end the branch for the merge below
+            self._note_escape(env)
+            then_frame.returned = True
+        then_vars, then_returned = env.snapshot(), then_frame.returned
+        env.vars.clear()
+        env.vars.update(before)
+        else_frame = Frame(frame.store_hook)
+        try:
+            self.exec_block(stmt.orelse, env, mod, else_frame)
+        except (_Break, _Continue):
+            self._note_escape(env)
+            else_frame.returned = True
+        if then_frame.ret is not None:
+            frame.add_return(then_frame.ret)
+        if else_frame.ret is not None:
+            frame.add_return(else_frame.ret)
+        if then_returned and else_frame.returned:
+            frame.returned = True
+            return
+        if then_returned:       # only the else-path continues
+            return
+        if else_frame.returned:  # only the then-path continues
+            env.vars.clear()
+            env.vars.update(then_vars)
+            return
+        merged = dict(env.vars)
+        for k, v in then_vars.items():
+            merged[k] = _join_values(merged[k], v) if k in merged else v
+        env.vars.clear()
+        env.vars.update(merged)
+
+    def _note_escape(self, env: Env) -> None:
+        self._loop_escape = True
+        snap = env.snapshot()
+        if self._escape_env is None:
+            self._escape_env = snap
+        else:
+            merged = dict(snap)
+            for k, v in self._escape_env.items():
+                merged[k] = _join_values(merged[k], v) if k in merged else v
+            self._escape_env = merged
+
+    def _push_loop_scope(self):
+        saved = (self._loop_escape, self._escape_env)
+        self._loop_escape, self._escape_env = False, None
+        return saved
+
+    def _pop_loop_scope(self, saved, env: Env) -> None:
+        """Join this loop's conditional-exit states into its post-state,
+        then restore the enclosing loop's escape bookkeeping."""
+        if self._escape_env:
+            for k, v in self._escape_env.items():
+                env.vars[k] = _join_values(env.vars[k], v) \
+                    if k in env.vars else v
+        self._loop_escape, self._escape_env = saved
+
+    def _iter_values(self, iterable) -> tuple[str, Any]:
+        """('concrete', [values]) when unrollable, else ('abstract', elem)."""
+        if isinstance(iterable, RangeVal):
+            s, st = iterable.start, iterable.step
+            stop = iterable.stop
+            if (isinstance(stop, IVal) and s.is_const and stop.is_const
+                    and st.is_const and st.lo):
+                vals = [IVal.const(v) for v in range(s.lo, stop.lo, st.lo)]
+                if len(vals) <= UNROLL_LIMIT:
+                    return "concrete", vals
+            # abstract range: the loop variable's bounds depend on the STEP
+            # SIGN, and an unknown start/stop side stays unbounded (it is
+            # NOT 0 — `range(n, 0, -1)` counts DOWN from n)
+            stop_iv = stop if isinstance(stop, IVal) else (
+                IVal(0, None) if isinstance(stop, SymVal) else TOP)
+            if st.is_const and st.lo is not None and st.lo > 0:
+                lo = s.lo
+                hi = stop_iv.hi - 1 if stop_iv.hi is not None else None
+            elif st.is_const and st.lo is not None and st.lo < 0:
+                lo = stop_iv.lo + 1 if stop_iv.lo is not None else None
+                hi = s.hi
+            else:  # unknown step sign: the hull of both directions
+                lo = None if s.lo is None or stop_iv.lo is None else \
+                    min(s.lo, stop_iv.lo + 1)
+                hi = None if s.hi is None or stop_iv.hi is None else \
+                    max(s.hi, stop_iv.hi - 1)
+            if lo is not None and hi is not None and lo > hi:
+                lo, hi = hi, lo  # degenerate/empty range: keep a valid hull
+            return "abstract", IVal.range(lo, hi)
+        if isinstance(iterable, LVal):
+            if iterable.concrete and len(iterable.elems) <= UNROLL_LIMIT:
+                return "concrete", list(iterable.elems)
+            return "abstract", elem_or_top(iterable)
+        if isinstance(iterable, TVal):
+            if len(iterable.elems) <= UNROLL_LIMIT:
+                return "concrete", list(iterable.elems)
+            return "abstract", _join_values(iterable.elems[0], iterable.elems[-1])
+        if isinstance(iterable, IVal):
+            return "abstract", IVal(tile=iterable.tile)  # array iteration
+        return "abstract", TOP
+
+    def _exec_for(self, stmt: ast.For, env: Env, mod: Module, frame: Frame) -> None:
+        mode, data = self._iter_values(self.eval(stmt.iter, env, mod))
+        saved = self._push_loop_scope()
+        try:
+            if mode == "concrete":
+                escaped = False
+                for item in data:
+                    self.assign(stmt.target, item, env, mod, frame)
+                    try:
+                        self.exec_block(stmt.body, env, mod, frame)
+                    except _Continue:
+                        continue
+                    except _Break:
+                        return
+                    if frame.returned:
+                        return
+                    if self._loop_escape:
+                        # a break/continue under an abstract condition: the
+                        # unroll is no longer exact — re-run as a join
+                        # fixpoint over the element join (the partial
+                        # unroll's effects are already in env; joining more
+                        # only widens, which is sound)
+                        escaped = True
+                        break
+                if not escaped:
+                    self.exec_block(stmt.orelse, env, mod, frame)
+                    return
+                elem = data[0] if data else TOP
+                for item in data[1:]:
+                    elem = _join_values(elem, item)
+                data = elem
+            self._fixpoint_loop(stmt.body, env, mod, frame,
+                                bind=lambda: self.assign(stmt.target, data,
+                                                         env, mod, frame))
+            self.exec_block(stmt.orelse, env, mod, frame)
+        finally:
+            self._pop_loop_scope(saved, env)
+
+    def _exec_while(self, stmt: ast.While, env: Env, mod: Module, frame: Frame) -> None:
+        saved = self._push_loop_scope()
+        try:
+            for _ in range(UNROLL_LIMIT * 8):
+                test = self.eval(stmt.test, env, mod)
+                if not (isinstance(test, IVal) and test.is_const):
+                    break
+                if not test.lo:
+                    return
+                try:
+                    self.exec_block(stmt.body, env, mod, frame)
+                except _Continue:
+                    continue
+                except _Break:
+                    return
+                if frame.returned:
+                    return
+                if self._loop_escape:
+                    break  # conditional exit: fall through to the fixpoint
+            self._fixpoint_loop(stmt.body, env, mod, frame)
+        finally:
+            self._pop_loop_scope(saved, env)
+
+    def _fixpoint_loop(self, body, env: Env, mod: Module, frame: Frame,
+                       bind: Callable | None = None) -> None:
+        entry = env.snapshot()
+        saved = self._push_loop_scope()
+        for i in range(FIX_PASSES + 1):
+            before = env.snapshot()
+            if bind is not None:
+                bind()
+            try:
+                self.exec_block(body, env, mod, frame)
+            except (_Break, _Continue):
+                pass  # fixpoint state is a join: any exit path is covered
+            if frame.returned:
+                frame.returned = False  # loop may also not take that path
+            changed = []
+            for k, v in env.snapshot().items():
+                if k not in before or not _same_value(before[k], v):
+                    changed.append(k)
+                    if k in before:
+                        env.vars[k] = _join_values(before[k], v)
+            if not changed:
+                break
+            if i >= FIX_PASSES:  # widen: still-changing names go to TOP
+                for k in changed:
+                    v = env.vars[k]
+                    tile = getattr(v, "tile", True)
+                    if isinstance(v, LVal):
+                        # the ELEMENT must widen too: a list whose element
+                        # bound kept growing would otherwise retain its
+                        # last (too-narrow) pass's bound
+                        e = v.join_elem()
+                        etile = getattr(e, "tile", True) if e is not None else True
+                        env.vars[k] = LVal(elem=IVal(tile=bool(etile)),
+                                           length=IVal(0, None))
+                    else:
+                        env.vars[k] = IVal(tile=bool(tile))
+                # one more pass so every recorded site OBSERVES the widened
+                # state — otherwise a site could keep a stale "proved" bound
+                # from the narrow early iterations
+                if bind is not None:
+                    bind()
+                try:
+                    self.exec_block(body, env, mod, frame)
+                except (_Break, _Continue):
+                    pass
+                frame.returned = False
+                break
+        self._pop_loop_scope(saved, env)
+        # the loop body may run zero times: join with the entry state
+        for k, v in entry.items():
+            if k in env.vars:
+                env.vars[k] = _join_values(env.vars[k], v)
+
+    # -- assignment ---------------------------------------------------------
+
+    def assign(self, target, value, env: Env, mod: Module, frame: Frame) -> None:
+        if isinstance(target, ast.Name):
+            env.set(target.id, value)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, value, env, mod, frame)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elems = None
+            if isinstance(value, TVal):
+                elems = list(value.elems)
+            elif isinstance(value, LVal) and value.concrete:
+                elems = list(value.elems)
+            if elems is not None and len(elems) == len(target.elts) and not any(
+                    isinstance(e, ast.Starred) for e in target.elts):
+                for t, v in zip(target.elts, elems):
+                    self.assign(t, v, env, mod, frame)
+            else:
+                joined = (elem_or_top(value) if isinstance(value, LVal)
+                          else _join_values(value, value) if isinstance(value, TVal)
+                          else TOP)
+                if isinstance(value, TVal):
+                    joined = join_all([e for e in value.elems
+                                       if isinstance(e, IVal)]) \
+                        if all(isinstance(e, IVal) for e in value.elems) else TOP
+                for t in target.elts:
+                    self.assign(t, joined, env, mod, frame)
+        elif isinstance(target, ast.Subscript):
+            self._store_subscript(target, value, env, mod, frame)
+        # attribute stores: no abstract effect
+
+    def _store_subscript(self, target: ast.Subscript, value, env: Env,
+                         mod: Module, frame: Frame) -> None:
+        container = self.eval(target.value, env, mod)
+        if frame.store_hook is not None and isinstance(target.value, ast.Name):
+            frame.store_hook(target.value.id, value, target)
+        idx = self.eval(target.slice, env, mod)
+        if isinstance(container, LVal):
+            if (container.concrete and isinstance(idx, IVal) and idx.is_const
+                    and -len(container.elems) <= idx.lo < len(container.elems)):
+                container.elems[idx.lo] = value  # strong update
+            elif container.concrete:
+                for i in range(len(container.elems)):  # weak update
+                    container.elems[i] = _join_values(container.elems[i], value)
+            else:
+                cur = container.join_elem()
+                container.elem = value if cur is None else _join_values(cur, value)
+        # array stores (in_ref[i] = v) carry no further abstract effect
+
+    # -- expressions --------------------------------------------------------
+
+    def eval(self, node, env: Env, mod: Module):
+        self._tick()
+        method = getattr(self, f"_eval_{type(node).__name__}", None)
+        if method is not None:
+            return method(node, env, mod)
+        return TOP
+
+    def _eval_Constant(self, node, env, mod):
+        v = node.value
+        if isinstance(v, bool):
+            return IVal.const(int(v), "bool")
+        if isinstance(v, int):
+            return IVal.const(v)
+        return ConstVal(v)
+
+    def _eval_Name(self, node, env, mod):
+        name = node.id
+        found = env.get(name)
+        if found is not None:
+            return found
+        if name in mod.roots:
+            return ModRef(mod.roots[name])
+        if name in mod.imports:
+            path, orig = mod.imports[name]
+            other = self.loader.get(path)
+            if other is not None:
+                hit = other.env.get(orig)
+                if hit is not None:
+                    return hit
+                if orig in other.funcs:
+                    return FuncVal(other.funcs[orig], other)
+            return TOP
+        if name in _BUILTINS:
+            return BuiltinVal("builtins", name)
+        return TOP
+
+    def _eval_Attribute(self, node, env, mod):
+        base = self.eval(node.value, env, mod)
+        attr = node.attr
+        if isinstance(base, ModRef):
+            sub = Module._ROOT_ALIASES.get(f"{_ROOT_CANON.get(base.root, base.root)}.{attr}")
+            if sub:
+                return ModRef(sub)
+            if base.root in ("jnp", "np") and attr in _DTYPE_NAMES:
+                return DtypeVal(attr)
+            if base.root == "jax" and attr == "numpy":
+                return ModRef("jnp")
+            if base.root == "jax" and attr == "lax":
+                return ModRef("lax")
+            return BuiltinVal(base.root, attr)
+        if isinstance(base, IVal):
+            if attr == "shape":
+                if base.shape is not None:
+                    return TVal(tuple(_dim_value(d) for d in base.shape))
+                if isinstance(node.value, ast.Name):
+                    fname = self._stack[-1][1] if self._stack else "?"
+                    return ShapeHandle(f"{fname}:{node.value.id}")
+                return TOP
+            if attr == "ndim":
+                return IVal.const(len(base.shape)) if base.shape is not None else HOST_TOP
+            if attr == "dtype":
+                return DtypeVal(base.dtype) if base.dtype else TOP
+            if attr == "T":
+                shp = tuple(reversed(base.shape)) if base.shape is not None else None
+                return dataclasses.replace(base, shape=shp)
+            return BoundMethod(base, attr)
+        if isinstance(base, StructVal):
+            if attr == "shape":
+                return TVal(tuple(_dim_value(d) for d in base.shape)) \
+                    if base.shape is not None else TOP
+            if attr == "dtype":
+                return DtypeVal(base.dtype) if base.dtype else TOP
+        if isinstance(base, LVal):
+            return BoundMethod(base, attr)
+        return TOP
+
+    def _eval_BinOp(self, node, env, mod):
+        a = self.eval(node.left, env, mod)
+        b = self.eval(node.right, env, mod)
+        return self._binop(node.op, a, b, node, env, mod)
+
+    def _binop(self, op, a, b, node, env: Env, mod: Module):
+        # sequence repetition / concatenation
+        if isinstance(op, ast.Mult):
+            for seq, n in ((a, b), (b, a)):
+                if isinstance(seq, (LVal, TVal)) and isinstance(n, IVal) and n.is_const:
+                    if isinstance(seq, TVal):
+                        seq = LVal(list(seq.elems))
+                    if seq.concrete and 0 <= n.lo * len(seq.elems) <= 4096:
+                        return LVal(list(seq.elems) * n.lo)
+                    return seq.summarised()
+        if isinstance(op, ast.Add):
+            if isinstance(a, LVal) and isinstance(b, LVal):
+                if a.concrete and b.concrete and len(a.elems) + len(b.elems) <= 4096:
+                    return LVal(list(a.elems) + list(b.elems))
+                return LVal(elem=_join_values(a.join_elem(), b.join_elem()),
+                            length=add(a.length, b.length))
+            if isinstance(a, TVal) and isinstance(b, TVal):
+                return TVal(a.elems + b.elems)
+        if isinstance(a, SymVal) or isinstance(b, SymVal):
+            return self._sym_binop(op, a, b)
+        if not isinstance(a, IVal) or not isinstance(b, IVal):
+            tile = getattr(a, "tile", False) or getattr(b, "tile", False)
+            return IVal(tile=tile)
+        fn = _TRANSFER.get(type(op))
+        if fn is None:
+            return IVal(tile=a.tile or b.tile)
+        math = fn(a, b)
+        dtype = self._result_dtype(a, b)
+        float_op = any(d in FLOAT_DTYPES for d in (dtype, a.dtype, b.dtype))
+        if isinstance(op, (ast.Mult, ast.LShift)) and (a.tile or b.tile) \
+                and not float_op:  # float math rounds, it does not wrap
+            self._record_site(node, math, dtype,
+                              "*" if isinstance(op, ast.Mult) else "<<")
+        ok = math.fits(dtype)
+        if ok is True:
+            return dataclasses.replace(math, dtype=dtype)
+        if dtype in INT_DTYPES:
+            return IVal.top(dtype, tile=math.tile)
+        return IVal(tile=math.tile)  # unknown dtype, unproven bound
+
+    @staticmethod
+    def _result_dtype(a: IVal, b: IVal) -> str | None:
+        if a.dtype and b.dtype:
+            return a.dtype if a.dtype == b.dtype else None
+        if a.dtype and b.dtype is None and not b.tile:
+            return a.dtype  # array op host scalar keeps the array dtype
+        if b.dtype and a.dtype is None and not a.tile:
+            return b.dtype
+        return None
+
+    def _sym_binop(self, op, a, b):
+        da = a.dim if isinstance(a, SymVal) else (
+            Dim.const(a.lo) if isinstance(a, IVal) and a.is_const else None)
+        db = b.dim if isinstance(b, SymVal) else (
+            Dim.const(b.lo) if isinstance(b, IVal) and b.is_const else None)
+        if da is not None and db is not None:
+            if isinstance(op, ast.Mult):
+                return SymVal(da * db)
+            if isinstance(op, ast.FloorDiv) and db.is_const and db.coeff > 0:
+                return SymVal(da.floordiv(db.coeff))
+        if isinstance(a, SymVal) or isinstance(b, SymVal):
+            return IVal(0, None)  # dims are non-negative host ints
+        return HOST_TOP
+
+    def _record_site(self, node, math: IVal, dtype: str | None, op: str) -> None:
+        if not self._stack:
+            return
+        mod, _fname, in_scope = self._stack[-1]
+        if not in_scope or mod.path not in self.check_paths:
+            return
+        lineno = getattr(node, "lineno", 0)
+        site = self.sites.setdefault((mod.path, lineno), Site(lineno, op))
+        if lineno in mod.wrapping:
+            site.wrapping = True
+        site.absorb(math, math.fits(dtype), op)
+        if not site.proved and not site.detail:
+            site.detail = f"dtype {dtype or DEFAULT_CHECK_DTYPE}"
+
+    def _eval_UnaryOp(self, node, env, mod):
+        v = self.eval(node.operand, env, mod)
+        if not isinstance(v, IVal):
+            return TOP
+        if isinstance(node.op, ast.USub):
+            return neg(v)
+        if isinstance(node.op, ast.Invert):
+            out = invert(v)
+            if v.dtype in INT_DTYPES:
+                return out.wrapped(v.dtype)
+            return out if out.fits(None) is True else IVal(tile=v.tile)
+        if isinstance(node.op, ast.Not):
+            if v.is_const:
+                return IVal.const(0 if v.lo else 1, "bool")
+            return IVal.range(0, 1, "bool", v.tile)
+        if isinstance(node.op, ast.UAdd):
+            return v
+        return TOP
+
+    def _eval_BoolOp(self, node, env, mod):
+        # `a and b` / `a or b` return an OPERAND, not a bool: the sound
+        # abstraction is the join of the possible results
+        vals = [self.eval(v, env, mod) for v in node.values]
+        ivs = [v for v in vals if isinstance(v, IVal)]
+        tile = any(getattr(v, "tile", False) for v in vals)
+        if len(ivs) != len(vals):
+            return IVal(tile=tile)
+        if all(v.is_const for v in ivs):
+            acc = ivs[0].lo
+            for v in ivs[1:]:
+                acc = (acc and v.lo) if isinstance(node.op, ast.And) else (acc or v.lo)
+            return IVal.const(int(acc))
+        out = join_all(ivs)
+        if isinstance(node.op, ast.And):  # may short-circuit to a falsy 0
+            out = out.join(IVal.const(0))
+        return dataclasses.replace(out, tile=tile)
+
+    def _eval_Compare(self, node, env, mod):
+        left = self.eval(node.left, env, mod)
+        results: list[IVal] = []
+        for op, comp in zip(node.ops, node.comparators):
+            right = self.eval(comp, env, mod)
+            sym = _CMP_SYMS.get(type(op))
+            if sym is not None and isinstance(left, IVal) and isinstance(right, IVal):
+                results.append(compare(left, right, sym))
+            else:
+                tile = getattr(left, "tile", False) or getattr(right, "tile", False)
+                results.append(IVal.range(0, 1, "bool", tile))
+            left = right
+        if len(results) == 1:
+            return results[0]
+        if all(r.is_const for r in results):  # and-fold of the chain
+            return IVal.const(int(all(r.lo for r in results)), "bool")
+        return IVal.range(0, 1, "bool", any(r.tile for r in results))
+
+    def _eval_IfExp(self, node, env, mod):
+        test = self.eval(node.test, env, mod)
+        if isinstance(test, IVal) and test.is_const:
+            return self.eval(node.body if test.lo else node.orelse, env, mod)
+        return _join_values(self.eval(node.body, env, mod),
+                            self.eval(node.orelse, env, mod))
+
+    def _eval_Tuple(self, node, env, mod):
+        return TVal(tuple(self._eval_elts(node.elts, env, mod)))
+
+    def _eval_List(self, node, env, mod):
+        return LVal(self._eval_elts(node.elts, env, mod))
+
+    def _eval_elts(self, elts, env, mod) -> list:
+        out = []
+        for e in elts:
+            if isinstance(e, ast.Starred):
+                mode, data = self._iter_values(self.eval(e.value, env, mod))
+                if mode == "concrete":
+                    out.extend(data)
+                else:
+                    out.append(data)
+            else:
+                out.append(self.eval(e, env, mod))
+        return out
+
+    def _eval_Set(self, node, env, mod):
+        return LVal(self._eval_elts(node.elts, env, mod)).summarised()
+
+    def _eval_Dict(self, node, env, mod):
+        for v in node.values:
+            if v is not None:
+                self.eval(v, env, mod)
+        return TOP
+
+    def _eval_Lambda(self, node, env, mod):
+        return FuncVal(node, mod, closure=env)
+
+    def _eval_JoinedStr(self, node, env, mod):
+        return ConstVal("")
+
+    def _eval_Slice(self, node, env, mod):
+        return TVal((self.eval(node.lower, env, mod) if node.lower else ConstVal(None),
+                     self.eval(node.upper, env, mod) if node.upper else ConstVal(None),
+                     self.eval(node.step, env, mod) if node.step else ConstVal(None)))
+
+    def _eval_ListComp(self, node, env, mod):
+        return self._comp(node, env, mod)
+
+    def _eval_GeneratorExp(self, node, env, mod):
+        return self._comp(node, env, mod)
+
+    def _comp(self, node, env, mod):
+        gen = node.generators[0]
+        mode, data = self._iter_values(self.eval(gen.iter, env, mod))
+        frame = Frame()
+        sub = Env(env)
+
+        def eval_element() -> Any:
+            for cond in gen.ifs:
+                self.eval(cond, sub, mod)
+            if len(node.generators) > 1:
+                inner = ast.GeneratorExp(elt=node.elt,
+                                         generators=node.generators[1:])
+                v = self._comp(inner, sub, mod)
+                return v
+            return self.eval(node.elt, sub, mod)
+
+        if mode == "concrete":
+            out = []
+            for item in data:
+                self.assign(gen.target, item, sub, mod, frame)
+                v = eval_element()
+                if len(node.generators) > 1 and isinstance(v, LVal) and v.concrete:
+                    out.extend(v.elems)
+                else:
+                    out.append(v)
+            return LVal(out)
+        self.assign(gen.target, data, sub, mod, frame)
+        elem = eval_element()
+        if isinstance(elem, LVal):
+            elem = elem.join_elem()
+        return LVal(elem=elem, length=IVal(0, None))
+
+    def _eval_Subscript(self, node, env, mod):
+        base = self.eval(node.value, env, mod)
+        if isinstance(node.slice, ast.Slice):
+            return self._slice(base, node.slice, env, mod)
+        idx = self.eval(node.slice, env, mod)
+        if isinstance(base, ShapeHandle):
+            if isinstance(idx, IVal) and idx.is_const and idx.lo >= 0:
+                return SymVal(base.dim_at(idx.lo))
+            return IVal(0, None)  # some dim: a non-negative host int
+        if isinstance(base, (LVal, TVal)):
+            elems = base.elems if isinstance(base, TVal) or base.concrete else None
+            if elems is not None and isinstance(idx, IVal) and idx.is_const \
+                    and -len(elems) <= idx.lo < len(elems):
+                return elems[idx.lo]
+            if isinstance(base, LVal):
+                return elem_or_top(base)
+            return join_all([e for e in base.elems if isinstance(e, IVal)]) \
+                if base.elems and all(isinstance(e, IVal) for e in base.elems) else TOP
+        if isinstance(base, IVal):
+            shape = base.shape[1:] if base.shape else None
+            return dataclasses.replace(base, shape=shape or None)
+        return TOP
+
+    def _slice(self, base, sl: ast.Slice, env, mod):
+        lo = self.eval(sl.lower, env, mod) if sl.lower else None
+        hi = self.eval(sl.upper, env, mod) if sl.upper else None
+        step = self.eval(sl.step, env, mod) if sl.step else None
+
+        def conc(v, default):
+            if v is None or isinstance(v, ConstVal) and v.value is None:
+                return default
+            if isinstance(v, IVal) and v.is_const:
+                return v.lo
+            return None
+
+        if isinstance(base, (LVal, TVal)):
+            elems = base.elems if isinstance(base, TVal) or base.concrete else None
+            if elems is not None:
+                a = conc(lo, None)
+                b = conc(hi, None)
+                s = conc(step, 1)
+                if s is not None and (lo is None or a is not None) and \
+                        (hi is None or b is not None):
+                    sliced = list(elems)[slice(a, b, s)]
+                    return TVal(tuple(sliced)) if isinstance(base, TVal) else LVal(sliced)
+            if isinstance(base, LVal):
+                length = base.length
+                b = conc(hi, None)
+                if b is not None and b >= 0:
+                    length = IVal.range(0, b if length.hi is None else min(length.hi, b))
+                return LVal(elem=base.join_elem(), length=length)
+            return base
+        if isinstance(base, IVal):
+            return dataclasses.replace(base, shape=None)
+        return TOP
+
+    # -- calls --------------------------------------------------------------
+
+    def _eval_Call(self, node: ast.Call, env, mod):
+        func = self.eval(node.func, env, mod)
+        args = self._eval_elts(node.args, env, mod)
+        kwargs: dict[str, Any] = {}
+        for kw in node.keywords:
+            if kw.arg is not None:
+                kwargs[kw.arg] = self.eval(kw.value, env, mod)
+            else:
+                self.eval(kw.value, env, mod)
+        return self.call(func, args, kwargs, node, env, mod)
+
+    def call(self, func, args: list, kwargs: dict, node, env: Env, mod: Module):
+        from . import models  # deferred: models imports this module's classes
+        if isinstance(func, BuiltinVal):
+            return models.dispatch(self, func, args, kwargs, node, env, mod)
+        if isinstance(func, DtypeVal):
+            v = args[0] if args else TOP
+            return models.cast(v, func.name)
+        if isinstance(func, BoundMethod):
+            return models.method(self, func, args, kwargs, node, env, mod)
+        if isinstance(func, VmapVal):
+            from . import shapes
+            shapes.check_vmap_call(self, func, args, node, mod)
+            tile = any(getattr(a, "tile", False) for a in args)
+            return IVal(tile=tile)
+        if isinstance(func, PallasVal):
+            from . import pallas_checks
+            return pallas_checks.check_pallas_invocation(self, func, args, mod)
+        if isinstance(func, FuncVal):
+            return self._call_user(func, args, kwargs, node, mod)
+        tile = any(getattr(a, "tile", False) for a in args)
+        return IVal(tile=tile)
+
+    def _call_user(self, fv: FuncVal, args, kwargs, node, mod: Module):
+        callee_mod = fv.module
+        fname = getattr(fv.node, "name", "<lambda>")
+        assumes = callee_mod.assumes.get(fname, {})
+        if assumes:
+            self._check_contract(fv, args, kwargs, assumes, node, mod)
+        # closures/lambdas: inline with actual arguments (their behaviour
+        # depends on the captured environment); module-level functions:
+        # context-insensitive memoized summary
+        if fv.closure is not None or isinstance(fv.node, ast.Lambda) \
+                or fv.bound_args or fv.bound_kwargs:
+            key = (callee_mod.path, id(fv.node))
+            if key in self.in_progress:
+                return TILE_TOP
+            self.in_progress.add(key)
+            try:
+                return self._run_function(fv, tuple(args), kwargs)
+            except _Budget:
+                raise
+            finally:
+                self.in_progress.discard(key)
+        return self.summary(fv)
+
+    def _check_contract(self, fv: FuncVal, args, kwargs, assumes, node, mod) -> None:
+        params = self._params(fv.node)
+        binding = {}
+        for i, p in enumerate(params):
+            if i < len(args):
+                binding[p.arg] = args[i]
+            elif p.arg in kwargs:
+                binding[p.arg] = kwargs[p.arg]
+        for pname, assume in assumes.items():
+            got = binding.get(pname)
+            if not isinstance(got, IVal):
+                continue
+            contract = IVal.range(assume.lo, assume.hi)
+            if got.lo is not None and got.hi is not None and (
+                    (contract.hi is not None and got.lo > contract.hi)
+                    or (contract.lo is not None and got.hi < contract.lo)):
+                fname = getattr(fv.node, "name", "<lambda>")
+                self.events.append(Event(
+                    "kernel-contract-violation", mod.path, node,
+                    f"argument {pname!r} of {fname}() is provably in "
+                    f"[{got.lo}, {got.hi}], outside the declared contract "
+                    f"`{assume.text.split('#', 1)[-1].strip()}`"))
+
+
+class BoundMethod:
+    __slots__ = ("base", "attr")
+
+    def __init__(self, base, attr: str):
+        self.base = base
+        self.attr = attr
+
+
+def _dim_value(d: Dim):
+    return IVal.const(d.coeff) if d.is_const else SymVal(d)
+
+
+_ROOT_CANON = {"jnp": "jax.numpy", "np": "numpy", "lax": "jax.lax",
+               "pl": "jax.experimental.pallas"}
+
+_DTYPE_NAMES = set(INT_DTYPES) | {"bfloat16", "float16", "float32", "float64"}
+
+_BUILTINS = {
+    "len", "range", "int", "float", "bool", "min", "max", "abs", "pow",
+    "divmod", "sum", "sorted", "list", "tuple", "zip", "enumerate",
+    "reversed", "isinstance", "getattr", "hasattr", "print", "round", "str",
+    "repr", "set", "dict", "all", "any", "id", "type",
+}
+
+_TRANSFER = {
+    ast.Add: add, ast.Sub: sub, ast.Mult: mul, ast.LShift: lshift,
+    ast.RShift: rshift, ast.BitAnd: bitand, ast.BitOr: bitor,
+    ast.BitXor: bitxor, ast.Mod: mod, ast.FloorDiv: floordiv,
+}
+
+_CMP_SYMS = {ast.Lt: "<", ast.Gt: ">", ast.LtE: "<=", ast.GtE: ">=",
+             ast.Eq: "==", ast.NotEq: "!="}

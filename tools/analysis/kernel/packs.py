@@ -1,0 +1,350 @@
+"""qrkernel analysis pack, exposed as qrlint ``Rule`` objects.
+
+One :class:`KernelAnalysis` per project run (abstract interpretation →
+value-range sites + shape/pallas/contract events, plus the AST-only
+donation/recompile pass), cached on the ``Project``; the thin rule classes
+each publish one finding id from it so ``--select``/``--ignore`` and the
+inline suppression machinery work unchanged.
+
+Rule ids:
+
+==========================  =================================================
+kernel-int32-overflow       a ``*``/``<<`` on kernel tile values whose
+                            mathematical interval cannot be proven to fit the
+                            value's dtype (int32 when unknown) and that is
+                            not annotated ``# qrkernel: wrapping``
+kernel-contract-violation   a call argument provably outside a declared
+                            ``# qrkernel: assume`` parameter contract
+kernel-shape-mismatch       reshape/concatenate/matmul with provably
+                            inconsistent symbolic element counts or dims
+kernel-batch-axis           vmap in_axes/transpose axis bookkeeping loses or
+                            misnames a batch axis (out-of-range, duplicated,
+                            arity mismatch)
+kernel-grid-blockspec       pallas_call grid × BlockSpec inconsistency:
+                            non-divisible block dims or an index_map that
+                            provably reaches out of bounds
+kernel-accum-dtype          an accumulator/output dtype narrower than the
+                            values stored into it (incl.
+                            preferred_element_type on contractions)
+kernel-read-after-donate    an operand read after being passed in a
+                            donate_argnums position
+kernel-recompile-hazard     a jitted callable invoked in a loop with a
+                            loop-dependent argument shape (recompile storm)
+kernel-unjustified-annotation  a qrkernel suppression / ``wrapping`` /
+                            ``assume`` annotation with no one-line
+                            justification
+==========================  =================================================
+
+File scope: the analysis runs on files that import jax and look
+kernel-shaped (pallas / ``*_tiles``/``*_kernel`` functions / vmap / jit /
+donation) — the modules named by docs/static_analysis.md plus any fixture
+that matches.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import FileContext, Project, Rule
+from ..rules_jax import _imports_jax
+from . import dataflow
+from .interp import _ASSUME_RE, _WRAPPING_RE, Interp
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*(?:qrlint|qrkernel):\s*disable(?:-file)?\s*=\s*"
+    r"(?P<rules>[\w.,\- ]+)(?P<rest>.*)$")
+
+
+def kernel_file(ctx: FileContext) -> bool:
+    """Any jax-importing file is in scope: the value-range sites are still
+    restricted to tile functions, but shape/vmap/pallas/donation mistakes
+    live in plain jnp code too (kem/, sig/, provider glue)."""
+    return _imports_jax(ctx)
+
+
+class KernelAnalysis:
+    """All qrkernel findings for one project, computed once and cached."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.interp = Interp()
+        self.findings: list[tuple[str, FileContext, object, str]] = []
+        self.sites = {}
+        checked: list[FileContext] = []
+        for ctx in project.contexts.values():
+            if kernel_file(ctx):
+                mod = self.interp.analyze_module(ctx.path, ctx.source)
+                if mod is not None:
+                    checked.append(ctx)
+        self.sites = self.interp.sites
+        self._collect_site_findings(project)
+        self._collect_events(project)
+        self._collect_dataflow(checked)
+        KernelAnalysis.last = self
+
+    #: most recent analysis in this process, so the CLI's --proofs ledger
+    #: can reuse the instance the engine run just computed instead of
+    #: re-interpreting the whole tree
+    last: "KernelAnalysis | None" = None
+
+    @classmethod
+    def of(cls, project: Project) -> "KernelAnalysis":
+        cached = getattr(project, "_qrkernel_analysis", None)
+        if cached is None:
+            cached = cls(project)
+            project._qrkernel_analysis = cached  # type: ignore[attr-defined]
+        return cached
+
+    def _ctx(self, path: str) -> FileContext | None:
+        return self.project.contexts.get(path)
+
+    def _collect_site_findings(self, project: Project) -> None:
+        for (path, lineno), site in sorted(self.interp.sites.items()):
+            if site.proved or site.wrapping:
+                continue
+            ctx = self._ctx(path)
+            if ctx is None:
+                continue
+            detail = f" ({site.detail})" if site.detail else ""
+            self.findings.append((
+                "kernel-int32-overflow", ctx, _LineNode(lineno),
+                f"`{site.op}` on kernel tile values: interval analysis cannot "
+                f"prove the result fits its vector-register dtype{detail}; "
+                "widen/restructure, declare a `# qrkernel: assume` parameter "
+                "contract the proof can start from, or annotate "
+                "`# qrkernel: wrapping — why` if wrap is by design"))
+
+    def _collect_events(self, project: Project) -> None:
+        seen: set[tuple] = set()
+        for ev in self.interp.events:
+            ctx = self._ctx(ev.path)
+            if ctx is None:
+                continue
+            key = (ev.rule, ev.path, getattr(ev.node, "lineno", 0),
+                   getattr(ev.node, "col_offset", 0), ev.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            self.findings.append((ev.rule, ctx, ev.node, ev.message))
+
+    def _collect_dataflow(self, checked: list[FileContext]) -> None:
+        seen: set[tuple] = set()
+        for ctx in checked:
+            for ev in dataflow.analyze_dataflow(ctx.tree):
+                # nested FunctionDefs are walked by both themselves and
+                # their enclosing function: dedupe per site
+                key = (ev.rule, ctx.path, getattr(ev.node, "lineno", 0),
+                       getattr(ev.node, "col_offset", 0), ev.message)
+                if key in seen:
+                    continue
+                seen.add(key)
+                self.findings.append((ev.rule, ctx, ev.node, ev.message))
+
+    # -- proof reporting (CLI --proofs, docs) -------------------------------
+
+    def proofs(self) -> list[dict]:
+        out = []
+        for (path, lineno), site in sorted(self.interp.sites.items()):
+            status = ("wrapping" if site.wrapping
+                      else "proved" if site.proved else "unproven")
+            entry = {"path": path, "line": lineno, "op": site.op,
+                     "status": status}
+            if site.proved and site.bound is not None:
+                entry["bound_bits"] = max(site.bound, 1).bit_length()
+                entry["bound"] = site.bound
+            out.append(entry)
+        return out
+
+
+class _KernelRule(Rule):
+    """Base: publish one finding id out of the shared analysis."""
+
+    severity = "error"
+
+    def check_project(self, project: Project) -> None:
+        analysis = KernelAnalysis.of(project)
+        for rule_id, ctx, node, message in analysis.findings:
+            if rule_id == self.id:
+                project.report(self, ctx, node, message)
+
+
+class Int32OverflowRule(_KernelRule):
+    id = "kernel-int32-overflow"
+    description = ("a */<< on kernel tile values whose interval cannot be "
+                   "proven to fit the register dtype (wrap-silent overflow); "
+                   "machine-checks what int32-narrowing suppressions claimed")
+
+
+class ContractViolationRule(_KernelRule):
+    id = "kernel-contract-violation"
+    description = ("a call argument provably outside the callee's declared "
+                   "`# qrkernel: assume` parameter contract")
+
+
+class ShapeMismatchRule(_KernelRule):
+    id = "kernel-shape-mismatch"
+    description = ("reshape/concatenate/matmul with provably inconsistent "
+                   "symbolic element counts or dims")
+
+
+class BatchAxisRule(_KernelRule):
+    id = "kernel-batch-axis"
+    description = ("vmap/transpose batch-axis bookkeeping error: "
+                   "out-of-range or duplicated axis, in_axes arity mismatch")
+
+
+class GridBlockSpecRule(_KernelRule):
+    id = "kernel-grid-blockspec"
+    description = ("pallas_call grid x BlockSpec inconsistency: non-divisible "
+                   "block dims or an out-of-bounds index_map")
+
+
+class AccumDtypeRule(_KernelRule):
+    id = "kernel-accum-dtype"
+    description = ("accumulator/output dtype narrower than the values stored "
+                   "into it (silent truncation)")
+
+
+class ReadAfterDonateRule(_KernelRule):
+    id = "kernel-read-after-donate"
+    description = ("an operand is read after being passed in a donate_argnums "
+                   "position (the buffer is aliased to the output)")
+
+
+class RecompileHazardRule(_KernelRule):
+    id = "kernel-recompile-hazard"
+    description = ("a jitted callable invoked in a loop with a loop-dependent "
+                   "argument shape: every iteration recompiles")
+
+
+class UnjustifiedAnnotationRule(Rule):
+    """qrkernel suppressions AND semantic annotations (``wrapping`` /
+    ``assume``) require a one-line justification, policed exactly like
+    qrflow's suppressions: a waiver nobody can read is a human claim again."""
+
+    id = "kernel-unjustified-annotation"
+    severity = "error"
+    description = ("a qrkernel suppression / wrapping / assume annotation "
+                   "carries no one-line justification")
+
+    _POLICED = frozenset({
+        "kernel-int32-overflow", "kernel-contract-violation",
+        "kernel-shape-mismatch", "kernel-batch-axis", "kernel-grid-blockspec",
+        "kernel-accum-dtype", "kernel-read-after-donate",
+        "kernel-recompile-hazard", "kernel-unjustified-annotation",
+    })
+
+    def check_project(self, project: Project) -> None:
+        for ctx in project.contexts.values():
+            for lineno, comment in _comments(ctx):
+                self._check_line(project, ctx, lineno, comment)
+
+    def _check_line(self, project: Project, ctx: FileContext, lineno: int,
+                    line: str) -> None:
+        m = _WRAPPING_RE.search(line)
+        if m and not re.search(r"\w", m.group("just") or ""):
+            project.report(
+                self, ctx, _LineNode(lineno),
+                "`# qrkernel: wrapping` annotation has no justification — "
+                "state WHY the wrap is by design (e.g. `— uint32 lane "
+                "rotation: shifted-out bits recovered from the partner "
+                "word`)")
+            return
+        m = _ASSUME_RE.search(line)
+        if m and not re.search(r"\w", m.group("just") or ""):
+            project.report(
+                self, ctx, _LineNode(lineno),
+                f"`# qrkernel: assume {m.group('name')} in …` contract has "
+                "no justification — cite the spec fact that makes the "
+                "precondition true (e.g. `— FIPS 204: NTT operands are "
+                "mod-q residues`)")
+            return
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            return
+        blob, rest = m.group("rules"), m.group("rest") or ""
+        sep = re.search(r"[^\w,\- ]", blob)
+        ids_part = blob[: sep.start()] if sep else blob
+        justification = (blob[sep.start():] if sep else "") + rest
+        ids = {tok for part in ids_part.split(",")
+               for tok in part.strip().split() if tok}
+        kernel_ids = ids & self._POLICED
+        if kernel_ids and not re.search(r"\w", justification):
+            project.report(
+                self, ctx, _LineNode(lineno),
+                f"suppression of {', '.join(sorted(kernel_ids))} has no "
+                "justification — append one after the rule id "
+                "(e.g. `# qrkernel: disable=kernel-recompile-hazard — "
+                "cold path, one-off trace`)")
+
+
+class _LineNode:
+    """Minimal AST-node stand-in so line-anchored findings route through
+    the normal report/suppression machinery."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.end_lineno = lineno
+        self.col_offset = 0
+
+
+def _comments(ctx: FileContext) -> list[tuple[int, str]]:
+    """Real COMMENT tokens only — annotation syntax quoted inside a
+    docstring or an error-message string must not be policed."""
+    import io
+    import tokenize
+
+    out: list[tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(ctx.source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # fall back to raw lines on tokenizer trouble (never silently skip)
+        out = list(enumerate(ctx.lines, start=1))
+    return out
+
+
+KERNEL_RULES = (
+    Int32OverflowRule, ContractViolationRule, ShapeMismatchRule,
+    BatchAxisRule, GridBlockSpecRule, AccumDtypeRule, ReadAfterDonateRule,
+    RecompileHazardRule, UnjustifiedAnnotationRule,
+)
+
+
+# -- single-file interval API (qrlint's int32-narrowing defers to this) -------
+
+_STATUS_CACHE: dict[tuple[str, int], dict[int, str]] = {}
+
+
+def site_status(path: str, source: str) -> dict[int, str]:
+    """``{lineno: 'proved' | 'wrapping'}`` for one kernel module's ``*``/``<<``
+    sites — the machine-checked facts qrlint's ``int32-narrowing`` rule
+    defers to.  Sites the interval analysis cannot prove are absent (qrlint
+    keeps flagging them).  Cached per (path, source)."""
+    key = (path, hash(source))
+    if key in _STATUS_CACHE:
+        return _STATUS_CACHE[key]
+    interp = Interp()
+    out: dict[int, str] = {}
+    try:
+        mod = interp.loader.get(path, source)
+        if mod is not None:
+            interp.check_paths.add(mod.path)
+            from .interp import FuncVal
+            for name in mod.scope_funcs():
+                func = mod.funcs.get(name)
+                if func is not None:
+                    interp.summary(FuncVal(func, mod))
+            for (p, lineno), site in interp.sites.items():
+                if p != mod.path:
+                    continue
+                if site.wrapping:
+                    out[lineno] = "wrapping"
+                elif site.proved:
+                    out[lineno] = "proved"
+    except (SyntaxError, RecursionError):
+        out = {}
+    _STATUS_CACHE[key] = out
+    return out

@@ -1,0 +1,176 @@
+"""Pallas structural checks (``kernel-grid-blockspec``, ``kernel-accum-dtype``).
+
+A ``pl.pallas_call`` is evaluated into a first-class :class:`PallasVal`.  At
+construction time the analyzer checks what is derivable from the call itself
+(out_specs vs out_shape divisibility, index-map bounds over the concrete
+grid, the kernel's stores vs the declared out dtypes); at *invocation* time
+it checks the actual input arrays against the in_specs.  Everything is
+gated on concreteness — symbolic grids/shapes (the live kernels' padded
+batch dims) stay silent.
+"""
+
+from __future__ import annotations
+
+from .absdom import DTYPE_WIDTH, Dim, IVal
+from .interp import (TOP, BlockSpecVal, Event, FuncVal, LVal, StructVal,
+                     SymVal, TVal, _Budget)
+
+
+def _emit(interp, mod, node, message: str) -> None:
+    if mod.path in interp.check_paths:
+        interp.events.append(Event("kernel-grid-blockspec", mod.path, node,
+                                   message))
+
+
+def _listify(interp, v) -> list:
+    if v is None:
+        return []
+    if isinstance(v, (LVal, TVal)):
+        mode, data = interp._iter_values(v)
+        return list(data) if mode == "concrete" else []
+    return [v]
+
+
+def _grid_dims(interp, grid) -> list[Dim] | None:
+    if grid is None:
+        return None
+    if isinstance(grid, IVal) and grid.is_const:
+        return [Dim.const(grid.lo)]
+    if isinstance(grid, (TVal, LVal)):
+        mode, data = interp._iter_values(grid)
+        if mode != "concrete":
+            return None
+        out = []
+        for d in data:
+            if isinstance(d, IVal) and d.is_const:
+                out.append(Dim.const(d.lo))
+            elif isinstance(d, SymVal):
+                out.append(d.dim)
+            else:
+                return None
+        return out
+    return None
+
+
+def check_pallas_static(interp, pv, mod) -> None:
+    grid = _grid_dims(interp, pv.grid)
+    out_specs = _listify(interp, pv.out_specs)
+    out_shapes = _listify(interp, pv.out_shape)
+    for i, struct in enumerate(out_shapes):
+        if not isinstance(struct, StructVal) or struct.shape is None:
+            continue
+        spec = out_specs[i] if i < len(out_specs) else None
+        if isinstance(spec, BlockSpecVal):
+            _check_spec(interp, mod, pv.node, spec, struct.shape, grid,
+                        f"out_specs[{i}]")
+    _check_kernel_stores(interp, pv, mod, out_shapes)
+
+
+def check_pallas_invocation(interp, pv, args, mod):
+    grid = _grid_dims(interp, pv.grid)
+    in_specs = _listify(interp, pv.in_specs)
+    for i, arg in enumerate(args):
+        if not isinstance(arg, IVal) or arg.shape is None:
+            continue
+        spec = in_specs[i] if i < len(in_specs) else None
+        if isinstance(spec, BlockSpecVal):
+            _check_spec(interp, mod, pv.node, spec, arg.shape, grid,
+                        f"in_specs[{i}]")
+    out_shapes = _listify(interp, pv.out_shape)
+    outs = []
+    for struct in out_shapes:
+        if isinstance(struct, StructVal):
+            outs.append(IVal(dtype=struct.dtype, tile=True, shape=struct.shape))
+        else:
+            outs.append(IVal(tile=True))
+    if len(outs) == 1:
+        return outs[0]
+    if outs:
+        return TVal(tuple(outs))
+    return IVal(tile=True)
+
+
+def _check_spec(interp, mod, node, spec: BlockSpecVal, array_shape, grid,
+                where: str) -> None:
+    block = spec.block_shape
+    if block is None:
+        return
+    if len(block) == len(array_shape):
+        for i, (b, a) in enumerate(zip(block, array_shape)):
+            if b.is_const and a.is_const and b.coeff > 0 \
+                    and a.coeff % b.coeff != 0:
+                _emit(interp, mod, node,
+                      f"{where}: array dim {i} ({a}) is not divisible by the "
+                      f"BlockSpec block dim ({b}): the trailing partial block "
+                      "reads/writes out of bounds or pads silently")
+    if spec.index_map is None or grid is None:
+        return
+    if not all(g.is_const for g in grid):
+        return
+    idx_args = [IVal.range(0, max(g.coeff - 1, 0)) for g in grid]
+    try:
+        result = interp._run_function(spec.index_map, tuple(idx_args))
+    except _Budget:
+        return
+    indices = result.elems if isinstance(result, TVal) else (
+        (result,) if isinstance(result, IVal) else ())
+    for i, idx in enumerate(indices):
+        if not isinstance(idx, IVal) or idx.hi is None or i >= len(block):
+            continue
+        b, a = block[i], array_shape[i] if i < len(array_shape) else None
+        if a is not None and b.is_const and a.is_const:
+            if (idx.hi + 1) * b.coeff > a.coeff:
+                _emit(interp, mod, node,
+                      f"{where}: index_map dim {i} reaches block "
+                      f"{idx.hi} * {b} + {b} > array dim {a}: out-of-bounds "
+                      "block under the declared grid")
+
+
+def _check_kernel_stores(interp, pv, mod, out_shapes) -> None:
+    """Abstractly run the kernel with out-ref dtypes seeded; a store of a
+    provably wider value into a narrower out ref is a silent-narrowing
+    accumulator (``kernel-accum-dtype``)."""
+    kernel = pv.kernel
+    if kernel is None or not isinstance(kernel, FuncVal) \
+            or mod.path not in interp.check_paths:
+        return
+    params = interp._params(kernel.node)
+    n_out = len(out_shapes)
+    bound = len(kernel.bound_args)
+    free = [p.arg for p in params[bound:] if p.arg not in kernel.bound_kwargs]
+    out_dtypes: dict[str, str] = {}
+    seeds = []
+    n_in = max(len(free) - n_out, 0)
+    for i, name in enumerate(free):
+        if i < n_in:
+            seeds.append(IVal(tile=True))
+        else:
+            struct = out_shapes[i - n_in]
+            dt = struct.dtype if isinstance(struct, StructVal) else None
+            if dt:
+                out_dtypes[name] = dt
+            seeds.append(IVal(dtype=dt, tile=True))
+
+    events: list[Event] = []
+
+    def hook(ref_name: str, value, node) -> None:
+        out_dt = out_dtypes.get(ref_name)
+        vdt = getattr(value, "dtype", None)
+        if out_dt and vdt and DTYPE_WIDTH.get(vdt, 0) > DTYPE_WIDTH.get(out_dt, 99):
+            events.append(Event(
+                "kernel-accum-dtype", mod.path, node,
+                f"kernel stores a {vdt} value into out ref {ref_name!r} "
+                f"declared {out_dt} in out_shape: the accumulator dtype is "
+                "narrower than its operands (silent truncation)"))
+
+    key = (kernel.module.path, id(kernel.node))
+    if key in interp.in_progress:
+        return
+    interp.in_progress.add(key)
+    try:
+        interp._run_function(kernel, tuple(seeds), store_hook=hook)
+    except _Budget:
+        return
+    finally:
+        interp.in_progress.discard(key)
+    interp.events.extend(events)

@@ -1,0 +1,114 @@
+"""qrkernel CLI — ``python -m tools.analysis.kernel.run <package-or-path>``.
+
+Exit status mirrors the qrlint/qrflow ratchet contract: 0 when the tree is
+clean (modulo explicit, JUSTIFIED suppressions), 1 when any error-severity
+finding remains, 2 on usage errors.  ``--format json``/``--format sarif``
+emit machine-readable output; ``--proofs`` additionally reports every
+``*``/``<<`` site's proof status (proved bound / wrapping / unproven) — the
+facts that replaced the hand-written int32-narrowing suppressions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from ..engine import Engine, render_findings, resolve_target
+from ..flow.sarif import to_sarif
+from . import kernel_rules
+
+
+def _resolve_target(target: str) -> Path:
+    return resolve_target(target, "qrkernel")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="qrkernel",
+        description=("abstract-interpretation verifier for the JAX/Pallas "
+                     "kernel layer (docs/static_analysis.md)"),
+    )
+    ap.add_argument("targets", nargs="*", default=["quantum_resistant_p2p_tpu"],
+                    help="files, directories, or package names (default: the package)")
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human", help="output format (default: human)")
+    ap.add_argument("--json", action="store_true",
+                    help="alias for --format json (qrlint compatibility)")
+    ap.add_argument("--select", help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    ap.add_argument("--proofs", action="store_true",
+                    help="also report per-site interval proof status")
+    args = ap.parse_args(argv)
+
+    rules = kernel_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.id:28} [{rule.severity}] {rule.description}")
+        return 0
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",")}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"qrkernel: unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+    if args.ignore:
+        dropped = {r.strip() for r in args.ignore.split(",")}
+        rules = [r for r in rules if r.id not in dropped]
+
+    targets = [_resolve_target(t) for t in (args.targets or ["quantum_resistant_p2p_tpu"])]
+    engine = Engine(rules)
+    findings, suppressed = engine.lint_paths(targets)
+
+    fmt = "json" if args.json else args.format
+    if fmt == "sarif":
+        print(json.dumps(to_sarif(findings, suppressed, rules,
+                                  tool_name="qrkernel"), indent=2))
+    else:
+        out = render_findings(findings, suppressed, as_json=(fmt == "json"))
+        if out and fmt == "human":
+            lines = out.splitlines()
+            lines[-1] = lines[-1].replace("qrlint:", "qrkernel:", 1)
+            out = "\n".join(lines)
+        if out:
+            print(out)
+    if args.proofs and fmt == "human":
+        _print_proofs(targets)
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+def _print_proofs(targets: list[Path]) -> None:
+    from ..engine import FileContext, Project
+    from .packs import KernelAnalysis
+
+    analysis = KernelAnalysis.last  # the engine run above already built it
+    if analysis is None:  # e.g. --select skipped every kernel rule
+        files: list[Path] = []
+        for t in targets:
+            files.extend(sorted(t.rglob("*.py")) if t.is_dir() else [t])
+        contexts = {}
+        for f in files:
+            try:
+                contexts[str(f)] = FileContext(str(f), f.read_text(encoding="utf-8"))
+            except (SyntaxError, UnicodeDecodeError, OSError):
+                continue
+        analysis = KernelAnalysis.of(Project(contexts))
+    proofs = analysis.proofs()
+    if not proofs:
+        print("qrkernel: no tile multiply/shift sites in scope")
+        return
+    print("qrkernel proof ledger:")
+    for p in proofs:
+        if p["status"] == "proved":
+            print(f"  {p['path']}:{p['line']}: `{p['op']}` proved <= "
+                  f"{p['bound']} ({p['bound_bits']} bits)")
+        else:
+            print(f"  {p['path']}:{p['line']}: `{p['op']}` {p['status']}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

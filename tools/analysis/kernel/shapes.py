@@ -1,0 +1,207 @@
+"""Symbolic shape / batch-axis checks (the ``kernel-shape-mismatch`` and
+``kernel-batch-axis`` analyses).
+
+Shapes are tuples of :class:`absdom.Dim` — product normal forms over opaque
+symbols — threaded through the interpreter's jnp models.  Every check fires
+only on a *provable* inconsistency (two dims whose symbolic factors agree
+but whose integer coefficients differ, an axis index provably outside a
+known rank), so symbolic or unknown shapes can never false-positive:
+``x.reshape(B, 64)`` of a ``(B, 128)`` array is flagged even though ``B`` is
+unknown, while anything involving a dim the algebra cannot normalise stays
+silent.
+"""
+
+from __future__ import annotations
+
+from .absdom import Dim, IVal, format_shape, shape_product
+from .interp import Event, LVal, SymVal, TVal
+
+
+def _emit(interp, rule: str, mod, node, message: str) -> None:
+    if mod.path in interp.check_paths:
+        interp.events.append(Event(rule, mod.path, node, message))
+
+
+def check_reshape(interp, src: IVal, new_dims, node, mod):
+    """Element-count consistency of a reshape; returns the result shape."""
+    if new_dims is None:
+        return None
+    holes = [i for i, d in enumerate(new_dims) if d.is_const and d.coeff == -1]
+    if len(holes) > 1:
+        return None
+    fixed = [d for i, d in enumerate(new_dims) if i not in holes]
+    if src.shape is None:
+        return tuple(new_dims) if not holes else None
+    old_total = shape_product(src.shape)
+    new_total = shape_product(fixed)
+    if holes:
+        # -1 infers the hole: old_total must be divisible by the rest
+        if old_total.factors == new_total.factors and new_total.coeff > 0:
+            if old_total.coeff % new_total.coeff != 0:
+                _emit(interp, "kernel-shape-mismatch", mod, node,
+                      f"reshape of {format_shape(src.shape)} "
+                      f"({old_total} elements) cannot infer -1: not divisible "
+                      f"by the other dims ({new_total})")
+                return None
+            hole = Dim.const(old_total.coeff // new_total.coeff)
+            out = list(new_dims)
+            out[holes[0]] = hole
+            return tuple(out)
+        return None
+    if old_total.provably_ne(new_total):
+        _emit(interp, "kernel-shape-mismatch", mod, node,
+              f"reshape of {format_shape(src.shape)} ({old_total} elements) "
+              f"to {format_shape(tuple(new_dims))} ({new_total} elements): "
+              "element counts provably differ")
+        return None
+    return tuple(new_dims)
+
+
+def check_concatenate(interp, parts, axis: int, node, mod):
+    shapes = [p.shape for p in parts
+              if isinstance(p, IVal) and p.shape is not None]
+    if len(shapes) < 2 or len(shapes) != len(parts):
+        return None
+    rank = len(shapes[0])
+    if any(len(s) != rank for s in shapes) or not (-rank <= axis < rank):
+        return None
+    axis %= rank
+    for i in range(rank):
+        if i == axis:
+            continue
+        for s in shapes[1:]:
+            if shapes[0][i].provably_ne(s[i]):
+                _emit(interp, "kernel-shape-mismatch", mod, node,
+                      f"concatenate along axis {axis}: dim {i} differs "
+                      f"({shapes[0][i]} vs {s[i]}) across operands")
+                return None
+    out = list(shapes[0])
+    if all(s[axis].is_const for s in shapes):
+        out[axis] = Dim.const(sum(s[axis].coeff for s in shapes))
+    else:
+        out[axis] = Dim.fresh("cat")
+    return tuple(out)
+
+
+def _axes_list(interp, axes):
+    if axes is None:
+        return None
+    if isinstance(axes, (TVal, LVal)):
+        mode, data = interp._iter_values(axes)
+        if mode != "concrete":
+            return None
+        out = []
+        for d in data:
+            if isinstance(d, IVal) and d.is_const:
+                out.append(d.lo)
+            else:
+                return None
+        return out
+    if isinstance(axes, IVal) and axes.is_const:
+        return [axes.lo]
+    return None
+
+
+def check_transpose(interp, src: IVal, axes, node, mod):
+    perm = _axes_list(interp, axes)
+    if perm is None:
+        return tuple(reversed(src.shape)) if src.shape is not None and axes is None \
+            else None
+    rank = len(src.shape) if src.shape is not None else None
+    norm = []
+    for a in perm:
+        if rank is not None and not (-rank <= a < rank):
+            _emit(interp, "kernel-batch-axis", mod, node,
+                  f"transpose axis {a} out of range for a rank-{rank} array "
+                  f"{format_shape(src.shape)}: the batch axis this permutation "
+                  "names does not exist")
+            return None
+        norm.append(a % rank if rank is not None else a)
+    if len(set(norm)) != len(norm):
+        _emit(interp, "kernel-batch-axis", mod, node,
+              f"transpose permutation {perm} repeats an axis: one source axis "
+              "is duplicated and another (the batch axis) is dropped")
+        return None
+    if rank is not None and len(norm) == rank:
+        return tuple(src.shape[a] for a in norm)
+    return None
+
+
+def check_swapaxes(interp, src: IVal, a1, a2, node, mod):
+    axes = []
+    for a in (a1, a2):
+        if isinstance(a, IVal) and a.is_const:
+            axes.append(a.lo)
+        else:
+            return None
+    rank = len(src.shape) if src.shape is not None else None
+    if rank is None:
+        return None
+    for a in axes:
+        if not (-rank <= a < rank):
+            _emit(interp, "kernel-batch-axis", mod, node,
+                  f"swapaxes axis {a} out of range for rank-{rank} array "
+                  f"{format_shape(src.shape)}")
+            return None
+    i, j = (a % rank for a in axes)
+    out = list(src.shape)
+    out[i], out[j] = out[j], out[i]
+    return tuple(out)
+
+
+def check_matmul(interp, a: IVal, b: IVal, node, mod):
+    if a.shape is None or b.shape is None or not a.shape or not b.shape:
+        return None
+    ka = a.shape[-1]
+    kb = b.shape[0] if len(b.shape) == 1 else b.shape[-2]
+    if ka.provably_ne(kb):
+        _emit(interp, "kernel-shape-mismatch", mod, node,
+              f"matmul contraction dims provably differ: "
+              f"{format_shape(a.shape)} @ {format_shape(b.shape)} "
+              f"({ka} vs {kb})")
+        return None
+    if len(a.shape) >= 2 and len(b.shape) >= 2:
+        return (*a.shape[:-1], b.shape[-1])
+    return None
+
+
+def check_vmap_call(interp, vmap, args, node, mod) -> None:
+    """Batch-axis bookkeeping at a ``jax.vmap(f, in_axes=…)(…)`` call."""
+    in_axes = vmap.in_axes
+    per_arg: list[int | None]
+    axes = _axes_list(interp, in_axes) if in_axes is not None else None
+    from .interp import ConstVal
+    if in_axes is None:
+        per_arg = [0] * len(args)
+    elif isinstance(in_axes, IVal) and in_axes.is_const:
+        per_arg = [in_axes.lo] * len(args)
+    elif isinstance(in_axes, (TVal, LVal)):
+        mode, data = interp._iter_values(in_axes)
+        if mode != "concrete":
+            return
+        if len(data) != len(args):
+            _emit(interp, "kernel-batch-axis", mod, vmap.node,
+                  f"vmap in_axes names {len(data)} entries but the mapped "
+                  f"function is called with {len(args)} arguments: the batch "
+                  "axis of at least one operand is unaccounted for")
+            return
+        per_arg = []
+        for d in data:
+            if isinstance(d, IVal) and d.is_const:
+                per_arg.append(d.lo)
+            elif isinstance(d, ConstVal) and d.value is None:
+                per_arg.append(None)
+            else:
+                per_arg.append(None)
+    else:
+        per_arg = [None] * len(args)
+    del axes
+    for i, (ax, arg) in enumerate(zip(per_arg, args)):
+        if ax is None or not isinstance(arg, IVal) or arg.shape is None:
+            continue
+        rank = len(arg.shape)
+        if not (-rank <= ax < rank):
+            _emit(interp, "kernel-batch-axis", mod, node,
+                  f"vmap in_axes={ax} for argument {i} is out of range for "
+                  f"its rank-{rank} shape {format_shape(arg.shape)}: the "
+                  "mapped batch axis does not exist (axis loss)")

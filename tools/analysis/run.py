@@ -12,18 +12,11 @@ import sys
 from pathlib import Path
 
 from . import default_rules
-from .engine import Engine, render_findings
+from .engine import Engine, render_findings, resolve_target
 
 
 def _resolve_target(target: str) -> Path:
-    """A target is a path, or a dotted/plain package name relative to cwd."""
-    p = Path(target)
-    if p.exists():
-        return p
-    p = Path(target.replace(".", "/"))
-    if p.exists():
-        return p
-    raise SystemExit(f"qrlint: no such file, directory, or package: {target!r}")
+    return resolve_target(target, "qrlint")
 
 
 def main(argv: list[str] | None = None) -> int:

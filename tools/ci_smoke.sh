@@ -17,14 +17,13 @@ echo "qrp2p --help ok"
 python -m quantum_resistant_p2p_tpu --help >/dev/null
 echo "python -m quantum_resistant_p2p_tpu --help ok"
 
-# Static-analysis ratchet: the tree must lint clean (docs/static_analysis.md).
-python -m tools.analysis.run quantum_resistant_p2p_tpu
-echo "qrlint clean"
-
-# Dataflow ratchet: interprocedural secret-taint / constant-time / race
-# analysis must also pass (every suppression carries a justification).
-python -m tools.analysis.flow.run quantum_resistant_p2p_tpu
-echo "qrflow clean"
+# Static-analysis ratchets (docs/static_analysis.md): the unified driver
+# runs qrlint (AST lint) -> qrflow (interprocedural taint/race) -> qrkernel
+# (abstract-interpretation kernel verifier) with ONE exit code, and asserts
+# the suppression budget (tools/analysis/suppression_budget.json): counts
+# per analyzer may only go down — an unbudgeted suppression fails loudly.
+python -m tools.analysis.all quantum_resistant_p2p_tpu
+echo "qr-analysis clean (qrlint + qrflow + qrkernel, within suppression budget)"
 
 # Gateway storm smoke (docs/gateway.md): a fast 48-session storm through
 # the real TCP transport + protocol engine + autotuner must complete with

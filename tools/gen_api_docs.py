@@ -84,6 +84,15 @@ SECTIONS: dict[str, list[str]] = {
         "tools.analysis.flow.domains",
         "tools.analysis.flow.packs",
         "tools.analysis.flow.sarif",
+        "tools.analysis.kernel",
+        "tools.analysis.kernel.absdom",
+        "tools.analysis.kernel.interp",
+        "tools.analysis.kernel.models",
+        "tools.analysis.kernel.shapes",
+        "tools.analysis.kernel.pallas_checks",
+        "tools.analysis.kernel.dataflow",
+        "tools.analysis.kernel.packs",
+        "tools.analysis.all",
     ],
 }
 
