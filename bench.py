@@ -52,6 +52,24 @@ SLO_PEERS = 32
 SLO_MIN_DEVICE_SERVED = 0.9
 
 
+def _write_slo_report(mode: str, slo: dict | None) -> None:
+    """The SLO engines' burn/budget evaluation for the bench run (CI
+    uploads it next to the trace and metrics artifacts;
+    ``if-no-files-found: ignore`` covers runs without one).  The path is
+    per-mode — ``slo_report.json`` for the ``--slo`` probe,
+    ``storm_slo_report.json`` for ``--storm`` — so a session running both
+    benches leaves BOTH evaluations on disk instead of the last writer
+    silently replacing the other's under the slo-probe's name."""
+    from pathlib import Path
+
+    if slo is None:
+        return
+    name = "slo_report.json" if mode == "slo" else f"{mode}_slo_report.json"
+    Path("bench_results").mkdir(exist_ok=True)
+    Path(f"bench_results/{name}").write_text(
+        json.dumps({"mode": mode, "slo": slo}, indent=2) + "\n")
+
+
 def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
              warmup: int = 4) -> int:
     """Single-handshake SLO probe as a first-class bench output.
@@ -72,9 +90,12 @@ def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
     )
     # obs/ artifacts ride along with the SLO JSON (bench_results/): the
     # trace-event file renders the measured handshakes as flame graphs
-    # (the 4-trips budget, visible) and the metrics snapshot captures the
-    # queue/breaker state the p50/p99 numbers were measured under
+    # (the 4-trips budget, visible), the MERGED multi-node trace puts the
+    # hub and the peers on separate process lanes under the propagated
+    # trace ids, and the metrics snapshot captures the queue/breaker state
+    # the p50/p99 numbers were measured under
     write_obs_artifacts(stats, "bench_results", stem="slo")
+    _write_slo_report("slo", stats.get("slo"))
     p50 = stats.get("p50_handshake_s")
     fraction = stats.get("device_served_fraction")
     out = {
@@ -143,7 +164,7 @@ def storm_main(out_path: str | None = None, sessions: int = STORM_SESSIONS,
     import sys
     from pathlib import Path
 
-    from tools.swarm_bench import run_storm
+    from tools.swarm_bench import run_storm, write_obs_artifacts
 
     params = dict(
         sessions=sessions, arrival_rate=STORM_ARRIVAL_RATE,
@@ -190,6 +211,10 @@ def storm_main(out_path: str | None = None, sessions: int = STORM_SESSIONS,
         "budget": budget,
         "ok": True,
     }
+    # obs artifacts for the LAST (tuned) storm window: merged multi-node
+    # trace + metrics snapshot, plus the SLO engines' burn report
+    write_obs_artifacts(out, "bench_results", stem="storm")
+    _write_slo_report("storm", runs[True][-1].get("slo"))
     rc = 0
     if failures:
         print(f"STORM FAIL: {failures} handshake failure(s)", file=sys.stderr)

@@ -80,6 +80,12 @@ HAD_SESSION_CAP = 4096
 #: default) left the first live size-2/4 flush eating a cold jit inside
 #: KEY_EXCHANGE_TIMEOUT
 WARMUP_SIZES = (1, 2, 4)
+#: latency SLO threshold for an initiated handshake attempt (obs/slo.py):
+#: chosen ON a DEFAULT_LATENCY_BUCKETS boundary so the good/bad split of
+#: the burn-rate math is exact, and generous enough that only a degraded
+#: plane (cold compiles on the hot path, breaker storms, gateway
+#: saturation) burns budget — warm fused handshakes measure ~0.1-0.2 s
+HANDSHAKE_SLO_THRESHOLD_S = 2.0
 
 
 class KeyExchangeState(enum.Enum):
@@ -254,6 +260,14 @@ class SecureMessaging:
             "handshake_sheds", "inbound handshakes rejected over budget")
         self._ctr_bulk_sheds = self.registry.counter(
             "bulk_sheds", "bulk sends shed at the bulk-lane bound")
+        self._ctr_hs_admitted = self.registry.counter(
+            "handshakes_admitted", "inbound ke_inits admitted past the budget")
+        #: wall latency of every initiated-handshake attempt (success or
+        #: failure — a timed-out attempt is exactly what the latency SLO
+        #: must count against the budget).  Default le-buckets include the
+        #: 2 s SLO threshold boundary, so the good/bad split is exact.
+        self._handshake_latency = self.registry.histogram(
+            "handshake_latency_s", "initiated handshake attempt latency (s)")
         self.registry.register_collector("queues", self._collect_queues)
         self.registry.register_collector("opcaches", self._collect_opcaches)
         #: responder-side concurrent-handshake budget (0 = unlimited):
@@ -317,6 +331,11 @@ class SecureMessaging:
             self._bfused = self._make_fused()
             self._attach_tuners()
             self._spawn_warmup()
+
+        # the SLO engine (obs/slo.py): burn-rate evaluation over the
+        # counters above — metrics()["slo"], the CLI /slo command, and the
+        # slo_burn flight trigger all read through it
+        self.slo = self._build_slo_engine()
 
         # per-peer protocol state.  raw_secrets values are bytearrays so
         # every drop path (rekey, reconnect, hot-swap) can zeroize in place
@@ -772,8 +791,14 @@ class SecureMessaging:
 
     async def _initiate_once(self, peer_id: str) -> str:
         """One handshake attempt -> "ok" | "timeout" | a typed failure."""
-        with obs_trace.span("handshake.initiate", peer=peer_id[:8],
-                            kem=self.kem.name, sig=self.signature.name) as sp:
+        # node_scope: one process may host many engines (swarm benches) —
+        # the span (and everything it parents) lands on THIS node's lane
+        # in a merged multi-node flame graph (tools/trace_merge.py)
+        with obs_trace.node_scope(self.node_id), \
+                obs_trace.span("handshake.initiate", peer=peer_id[:8],
+                               kem=self.kem.name,
+                               sig=self.signature.name) as sp, \
+                self._handshake_latency.time():
             status = await self._initiate_attempt(peer_id)
             sp.set_attr("status", status)
             return status
@@ -997,6 +1022,93 @@ class SecureMessaging:
                 out[key] = cache.stats()
         return out
 
+    def _build_slo_engine(self):
+        """Declarative SLOs over the counters this engine already keeps
+        (obs/slo.py; docs/observability.md "SLO specs"):
+
+        * ``handshake_p99`` — initiated attempts complete within
+          HANDSHAKE_SLO_THRESHOLD_S (timeouts count against the budget);
+        * ``gateway_shed_rate`` — inbound work admitted vs shed across
+          every admission boundary (connection / handshake / bulk lane);
+        * per-shard ``device_served_shard<i>`` — dispatch steps the shard
+          served from the device vs its cpu fallback (objective matches
+          the 0.9 bench gate, thresholds sized to its burn ceiling);
+        * ``breaker_availability`` — wall-time fraction the facade
+          breaker's device path was closed.
+
+        Probes read live objects that survive algorithm hot-swaps (the
+        scheduler's shard breakers, registry instruments, the node), so
+        the engine never needs re-wiring."""
+        from ..obs import slo as obs_slo
+
+        eng = obs_slo.SLOEngine(registry=self.registry)
+        eng.add(obs_slo.SLOSpec(
+            "handshake_p99", objective=0.99,
+            probe=obs_slo.latency_probe(self._handshake_latency,
+                                        HANDSHAKE_SLO_THRESHOLD_S),
+            description=("initiated handshake attempts complete within "
+                         f"{HANDSHAKE_SLO_THRESHOLD_S:g}s"),
+        ))
+        # session-admission SLI only, and SYMMETRIC per boundary: each
+        # side of a counted decision must have its twin — connection
+        # admissions (node.admitted) balance connection sheds
+        # (node.sheds), handshake admissions balance handshake sheds.
+        # Counting connection sheds against handshake admissions alone
+        # turned a reconnect wave of admitted-but-not-yet-handshaking
+        # peers into a ~100x false burn.  Bulk-lane sheds are
+        # per-MESSAGE and deliberately excluded — a bulk flood shedding
+        # 1% of 10k sends must not read as a 40x session-admission burn.
+        eng.add(obs_slo.SLOSpec(
+            "gateway_shed_rate", objective=0.99,
+            probe=obs_slo.counter_pair_probe(
+                lambda: (self._ctr_hs_admitted.value + self.node.admitted),
+                lambda: (self._ctr_handshake_sheds.value + self.node.sheds)),
+            description="admission decisions accepted vs shed (connection "
+                        "+ handshake boundaries)",
+            fast_burn=10.0, slow_burn=1.0,
+        ))
+        if self._scheduler is not None:
+            for sh in self._scheduler.shards:
+                eng.add(obs_slo.SLOSpec(
+                    f"device_served_shard{sh.index}", objective=0.9,
+                    probe=obs_slo.counter_pair_probe(
+                        lambda b=sh.breaker: b.device_trips,
+                        lambda b=sh.breaker: b.fallback_trips),
+                    description=("dispatch steps this shard served from "
+                                 "the device path (vs cpu fallback)"),
+                    # a full outage burns at 1/(1-0.9) = 10x: thresholds
+                    # must sit under that ceiling to ever fire
+                    fast_burn=5.0, slow_burn=2.0,
+                ))
+            eng.add(obs_slo.SLOSpec(
+                "breaker_availability", objective=0.95,
+                probe=obs_slo.breaker_availability_probe(self._queue_breaker),
+                description=("wall-time fraction the facade breaker's "
+                             "device path was closed"),
+                fast_burn=5.0, slow_burn=1.0,
+            ))
+        # evaluation rides the registry's collector hook so a gateway
+        # monitored ONLY through Prometheus scrapes still advances the
+        # burn windows, refreshes the slo_* gauges, and can fire the
+        # slo_burn flight trigger mid-incident — metrics()/ /slo are not
+        # the only readers that keep the engine honest.  The summary the
+        # collector returns is the scrape-able roll-up; the full report
+        # stays on metrics()["slo"].
+        def _collect_slo() -> dict[str, Any]:
+            specs = eng.evaluate()
+            return {
+                "alerts_total": sum(s["alerts"] for s in specs),
+                "alerting_count": sum(1 for s in specs if s["alerting"]),
+            }
+
+        self.registry.register_collector("slo_health", _collect_slo)
+        return eng
+
+    def slo_status(self) -> dict[str, Any]:
+        """Evaluate the SLO engine now and return its burn/budget report
+        (also served as ``metrics()["slo"]`` and the CLI ``/slo``)."""
+        return self.slo.status()
+
     def metrics(self) -> dict[str, Any]:
         """Operational counters: per-queue stats, aggregate dispatch trips,
         operand-cache hit rates, and trips-per-initiated-handshake — read
@@ -1032,6 +1144,7 @@ class SecureMessaging:
         # same compatibility contract as "resilience"
         out["gateway"] = {
             "max_peers": self.node.max_peers,
+            "connections_admitted": self.node.admitted,
             "connection_sheds": self.node.sheds,
             "busy_rejects": self.node.busy_rejects,
             "handshake_budget": self._hs_budget,
@@ -1042,6 +1155,13 @@ class SecureMessaging:
                          if self._autotuner is not None
                          else {"enabled": False}),
         }
+        # the SLO section (docs/observability.md): burn rates and budget
+        # remaining per objective — additive key, same compatibility
+        # contract as "resilience"/"gateway".  This evaluates the engine,
+        # as does the registry's "slo_health" collector on every
+        # snapshot/Prometheus scrape — whichever surface a gateway is
+        # watched through, the burn windows advance.
+        out["slo"] = self.slo.status()
         return out
 
     def _spawn_warmup(self, kem: bool = True, sig: bool = True) -> None:
@@ -1173,6 +1293,7 @@ class SecureMessaging:
             await self._reject(peer_id, message_id, RejectReason.BUSY)
             return
         self._responding += 1
+        self._ctr_hs_admitted.inc()  # the shed-rate SLO's "good" side
         try:
             with obs_trace.span("handshake.respond", peer=peer_id[:8],
                                 kem=self.kem.name):

@@ -83,6 +83,8 @@ commands:
   /metrics [prom]            security + operational metrics (queues, breaker,
                              trips, resilience counters; "prom" prints the
                              Prometheus text exposition instead)
+  /slo                       SLO burn-rate report: per-objective fast/slow
+                             burn, error budget remaining, alert state
   /trace [path]              export recent spans as chrome://tracing JSON
                              (load in chrome://tracing or ui.perfetto.dev)
   /flight [path]             dump the flight-recorder diagnostic bundle
@@ -312,6 +314,11 @@ class CLI:
                     },
                     indent=2, default=str,
                 ))
+        elif cmd == "/slo":
+            status = m.slo_status()
+            self.print(json.dumps(status, indent=2, default=str))
+            if status["alerting"]:
+                self.print(f"ALERTING: {', '.join(status['alerting'])}")
         elif cmd == "/trace":
             from .obs import trace as obs_trace
 

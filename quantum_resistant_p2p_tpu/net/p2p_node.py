@@ -92,6 +92,12 @@ class P2PNode:
         self.accept_backlog = accept_backlog
         #: inbound connections shed over the budget (the gateway gauge)
         self.sheds = 0
+        #: inbound connections ADMITTED at the same decision point — the
+        #: good side matching ``sheds``: an SLI that counts connection
+        #: sheds as bad must count connection admissions as good, or a
+        #: reconnect wave of peers that never handshake reads as a
+        #: near-total admission outage (docs/observability.md)
+        self.admitted = 0
         #: peers admitted but not yet registered (the hello reply awaits
         #: between the budget check and registration): counted against
         #: the budget so a storm of concurrent hellos cannot all pass the
@@ -311,6 +317,7 @@ class P2PNode:
         self._register_peer(
             peer_id, reader, writer, addr[0], int(hello.get("listen_port", addr[1]))
         )
+        self.admitted += 1
 
     async def _shed_inbound(self, writer: asyncio.StreamWriter, addr) -> None:
         """Refuse one over-budget inbound connection: typed ``__busy__``
@@ -372,8 +379,11 @@ class P2PNode:
             logger.warning("send to unknown peer %s", peer_id[:8])
             return False
         # the send rides the caller's span chain (a handshake's net sends
-        # interleave with its device dispatches in the flame graph)
-        with obs_trace.span("net.send", peer=peer_id[:8], msg_type=msg_type):
+        # interleave with its device dispatches in the flame graph); the
+        # node scope attributes it to THIS node even when one process
+        # hosts many (the swarm benches)
+        with obs_trace.node_scope(self.node_id), \
+                obs_trace.span("net.send", peer=peer_id[:8], msg_type=msg_type):
             # fault-injection boundary (faults/): a plan may drop, delay, or
             # corrupt this message BEFORE encoding — a no-op without a plan
             action, payload2 = _faults.net_send(self.node_id, peer_id, msg_type,
@@ -385,6 +395,13 @@ class P2PNode:
             else:
                 payload = payload2
             message = {"type": msg_type, **{k: _encode_value(v) for k, v in payload.items()}}
+            # cross-peer trace propagation: a bounded, ids-only ``_trace``
+            # field (the net.send span's own context, so the receiver's
+            # chain parents onto this exact send).  Correlation ids only —
+            # never payload data (qrflow: flow-secret-in-trace sink).
+            wire_ctx = obs_trace.wire_context()
+            if wire_ctx is not None:
+                message["_trace"] = wire_ctx
             try:
                 await self._send_frame(peer.writer, peer.write_lock, message)
                 return True
@@ -433,13 +450,15 @@ class P2PNode:
         try:
             while True:
                 flags, payload = await self._read_raw(peer.reader)
+                chunks = 0
                 if flags & _FLAG_CHUNK:
-                    message = self._reassemble(peer, payload)
-                    if message is None:
+                    reassembled = self._reassemble(peer, payload)
+                    if reassembled is None:
                         continue
+                    message, chunks = reassembled
                 else:
                     message = json.loads(payload)
-                await self._dispatch(peer.peer_id, message)
+                await self._dispatch(peer.peer_id, message, chunks)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         except Exception:
@@ -451,7 +470,11 @@ class P2PNode:
                 peer.writer.close()
                 self._fire_connection_event("disconnect", peer.peer_id)
 
-    def _reassemble(self, peer: _Peer, payload: bytes) -> dict | None:
+    def _reassemble(self, peer: _Peer, payload: bytes) -> tuple[dict, int] | None:
+        """-> (message, chunk_count) once complete, None while partial.
+        The chunk count rides into the dispatch's single ``net.recv`` span
+        (``chunks=`` attr): the LOGICAL message gets one span linked to its
+        handlers, not per-chunk spans with no edge to the dispatch."""
         stream_id, index, count = _CHUNK_HEADER.unpack_from(payload)
         data = payload[_CHUNK_HEADER.size :]
         entry = peer.reassembly.setdefault(stream_id, {"count": count, "chunks": {}})
@@ -460,17 +483,29 @@ class P2PNode:
             return None
         del peer.reassembly[stream_id]
         body = b"".join(entry["chunks"][i] for i in range(count))
-        return json.loads(body)
+        return json.loads(body), count
 
-    async def _dispatch(self, peer_id: str, message: dict) -> None:
+    async def _dispatch(self, peer_id: str, message: dict,
+                        chunks: int = 0) -> None:
         msg_type = message.get("type", "")
+        # cross-peer propagation: adopt the sender's bounded _trace context
+        # (validated — a malformed/hostile one is ignored and the receive
+        # roots a fresh trace exactly as before).  Popped FIRST so handlers
+        # never see the field: the wire protocol's payload surface is
+        # unchanged for them, hostile or not.
+        parent = obs_trace.adopt_wire_context(message.pop("_trace", None))
         decoded = {k: _decode_value(v) for k, v in message.items()}
         handlers = self._msg_handlers.get(msg_type, [])
         if not handlers:
             logger.debug("no handler for message type %r", msg_type)
-        # a fresh root per inbound message: handler work (and any crypto
-        # dispatches it enqueues) correlates under one receive trace
-        with obs_trace.span("net.recv", peer=peer_id[:8], msg_type=msg_type):
+        attrs = {"chunks": chunks} if chunks else {}
+        # one receive span per LOGICAL message: handler work (and any
+        # crypto dispatches it enqueues) correlates under it — and, with an
+        # adopted parent, under the SENDER's trace (the initiator's
+        # handshake and the responder's device dispatches become one tree)
+        with obs_trace.node_scope(self.node_id), \
+                obs_trace.span("net.recv", parent=parent, peer=peer_id[:8],
+                               msg_type=msg_type, **attrs):
             for h in list(handlers):
                 try:
                     await h(peer_id, decoded)

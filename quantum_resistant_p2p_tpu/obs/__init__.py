@@ -15,7 +15,11 @@ jax/cryptography dependency):
 * :mod:`.flight`  — bounded ring-buffer flight recorder of recent
   spans/events, redacted at record time with qrlint's secret-hygiene
   vocabulary, auto-dumping a diagnostic bundle on breaker-open /
-  quarantine / handshake-give-up / injected-fault triggers.
+  quarantine / handshake-give-up / injected-fault / SLO-burn triggers.
+* :mod:`.slo`     — declarative SLO specs evaluated on injectable clocks
+  over multi-window burn rates (fast 5 m / slow 1 h): error-budget
+  gauges in the registry, structured ``slo_burn`` flight events, and the
+  ``metrics()["slo"]`` / CLI ``/slo`` health report.
 
 Every layer above reports through here: the batch queue and breaker
 (provider/batched.py), the protocol engine (app/messaging.py), the
@@ -26,9 +30,10 @@ tools/swarm_bench.py).
 
 from __future__ import annotations
 
-from . import flight, metrics, trace  # noqa: F401
+from . import flight, metrics, slo, trace  # noqa: F401
 from .flight import FlightRecorder  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       LatencyHistogram, Registry)
+from .slo import SLOEngine, SLOSpec  # noqa: F401
 from .trace import (Span, SpanContext, Tracer, current,  # noqa: F401
-                    span, to_chrome_trace)
+                    node_scope, span, to_chrome_trace)

@@ -260,7 +260,8 @@ def _on_span(rec: dict[str, Any]) -> None:
         "span", name=rec["name"], trace_id=rec["trace_id"],
         span_id=rec["span_id"], parent_id=rec["parent_id"],
         t0=round(rec["t0"], 6), dur=round(rec["dur"], 6),
-        thread=rec["thread"], attrs=rec["attrs"],
+        thread=rec["thread"], node=rec.get("node") or None,
+        attrs=rec["attrs"],
     )
 
 

@@ -268,8 +268,8 @@ class Histogram(_Instrument):
 
 class LatencyHistogram:
     """Sliding-window percentile tracker over the last ``cap`` samples
-    (moved verbatim from ``utils/profiling.py``; the deprecation shim
-    there keeps old imports working).
+    (moved verbatim from ``utils/profiling.py`` in PR 5; the deprecation
+    shim at the old path has since been removed).
 
     A deque of recent samples, sorted on demand: percentiles reflect the
     CURRENT behavior of the system (a lifetime reservoir would keep

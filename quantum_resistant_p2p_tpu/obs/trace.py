@@ -26,6 +26,23 @@ recorder (obs/flight.py).  :func:`to_chrome_trace` renders a span list as
 chrome://tracing / Perfetto trace-event JSON, so a single traced handshake
 loads as a flame graph (the PR-2 four-trips-per-handshake budget, visible).
 
+**Cross-peer propagation** (the distributed half, docs/observability.md):
+:func:`wire_context` renders the current context as a bounded, ids-only
+dict the transport attaches to outbound frames (``_trace``), and
+:func:`adopt_wire_context` validates an inbound one from an UNTRUSTED
+peer — wrong shape, wrong types, over-long or non-token ids all yield
+``None`` (the receiver simply roots a fresh trace; a hostile context can
+never alter control flow, only correlation ids ever ride the wire).
+``QRP2P_TRACE_PROPAGATE=0`` disables both directions.
+
+**Node attribution**: span records carry a ``node`` field resolved from
+the ambient :func:`node_scope` (set by the transport around sends and
+handler dispatch) or inherited from the parent context, so one process
+hosting many P2P nodes (the swarm benches) still attributes every span to
+the node that did the work — the lane key ``tools/trace_merge.py`` groups
+merged multi-node flame graphs by.  Contexts adopted from the wire carry
+NO node: the responder's spans stay on the responder's lane.
+
 Span attributes are DIAGNOSTIC METADATA — op labels, batch sizes, peer-id
 prefixes, states.  Key material must never be passed as an attribute:
 qrflow's ``flow-secret-in-trace`` sink rule enforces this statically, and
@@ -36,9 +53,13 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import json
+import os
+import re
 import threading
 import time
 from collections import deque
+from pathlib import Path
 from typing import Any, Callable
 
 #: the current span context of this task/thread (None outside any span).
@@ -47,15 +68,36 @@ _CURRENT: contextvars.ContextVar["SpanContext | None"] = contextvars.ContextVar(
     "qrp2p_obs_span", default=None
 )
 
+#: the node this task/thread is doing work FOR (multi-node processes:
+#: the swarm benches host hub + thousands of peers in one interpreter)
+_NODE: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "qrp2p_obs_node", default=None
+)
+
+TRACE_PROPAGATE_ENV = "QRP2P_TRACE_PROPAGATE"
+
+#: wire ``_trace`` field hygiene: ids are short opaque tokens.  Anything
+#: longer or outside this alphabet is hostile or corrupt — ignored, so a
+#: peer can never inject log/trace-file noise through correlation ids.
+WIRE_ID_MAX = 64
+#: \Z, not $ — $ matches before a trailing newline, which would wave
+#: "evil\n" (and 65-byte "a"*64+"\n") through the hostile-input gate
+_WIRE_ID_RE = re.compile(r"^[A-Za-z0-9_.:\-]{1,64}\Z")
+
 
 class SpanContext:
-    """Immutable correlation handle: pass it across executor/thread hops."""
+    """Immutable correlation handle: pass it across executor/thread hops.
 
-    __slots__ = ("trace_id", "span_id")
+    ``node`` is the attribution lane of the span that minted the context
+    (``None`` for contexts adopted from the wire — a remote parent must
+    not pull the local child onto the remote node's lane)."""
 
-    def __init__(self, trace_id: str, span_id: str):
+    __slots__ = ("trace_id", "span_id", "node")
+
+    def __init__(self, trace_id: str, span_id: str, node: str | None = None):
         self.trace_id = trace_id
         self.span_id = span_id
+        self.node = node
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"SpanContext({self.trace_id}/{self.span_id})"
@@ -92,11 +134,19 @@ class Tracer:
     """
 
     def __init__(self, cap: int = 4096,
-                 clock: Callable[[], float] | None = None):
+                 clock: Callable[[], float] | None = None, tag: str = ""):
         self._lock = threading.Lock()
         self._spans: deque[dict[str, Any]] = deque(maxlen=cap)
         self._listeners: list[Callable[[dict[str, Any]], None]] = []
         self._next_id = 0
+        #: id prefix disambiguating ids minted by DIFFERENT tracers inside
+        #: one merged multi-node trace: every process's tracer counts from
+        #: 1, so without a tag two processes' span/trace ids collide and
+        #: tools/trace_merge.py would mislink parent edges.  "" (the
+        #: default) keeps single-tracer exports byte-stable for goldens;
+        #: the process-wide TRACER uses a pid+random tag (pid alone
+        #: collides across containers, where every node is pid 1).
+        self._tag = tag
         if clock is None:
             epoch = time.perf_counter()
             clock = lambda: time.perf_counter() - epoch  # noqa: E731
@@ -107,7 +157,7 @@ class Tracer:
     def _new_id(self) -> str:
         with self._lock:
             self._next_id += 1
-            return f"{self._next_id:08x}"
+            return f"{self._tag}{self._next_id:08x}"
 
     # -- span lifecycle -------------------------------------------------------
 
@@ -129,7 +179,13 @@ class Tracer:
         else:
             trace_id = parent.trace_id
             parent_id = parent.span_id
-        ctx = SpanContext(trace_id, self._new_id())
+        # node attribution: the ambient scope (set by the transport around
+        # sends/dispatch) wins; an explicitly handed-off parent carries its
+        # creator's node across the executor/thread edges contextvars miss
+        node = _NODE.get()
+        if node is None and parent is not None:
+            node = parent.node
+        ctx = SpanContext(trace_id, self._new_id(), node)
         sp = Span(name, ctx, parent_id, dict(attrs))
         token = _CURRENT.set(ctx)
         t0 = self._clock()
@@ -155,6 +211,7 @@ class Tracer:
             "t0": t0,
             "dur": dur,
             "thread": threading.current_thread().name,
+            "node": sp.context.node or "",
             "attrs": attrs,
         }
         with self._lock:
@@ -184,6 +241,12 @@ class Tracer:
         with self._lock:
             self._spans.clear()
 
+    def now(self) -> float:
+        """The tracer's current clock reading — the anchor
+        :func:`export_spans` pairs with wall time so dumps from different
+        processes can be aligned onto one merged timeline."""
+        return self._clock()
+
 
 def current() -> SpanContext | None:
     """The ambient span context — capture on the loop side, pass as
@@ -191,8 +254,86 @@ def current() -> SpanContext | None:
     return _CURRENT.get()
 
 
-#: process-wide default tracer: instrumentation sites record here
-TRACER = Tracer()
+@contextlib.contextmanager
+def node_scope(node_id: str):
+    """Attribute spans opened inside the block (and tasks/timers scheduled
+    from it — contextvars copy at scheduling time) to ``node_id``.  The
+    transport enters this around sends and inbound handler dispatch."""
+    token = _NODE.set(node_id)
+    try:
+        yield
+    finally:
+        _NODE.reset(token)
+
+
+def current_node() -> str | None:
+    """The ambient node attribution (None outside any :func:`node_scope`)."""
+    return _NODE.get()
+
+
+# -- cross-peer wire propagation ----------------------------------------------
+
+
+def propagation_enabled() -> bool:
+    """Trace-context propagation opt-out (``QRP2P_TRACE_PROPAGATE=0``).
+    Read at call time so a live process can be flipped."""
+    return os.environ.get(TRACE_PROPAGATE_ENV, "1") != "0"
+
+
+def wire_context(**extra: str) -> dict[str, str] | None:
+    """The current span context as the bounded, ids-only ``_trace`` dict
+    the transport attaches to outbound frames — ``None`` when there is no
+    current span or propagation is disabled.
+
+    ``extra`` admits additional short PUBLIC correlation tokens (e.g. a
+    bench run id); non-string or over-long values are dropped, and the
+    receiver ignores everything but the two ids anyway.  ONLY correlation
+    ids ever ride the wire: qrflow treats this function as a
+    ``flow-secret-in-trace`` sink, so key material reaching any argument
+    is a static-analysis error before it is a runtime one."""
+    if not propagation_enabled():
+        return None
+    ctx = _CURRENT.get()
+    if ctx is None:
+        return None
+    out = {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+    for k, v in extra.items():
+        if isinstance(v, str) and _WIRE_ID_RE.match(v):
+            out[k] = v
+    return out
+
+
+def adopt_wire_context(obj: Any) -> SpanContext | None:
+    """Validate an inbound ``_trace`` field from an UNTRUSTED peer into a
+    parent :class:`SpanContext` — or ``None``, which simply roots a fresh
+    local trace.  Hostile input must never alter control flow: anything
+    but a dict of two short token-charset string ids is ignored (wrong
+    type, missing/extra nesting, oversized or non-token ids).  The
+    adopted context carries no ``node``: the remote parent must not pull
+    local spans onto the remote peer's lane."""
+    if not propagation_enabled():
+        return None
+    if not isinstance(obj, dict):
+        return None
+    trace_id = obj.get("trace_id")
+    span_id = obj.get("span_id")
+    if not (isinstance(trace_id, str) and isinstance(span_id, str)):
+        return None
+    if not (_WIRE_ID_RE.match(trace_id) and _WIRE_ID_RE.match(span_id)):
+        return None
+    return SpanContext(trace_id, span_id)
+
+
+#: process-wide default tracer: instrumentation sites record here.  The
+#: tag keeps ids from concurrently-traced processes disjoint when their
+#: span dumps meet in one merged document (trace_merge): the pid half
+#: makes ids greppable back to the dump's ``pid`` field, the random half
+#: disambiguates processes whose pids collide — containers typically ALL
+#: run their node as pid 1, and trace_merge's span index is
+#: first-occurrence-wins, so pid alone would mislink cross-node edges in
+#: exactly the deployment shape the merge exists for.
+TRACER = Tracer(
+    tag=f"{os.getpid() & 0xFFFF:04x}{os.urandom(4).hex()}")
 
 
 def span(name: str, parent: SpanContext | None = None, **attrs: Any):
@@ -214,6 +355,7 @@ def to_chrome_trace(records: list[dict[str, Any]]) -> dict[str, Any]:
     events: list[dict[str, Any]] = []
     for rec in records:
         tid = tids.setdefault(rec["thread"], len(tids) + 1)
+        node = rec.get("node") or ""
         events.append({
             "name": rec["name"],
             "ph": "X",
@@ -226,6 +368,7 @@ def to_chrome_trace(records: list[dict[str, Any]]) -> dict[str, Any]:
                 "trace_id": rec["trace_id"],
                 "span_id": rec["span_id"],
                 "parent_id": rec["parent_id"],
+                **({"node": node} if node else {}),
                 **rec["attrs"],
             },
         })
@@ -237,11 +380,45 @@ def to_chrome_trace(records: list[dict[str, Any]]) -> dict[str, Any]:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
+SPAN_DUMP_FORMAT = "qrp2p-spans"
+SPAN_DUMP_VERSION = 1
+
+
+def span_dump(node: str = "", tracer: Tracer | None = None,
+              records: list[dict[str, Any]] | None = None) -> dict[str, Any]:
+    """One node's finished spans as a merge-ready dump document.
+
+    Beyond the records themselves it carries per-node PROCESS metadata —
+    node name, pid, and a (wall, mono) clock anchor pair taken at dump
+    time — so ``tools/trace_merge.py`` can put each node on its own
+    process lane and align dumps from DIFFERENT processes (each tracer's
+    clock is relative to its own creation) onto one wall-clock timeline.
+    """
+    tracer = tracer or TRACER
+    return {
+        "format": SPAN_DUMP_FORMAT,
+        "version": SPAN_DUMP_VERSION,
+        "node": node,
+        "pid": os.getpid(),
+        "wall_anchor": time.time(),
+        "mono_anchor": tracer.now(),
+        "spans": records if records is not None else tracer.snapshot(),
+    }
+
+
+def export_spans(path: str | Path, node: str = "",
+                 tracer: Tracer | None = None) -> dict[str, Any]:
+    """Write :func:`span_dump` as JSON; returns the dump document."""
+    doc = span_dump(node=node, tracer=tracer)
+    Path(path).write_text(json.dumps(doc))
+    return doc
+
+
 @contextlib.contextmanager
 def device_trace(log_dir: str = "/tmp/qrp2p_trace"):
     """Profile everything inside the block with ``jax.profiler``; view with
-    TensorBoard.  (Moved from ``utils.profiling``; a deprecation shim keeps
-    the old import path working.)"""
+    TensorBoard.  (Moved here from ``utils.profiling`` in PR 5; the
+    deprecation shim at the old path has since been removed.)"""
     import jax
 
     jax.profiler.start_trace(log_dir)

@@ -232,6 +232,12 @@ class Breaker(CoalescingHub):
         self.fallback_trips = 0
         self._open_until = 0.0
         self._probe_in_flight = False
+        #: cumulative seconds spent NOT closed (open/half-open/quarantined)
+        #: plus the start of the current degraded stretch — the "breaker
+        #: open time" SLO feed (obs/slo.py): budget burn is the fraction of
+        #: wall time the device path was unavailable
+        self._degraded_s = 0.0
+        self._degraded_since: float | None = None
         self._executor = None
         self._warmup_executor = None
         # queues sharing this breaker coalesce their flushes (CoalescingHub)
@@ -269,6 +275,13 @@ class Breaker(CoalescingHub):
             log = logging.getLogger(__name__)
             old = self.state
             self.state = new
+            # degraded-time ledger (the breaker-availability SLO feed)
+            now = time.monotonic()
+            if old == "closed" and new != "closed":
+                self._degraded_since = now
+            elif new == "closed" and self._degraded_since is not None:
+                self._degraded_s += now - self._degraded_since
+                self._degraded_since = None
             if new == "open":
                 self.opens += 1
                 log.warning(
@@ -334,6 +347,17 @@ class Breaker(CoalescingHub):
                 self._set_state(
                     "open", "canary probe failed" if escalate else "tripped"
                 )
+
+    def degraded_seconds(self) -> float:
+        """Cumulative wall seconds this breaker spent NOT closed (open,
+        half-open, or quarantined), the live stretch included — the
+        numerator of the availability SLO (obs/slo.py): ``bad time /
+        total time`` is the burn of the "device path available" objective."""
+        with self._lock:
+            total = self._degraded_s
+            if self._degraded_since is not None:
+                total += time.monotonic() - self._degraded_since
+            return total
 
     def quarantine(self, why: str) -> None:
         """Pin the fallback for the process lifetime (device-health gate:

@@ -1,4 +1,7 @@
-"""Host-side utilities: profiling/tracing hooks, shared helpers."""
+"""Host-side utilities: benchmarking helpers, CTR-DRBG, shared helpers.
+(The profiling/tracing hooks moved to ``quantum_resistant_p2p_tpu.obs``
+in PR 5; the deprecation shim that bridged the old import path has been
+removed.)"""
 
 
 def next_pow2(n: int) -> int:

@@ -88,6 +88,10 @@ def test_trace_flight_and_prometheus_commands(run, tmp_path):
         assert "qrp2p_" in a_out.getvalue()
         assert await a.handle("/metrics")
         assert '"operational"' in a_out.getvalue()
+        assert await a.handle("/slo")
+        out = a_out.getvalue()
+        assert '"handshake_p99"' in out and '"budget_remaining"' in out
+        assert "ALERTING" not in out  # a fresh node has burned nothing
         assert not await a.handle("/quit")
 
     run(main())

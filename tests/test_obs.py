@@ -149,18 +149,16 @@ def test_prometheus_text_golden():
     )
 
 
-def test_latency_histogram_shim_still_importable():
-    """utils/profiling.py is a deprecation shim over the obs home."""
-    with pytest.warns(DeprecationWarning):
-        import importlib
-
-        import quantum_resistant_p2p_tpu.utils.profiling as prof
-        importlib.reload(prof)
-    assert prof.LatencyHistogram is obs_metrics.LatencyHistogram
-    assert prof.device_trace is obs_trace.device_trace
-    h = prof.LatencyHistogram()
+def test_profiling_shim_is_gone():
+    """PR 5 promised the utils/profiling deprecation shim would be removed
+    once nothing imported it; this pins the removal (and that the real
+    homes still serve the moved objects)."""
+    with pytest.raises(ModuleNotFoundError):
+        import quantum_resistant_p2p_tpu.utils.profiling  # noqa: F401
+    h = obs_metrics.LatencyHistogram()
     h.record(0.5)
     assert h.summary()["count"] == 1 and h.percentile(50) == 0.5
+    assert callable(obs_trace.device_trace)
 
 
 # -- span propagation ---------------------------------------------------------
@@ -471,6 +469,345 @@ def test_metrics_parity_without_batching():
     assert out["backend"] == "cpu" and out["batching"] is False
     assert LEGACY_TRIPS_KEYS <= set(out["handshake_trips"])
     assert "kem_queue" not in out  # batching off: same shape as before obs/
+
+
+# -- cross-peer trace propagation ---------------------------------------------
+
+
+class ToyKEM:
+    """Deterministic hash-based toy KEM (the faults-suite pattern): lets
+    the two-node propagation e2e run the REAL protocol in milliseconds."""
+
+    name = "TOY-KEM"
+    display_name = "TOY-KEM"
+    public_key_len = 32
+    secret_key_len = 32
+    ciphertext_len = 32
+    shared_secret_len = 32
+    backend = "cpu"
+
+    def generate_keypair(self):
+        sk = os.urandom(32)
+        return hashlib.sha256(b"pk" + sk).digest(), sk
+
+    def encapsulate(self, public_key):
+        ct = os.urandom(32)
+        return ct, hashlib.sha256(public_key + ct).digest()
+
+    def decapsulate(self, secret_key, ciphertext):
+        pk = hashlib.sha256(b"pk" + secret_key).digest()
+        return hashlib.sha256(pk + ciphertext).digest()
+
+
+class ToySig:
+    name = "TOY-SIG"
+    display_name = "TOY-SIG"
+    public_key_len = 32
+    secret_key_len = 32
+    signature_len = 32
+    backend = "cpu"
+
+    def generate_keypair(self):
+        sk = os.urandom(32)
+        return hashlib.sha256(b"pk" + sk).digest(), sk
+
+    def sign(self, secret_key, message):
+        pk = hashlib.sha256(b"pk" + secret_key).digest()
+        return hashlib.sha256(b"sig" + pk + message).digest()
+
+    def verify(self, public_key, message, signature):
+        return hmac.compare_digest(
+            signature, hashlib.sha256(b"sig" + public_key + message).digest())
+
+
+async def _toy_pair():
+    a_node = P2PNode(node_id="alice", host="127.0.0.1", port=0)
+    b_node = P2PNode(node_id="bob", host="127.0.0.1", port=0)
+    await a_node.start()
+    await b_node.start()
+    kw = dict(kem=ToyKEM(), signature=ToySig(), symmetric=StdlibAEAD())
+    a = SecureMessaging(a_node, **kw)
+    b = SecureMessaging(b_node, **kw)
+    assert await a_node.connect_to_peer("127.0.0.1", b_node.port) == "bob"
+    for _ in range(100):
+        if b_node.is_connected("alice"):
+            break
+        await asyncio.sleep(0.01)
+    return a, b
+
+
+def _spans_by_name(spans):
+    out = {}
+    for s in spans:
+        out.setdefault(s["name"], []).append(s)
+    return out
+
+
+def test_wire_context_validation_rejects_hostile_input(monkeypatch):
+    """adopt_wire_context: peers are untrusted — anything but a dict of
+    two short token-charset string ids is ignored, never an exception or
+    a control-flow change."""
+    tr = Tracer()
+    with tr.span("root"):
+        ctx = obs_trace.wire_context()
+        assert set(ctx) == {"trace_id", "span_id"}
+        # extra kwargs: only short token strings survive; qrflow polices
+        # the surface statically so nothing tainted can reach here
+        rich = obs_trace.wire_context(run="r1", huge="x" * 65, n=3)  # type: ignore[arg-type]
+        assert rich["run"] == "r1" and "huge" not in rich and "n" not in rich
+    good = obs_trace.adopt_wire_context(ctx)
+    assert good is not None and good.trace_id == ctx["trace_id"]
+    assert good.node is None  # remote parents never claim a local lane
+    for hostile in (
+        None, 7, "t1/s1", [], {"trace_id": "a"},                  # shapes
+        {"trace_id": 5, "span_id": "b"},                          # types
+        {"trace_id": "a" * 65, "span_id": "b"},                   # oversize
+        {"trace_id": "ok", "span_id": "bad\nid"},                 # charset
+        {"trace_id": "evil\n", "span_id": "b"},                   # $-anchor hole
+        {"trace_id": "a" * 64 + "\n", "span_id": "b"},            # 65B via \n
+        {"trace_id": "ok", "span_id": "sp", "extra": object()},   # junk rides
+    ):
+        adopted = obs_trace.adopt_wire_context(hostile)
+        if isinstance(hostile, dict) and hostile.get("span_id") == "sp":
+            assert adopted is not None  # extra keys ignored, ids adopted
+        else:
+            assert adopted is None, hostile
+    monkeypatch.setenv("QRP2P_TRACE_PROPAGATE", "0")
+    with tr.span("root2"):
+        assert obs_trace.wire_context() is None
+    assert obs_trace.adopt_wire_context(ctx) is None
+
+
+async def _handshake_spans(a, b, *needed):
+    """Run one a->b handshake and snapshot spans once ``needed`` names
+    have all been recorded (the responder's tail work is async)."""
+    obs_trace.TRACER.reset()
+    assert await a.initiate_key_exchange("bob")
+    spans = []
+    for _ in range(200):
+        spans = obs_trace.TRACER.snapshot()
+        if all(any(s["name"] == n for s in spans) for n in needed):
+            break
+        await asyncio.sleep(0.01)
+    await a.node.stop()
+    await b.node.stop()
+    return spans
+
+
+def test_two_node_handshake_joins_one_trace(run, monkeypatch):
+    """Acceptance (ISSUE 10): initiator and responder handshake spans
+    share ONE trace_id, and the responder's chain parents onto the
+    initiator's net.send span via the propagated wire context."""
+    monkeypatch.setattr(messaging_mod, "KEY_EXCHANGE_TIMEOUT", 10.0)
+
+    async def main():
+        a, b = await _toy_pair()
+        return await _handshake_spans(
+            a, b, "handshake.initiate", "handshake.respond",
+            "handshake.confirm")
+
+    spans = run(main())
+    by_name = _spans_by_name(spans)
+    (initiate,) = by_name["handshake.initiate"]
+    (respond,) = by_name["handshake.respond"]
+    (confirm,) = by_name["handshake.confirm"]
+    # one causal chain across both peers
+    assert respond["trace_id"] == initiate["trace_id"]
+    assert confirm["trace_id"] == initiate["trace_id"]
+    # node attribution: each side's protocol spans sit on its own lane
+    assert initiate["node"] == "alice"
+    assert respond["node"] == "bob"
+    # the responder chain parents onto the initiator's ke_init net.send
+    by_id = {s["span_id"]: s for s in spans}
+    recv_init = by_id[respond["parent_id"]]
+    assert recv_init["name"] == "net.recv"
+    assert recv_init["attrs"]["msg_type"] == "ke_init"
+    send_init = by_id[recv_init["parent_id"]]
+    assert send_init["name"] == "net.send"
+    assert send_init["attrs"]["msg_type"] == "ke_init"
+    assert send_init["node"] == "alice"
+    # every net.recv of the exchange adopted a remote parent (no orphan
+    # re-roots anywhere in the 5-message chain)
+    ke_recvs = [s for s in by_name["net.recv"]
+                if s["attrs"]["msg_type"].startswith("ke_")]
+    assert ke_recvs and all(s["trace_id"] == initiate["trace_id"]
+                            and s["parent_id"] for s in ke_recvs)
+
+
+def test_propagation_optout_restores_disjoint_traces(run, monkeypatch):
+    """QRP2P_TRACE_PROPAGATE=0: no ``_trace`` field rides any frame
+    (wire-identical to the pre-propagation protocol) and the two sides'
+    traces are disjoint again."""
+    monkeypatch.setenv("QRP2P_TRACE_PROPAGATE", "0")
+    monkeypatch.setattr(messaging_mod, "KEY_EXCHANGE_TIMEOUT", 10.0)
+    sent_messages = []
+    orig = P2PNode._send_frame
+
+    async def spy(self, writer, lock, message):
+        sent_messages.append(message)
+        return await orig(self, writer, lock, message)
+
+    monkeypatch.setattr(P2PNode, "_send_frame", spy)
+
+    async def main():
+        a, b = await _toy_pair()
+        return await _handshake_spans(
+            a, b, "handshake.initiate", "handshake.respond")
+
+    spans = run(main())
+    assert all("_trace" not in m for m in sent_messages)
+    by_name = _spans_by_name(spans)
+    (initiate,) = by_name["handshake.initiate"]
+    (respond,) = by_name["handshake.respond"]
+    assert respond["trace_id"] != initiate["trace_id"]
+    assert all(s["parent_id"] is None for s in by_name["net.recv"])
+
+
+def test_propagation_on_attaches_ids_only_field(run, monkeypatch):
+    """With propagation ON (the default), ke_* frames carry exactly the
+    bounded ids-only ``_trace`` dict — and handlers never see it."""
+    monkeypatch.setattr(messaging_mod, "KEY_EXCHANGE_TIMEOUT", 10.0)
+    sent_messages = []
+    seen_by_handler = []
+    orig = P2PNode._send_frame
+
+    async def spy(self, writer, lock, message):
+        sent_messages.append(message)
+        return await orig(self, writer, lock, message)
+
+    monkeypatch.setattr(P2PNode, "_send_frame", spy)
+
+    async def main():
+        a, b = await _toy_pair()
+
+        async def on_init(peer_id, msg):
+            seen_by_handler.append(msg)
+
+        b.node.register_message_handler("ke_init", on_init)
+        assert await a.initiate_key_exchange("bob")
+        await a.node.stop()
+        await b.node.stop()
+
+    run(main())
+    traced = [m for m in sent_messages if "_trace" in m]
+    assert traced, "no frame carried the propagated context"
+    for m in traced:
+        assert set(m["_trace"]) == {"trace_id", "span_id"}
+        assert all(isinstance(v, str) and len(v) <= obs_trace.WIRE_ID_MAX
+                   for v in m["_trace"].values())
+    assert seen_by_handler and all("_trace" not in m for m in seen_by_handler)
+
+
+def test_chunked_message_gets_one_recv_span_with_chunk_attr(run):
+    """Satellite (ISSUE 10): a reassembled chunked message carries ONE
+    net.recv span for the logical message, with a ``chunks=`` attr, still
+    parented on the sender's propagated context."""
+
+    async def main():
+        a = P2PNode(node_id="alice", host="127.0.0.1", port=0)
+        b = P2PNode(node_id="bob", host="127.0.0.1", port=0)
+        await a.start()
+        await b.start()
+        assert await a.connect_to_peer("127.0.0.1", b.port) == "bob"
+        for _ in range(100):
+            if b.is_connected("alice"):
+                break
+            await asyncio.sleep(0.01)
+        a.chunk_size = 4096
+        got = asyncio.Event()
+
+        async def on_big(peer_id, msg):
+            got.set()
+
+        b.register_message_handler("big", on_big)
+        obs_trace.TRACER.reset()
+        with obs_trace.TRACER.span("caller"):
+            assert await a.send_message("bob", "big", data=bytes(40_000))
+        await asyncio.wait_for(got.wait(), 10)
+        spans = obs_trace.TRACER.snapshot()
+        await a.stop()
+        await b.stop()
+        return spans
+
+    spans = run(main())
+    by_name = _spans_by_name(spans)
+    recvs = [s for s in by_name.get("net.recv", [])
+             if s["attrs"]["msg_type"] == "big"]
+    assert len(recvs) == 1, recvs  # one span per LOGICAL message
+    (recv,) = recvs
+    (send,) = [s for s in by_name["net.send"]
+               if s["attrs"]["msg_type"] == "big"]
+    assert recv["attrs"]["chunks"] >= 2  # ~40KB over 4KiB chunks
+    assert recv["parent_id"] == send["span_id"]
+    assert recv["trace_id"] == send["trace_id"]
+
+
+def test_merged_two_node_trace_has_process_lanes_and_flow_edges(run, monkeypatch):
+    """Acceptance: the merged chrome document shows both nodes as separate
+    process lanes under a single trace id, with cross-node flow arrows on
+    the propagated parent edges (tools/trace_merge.py)."""
+    from tools import trace_merge
+
+    monkeypatch.setattr(messaging_mod, "KEY_EXCHANGE_TIMEOUT", 10.0)
+
+    async def main():
+        a, b = await _toy_pair()
+        return await _handshake_spans(
+            a, b, "handshake.initiate", "handshake.respond",
+            "handshake.confirm")
+
+    spans = run(main())
+    doc = trace_merge.merge([obs_trace.span_dump(records=spans)])
+    other = doc["otherData"]
+    assert {"alice", "bob"} <= set(other["merged_nodes"])
+    assert other["cross_node_edges"] >= 2  # ke_init + at least one reply
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    procs = {e["name"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert procs  # process_name metadata present
+    pid_by_node = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "M" and e["name"] == "process_name":
+            pid_by_node[e["args"]["name"]] = e["pid"]
+    hs = {e["name"]: e for e in xs if e["name"].startswith("handshake.")}
+    assert hs["handshake.initiate"]["pid"] == pid_by_node["alice"]
+    assert hs["handshake.respond"]["pid"] == pid_by_node["bob"]
+    assert (hs["handshake.respond"]["args"]["trace_id"]
+            == hs["handshake.initiate"]["args"]["trace_id"])
+    flows = [e for e in doc["traceEvents"] if e.get("ph") in ("s", "f")]
+    assert flows and len(flows) == 2 * other["cross_node_edges"]
+    # loadable JSON round-trip
+    json.loads(json.dumps(doc))
+
+
+def test_trace_merge_aligns_multi_process_dumps(tmp_path):
+    """Dumps from different processes (distinct clock epochs, distinct
+    tracer tags) merge onto one timeline with parent edges intact."""
+    from tools import trace_merge
+
+    ta = Tracer(clock=_fake_clock(0.5), tag="aaaa")
+    with obs_trace.node_scope("alice"), ta.span("net.send"):
+        wire = obs_trace.wire_context()
+    da = obs_trace.span_dump(node="alice", tracer=ta)
+    da["wall_anchor"], da["mono_anchor"] = 1000.0, da["mono_anchor"]
+
+    tb = Tracer(clock=_fake_clock(0.5), tag="bbbb")
+    parent = obs_trace.adopt_wire_context(wire)
+    with obs_trace.node_scope("bob"), tb.span("net.recv", parent=parent):
+        pass
+    db = obs_trace.span_dump(node="bob", tracer=tb)
+    db["wall_anchor"], db["mono_anchor"] = 1002.0, db["mono_anchor"]
+
+    (tmp_path / "a.json").write_text(json.dumps(da))
+    (tmp_path / "b.json").write_text(json.dumps(db))
+    doc = trace_merge.merge_files([tmp_path / "a.json", tmp_path / "b.json"])
+    assert doc["otherData"]["merged_nodes"] == ["alice", "bob"]
+    assert doc["otherData"]["cross_node_edges"] == 1
+    xs = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+    # bob's dump anchors 2 wall-seconds later: its span lands later on the
+    # merged timeline even though both tracers' raw clocks started at ~0
+    assert xs["net.recv"]["ts"] > xs["net.send"]["ts"]
+    assert xs["net.recv"]["pid"] != xs["net.send"]["pid"]
 
 
 # -- end to end: the traced warm handshake -----------------------------------

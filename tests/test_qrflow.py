@@ -366,6 +366,48 @@ def test_sink_trace_trigger_clean_suppressed():
     assert [s.rule for s in suppressed] == ["flow-secret-in-trace"]
 
 
+def test_sink_wire_propagation_trigger_clean_suppressed():
+    """flow-secret-in-trace over the cross-peer propagation surface
+    (obs/trace.py wire_context/adopt_wire_context): whatever reaches these
+    functions rides the network in the ``_trace`` frame field, so only
+    correlation ids may ever flow in."""
+    assert rule_ids(
+        """
+        def f(obs_trace, kem, a, b, msg):
+            ss = kem.decapsulate(a, b)
+            msg["_trace"] = obs_trace.wire_context(session=ss)
+        """
+    ) == ["flow-secret-in-trace"]
+    # the adopt side is the same surface (a tainted value fed to the
+    # validator would still transit taint into correlation state)
+    assert rule_ids(
+        """
+        def g(obs_trace, secret_key):
+            return obs_trace.adopt_wire_context(secret_key)
+        """
+    ) == ["flow-secret-in-trace"]
+    # the shipped shape: ids-only attachment, public inbound field
+    assert rule_ids(
+        """
+        def f(obs_trace, msg, message):
+            ctx = obs_trace.wire_context()
+            if ctx is not None:
+                msg["_trace"] = ctx
+            parent = obs_trace.adopt_wire_context(message.pop("_trace", None))
+            return parent
+        """
+    ) == []
+    findings, suppressed = lint(
+        """
+        def f(obs_trace, kem, a, b, msg):
+            ss = kem.decapsulate(a, b)
+            msg["_trace"] = obs_trace.wire_context(tag=ss)  # qrlint: disable=flow-secret-in-trace — fixture: pinned KAT digest used as a run tag, not live key material
+        """
+    )
+    assert not findings
+    assert [s.rule for s in suppressed] == ["flow-secret-in-trace"]
+
+
 def test_sink_branch_trigger_and_clean():
     ids = rule_ids(
         """

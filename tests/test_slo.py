@@ -1,0 +1,436 @@
+"""obs/slo.py — SLO / burn-rate alert engine acceptance suite.
+
+Covers: window-delta burn math on injected-clock timelines, the
+both-windows alert condition, alert edges (structured ``slo_burn`` flight
+events through the auto-dump machinery, rate-limited one-time WARNINGs,
+recovery events), budget gauges in the registry, the probe builders
+(latency-histogram split, counter pairs, breaker degraded-time), the
+``SecureMessaging.metrics()["slo"]`` section, and the seeded chaos
+acceptance: a breaker storm deterministically fires the fast-burn alert
+and the flight dump tells the story event by event.
+
+Stdlib-only; runs on minimal images.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+import pytest
+
+from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging
+from quantum_resistant_p2p_tpu.faults import FaultPlan, FaultRule
+from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode
+from quantum_resistant_p2p_tpu.obs import flight as obs_flight
+from quantum_resistant_p2p_tpu.obs import slo as obs_slo
+from quantum_resistant_p2p_tpu.obs.flight import FlightRecorder
+from quantum_resistant_p2p_tpu.obs.metrics import Histogram, Registry
+from quantum_resistant_p2p_tpu.obs.slo import (SLOEngine, SLOSpec,
+                                               breaker_availability_probe,
+                                               counter_pair_probe,
+                                               latency_probe)
+from quantum_resistant_p2p_tpu.provider.batched import Breaker, OpQueue
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+class _Clock:
+    """Settable deterministic clock."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _engine(clock, registry=None, **kw):
+    return SLOEngine(registry=registry, clock=clock, **kw)
+
+
+# -- spec validation ----------------------------------------------------------
+
+
+def test_spec_validation():
+    probe = lambda: (0.0, 0.0)  # noqa: E731
+    with pytest.raises(ValueError):
+        SLOSpec("x", objective=1.0, probe=probe)
+    with pytest.raises(ValueError):
+        SLOSpec("x", objective=0.0, probe=probe)
+    with pytest.raises(ValueError):
+        SLOSpec("x", objective=0.99, probe=probe,
+                fast_window_s=600.0, slow_window_s=600.0)
+
+
+# -- burn math ----------------------------------------------------------------
+
+
+def test_burn_rates_over_fast_and_slow_windows():
+    """Errors concentrated in the recent past burn the FAST window hard
+    while the slow window dilutes them over its longer baseline."""
+    clock = _Clock()
+    good, bad = [0.0], [0.0]
+    eng = _engine(clock)
+    eng.add(SLOSpec("svc", objective=0.99,
+                    probe=lambda: (good[0], bad[0]),
+                    fast_window_s=300.0, slow_window_s=3600.0))
+    # one clean hour: 10 good/s, no errors
+    for _ in range(60):
+        clock.t += 60.0
+        good[0] += 600.0
+        eng.tick()
+    (s,) = eng.evaluate()
+    assert s["burn_fast"] == 0.0 and s["burn_slow"] == 0.0
+    assert s["budget_remaining"] == 1.0 and not s["alerting"]
+    # then five bad minutes: half the traffic errors
+    for _ in range(5):
+        clock.t += 60.0
+        good[0] += 300.0
+        bad[0] += 300.0
+        eng.tick()
+    (s,) = eng.evaluate()
+    # fast window: ~50% errors against a 1% budget -> ~50x burn
+    assert 40.0 <= s["burn_fast"] <= 50.0
+    # slow window: 1500 bad / ~37500 total -> ~4x burn
+    assert 3.0 <= s["burn_slow"] <= 5.0
+    assert s["alerting"]  # 50x >= 14.4 and 4x >= 1.0
+    assert s["budget_remaining"] < 1.0
+
+
+def test_hot_scraper_keeps_slow_window_baseline():
+    """A scraper ticking at 5 Hz produces ~90k samples/h — far over the
+    retention cap.  The engine must DECIMATE interior samples, never evict
+    the slow-window baseline: with baseline eviction (the old fixed-size
+    ring) the slow window silently collapsed to ~14 min and a 5-minute
+    blip burned both windows alike, un-filtering exactly what the
+    multi-window design exists to filter."""
+    clock = _Clock()
+    good, bad = [0.0], [0.0]
+    eng = _engine(clock)
+    eng.add(SLOSpec("svc", objective=0.99,
+                    probe=lambda: (good[0], bad[0]),
+                    fast_window_s=300.0, slow_window_s=3600.0))
+    # one clean hour scraped at 5 Hz: 10 good/s
+    for _ in range(18_000):
+        clock.t += 0.2
+        good[0] += 2.0
+        eng.tick()
+    # then five bad minutes, still at 5 Hz: half the traffic errors
+    for _ in range(1_500):
+        clock.t += 0.2
+        good[0] += 1.0
+        bad[0] += 1.0
+        eng.tick()
+    (s,) = eng.evaluate()
+    assert 40.0 <= s["burn_fast"] <= 55.0
+    # the slow window still reaches back through the clean hour: 1500 bad
+    # over ~36000 total -> ~4x burn (a collapsed window reads ~18x)
+    assert 3.5 <= s["burn_slow"] <= 6.0
+    samples = eng._states["svc"].samples
+    assert 2 <= len(samples) <= obs_slo.MAX_SAMPLES
+    # the retained baseline really spans the slow window
+    assert samples[-1][0] - samples[0][0] >= 3600.0 - 1.0
+
+
+def test_alert_requires_both_windows():
+    """A fast-window spike alone must not page: the slow-window condition
+    is the flap filter."""
+    clock = _Clock()
+    bad = [0.0]
+    eng = _engine(clock)
+    eng.add(SLOSpec("svc", objective=0.9, probe=lambda: (10_000.0, bad[0]),
+                    fast_burn=1.0, slow_burn=10_000.0))  # slow: unreachable
+    for _ in range(3):
+        clock.t += 60.0
+        bad[0] += 500.0
+        eng.tick()
+    (s,) = eng.evaluate()
+    assert s["burn_fast"] >= 1.0
+    assert not s["alerting"]
+
+
+def test_short_history_process_still_evaluates():
+    """A process younger than its windows evaluates over the history it
+    has (the chaos-run case): total outage -> burn at the 1/(1-objective)
+    ceiling on both windows."""
+    clock = _Clock()
+    bad = [0.0]
+    eng = _engine(clock)
+    eng.add(SLOSpec("svc", objective=0.9, probe=lambda: (0.0, bad[0]),
+                    fast_burn=5.0, slow_burn=2.0))
+    eng.tick()
+    clock.t += 30.0
+    bad[0] += 64.0
+    (s,) = eng.evaluate()
+    assert s["burn_fast"] == 10.0 and s["burn_slow"] == 10.0
+    assert s["alerting"]
+
+
+# -- alert edges: flight events, warnings, gauges -----------------------------
+
+
+def test_alert_edge_fires_flight_event_and_one_warning(monkeypatch, caplog):
+    rec = FlightRecorder()
+    monkeypatch.setattr(obs_flight, "RECORDER", rec)
+    clock = _Clock()
+    bad = [0.0]
+    eng = _engine(clock, warn_interval_s=600.0)
+    eng.add(SLOSpec("svc", objective=0.9, probe=lambda: (0.0, bad[0]),
+                    fast_burn=5.0, slow_burn=2.0))
+    eng.tick()
+    with caplog.at_level(logging.WARNING, logger="quantum_resistant_p2p_tpu.obs.slo"):
+        for _ in range(5):  # stays alerting across evaluations
+            clock.t += 30.0
+            bad[0] += 10.0
+            eng.evaluate()
+    warnings = [r for r in caplog.records if "SLO svc burning" in r.message]
+    assert len(warnings) == 1  # one-time per episode (rate-limited)
+    burns = [e for e in rec.snapshot() if e["kind"] == "slo_burn"]
+    assert len(burns) == 1
+    assert burns[0]["slo"] == "svc" and burns[0]["burn_fast"] >= 5.0
+
+
+def test_rewarn_after_interval_while_still_burning(monkeypatch, caplog):
+    rec = FlightRecorder()
+    monkeypatch.setattr(obs_flight, "RECORDER", rec)
+    clock = _Clock()
+    bad = [0.0]
+    eng = _engine(clock, warn_interval_s=120.0)
+    eng.add(SLOSpec("svc", objective=0.9, probe=lambda: (0.0, bad[0]),
+                    fast_burn=5.0, slow_burn=2.0))
+    eng.tick()
+    with caplog.at_level(logging.WARNING, logger="quantum_resistant_p2p_tpu.obs.slo"):
+        for _ in range(6):  # 6 x 30s = 180s alerting > 120s re-warn interval
+            clock.t += 30.0
+            bad[0] += 10.0
+            eng.evaluate()
+    warnings = [r for r in caplog.records if "SLO svc burning" in r.message]
+    assert len(warnings) == 2  # entry + one re-warn, not six
+    # but still only ONE slo_burn flight event (edge-triggered)
+    assert len([e for e in rec.snapshot() if e["kind"] == "slo_burn"]) == 1
+
+
+def test_recovery_event_recorded(monkeypatch):
+    rec = FlightRecorder()
+    monkeypatch.setattr(obs_flight, "RECORDER", rec)
+    clock = _Clock()
+    good, bad = [0.0], [0.0]
+    eng = _engine(clock)
+    eng.add(SLOSpec("svc", objective=0.9,
+                    probe=lambda: (good[0], bad[0]),
+                    fast_window_s=60.0, slow_window_s=300.0,
+                    fast_burn=5.0, slow_burn=2.0))
+    eng.tick()
+    clock.t += 30.0
+    bad[0] += 100.0
+    eng.evaluate()
+    # clean traffic long enough to slide both windows past the incident
+    for _ in range(20):
+        clock.t += 30.0
+        good[0] += 1000.0
+        eng.evaluate()
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert "slo_burn" in kinds and "slo_recovered" in kinds
+    assert kinds.index("slo_burn") < kinds.index("slo_recovered")
+
+
+def test_budget_gauges_land_in_registry():
+    reg = Registry("slo-test")
+    clock = _Clock()
+    bad = [0.0]
+    eng = _engine(clock, registry=reg)
+    eng.add(SLOSpec("svc", objective=0.9, probe=lambda: (0.0, bad[0]),
+                    fast_burn=5.0, slow_burn=2.0))
+    eng.tick()
+    clock.t += 30.0
+    bad[0] += 10.0
+    eng.evaluate()
+    snap = reg.snapshot()
+    assert snap["gauges"]['slo_budget_remaining{slo="svc"}'] == 0.0
+    assert snap["gauges"]['slo_burn_fast{slo="svc"}'] == 10.0
+    prom = reg.to_prometheus()
+    assert 'qrp2p_slo_budget_remaining{registry="slo-test",slo="svc"} 0' in prom
+
+
+def test_crashing_probe_degrades_to_stale_not_engine_death():
+    clock = _Clock()
+    eng = _engine(clock)
+    eng.add(SLOSpec("broken", objective=0.99,
+                    probe=lambda: 1 // 0))  # type: ignore[arg-type]
+    eng.add(SLOSpec("fine", objective=0.99, probe=lambda: (10.0, 0.0)))
+    report = eng.status()
+    names = {s["name"] for s in report["specs"]}
+    assert names == {"broken", "fine"}
+    assert report["alerting"] == []
+
+
+# -- probe builders -----------------------------------------------------------
+
+
+def test_latency_probe_splits_on_bucket_boundary():
+    h = Histogram("lat", "t", buckets=(0.5, 1.0, 2.0, 5.0))
+    for v in (0.1, 0.9, 2.0, 4.0, 9.0):
+        h.record(v)
+    good, bad = latency_probe(h, 2.0)()
+    assert (good, bad) == (3.0, 2.0)  # <=2.0s is good; 4.0 and 9.0 burn
+    with pytest.raises(ValueError):
+        latency_probe(h, 0.1)  # below the smallest boundary: no exact split
+
+
+def test_counter_pair_probe_reads_live():
+    a, b = [5], [1]
+    p = counter_pair_probe(lambda: a[0], lambda: b[0])
+    assert p() == (5.0, 1.0)
+    a[0], b[0] = 7, 2
+    assert p() == (7.0, 2.0)
+
+
+def test_breaker_degraded_seconds_and_availability_probe():
+    b = Breaker(cooloff_s=0.05)
+    assert b.degraded_seconds() == 0.0
+    b.trip()  # closed -> open
+    time.sleep(0.02)
+    assert b.degraded_seconds() > 0.0
+    time.sleep(0.04)  # past the cool-off: probe route heals it
+    claim = b.acquire_dispatch()
+    assert claim == "probe"
+    b.record_success(claim)  # half_open -> closed
+    settled = b.degraded_seconds()
+    assert settled >= 0.05
+    time.sleep(0.01)
+    assert b.degraded_seconds() == settled  # ledger frozen while closed
+    good, bad = breaker_availability_probe(b)()
+    assert bad == pytest.approx(settled, rel=0.2)
+    assert good > 0.0
+
+
+# -- engine wiring (SecureMessaging) ------------------------------------------
+
+
+def test_messaging_metrics_slo_section(monkeypatch):
+    monkeypatch.setattr(SecureMessaging, "_spawn_warmup",
+                        lambda self, **kw: None)
+    node = P2PNode(node_id="slopeer", host="127.0.0.1", port=0)
+    m = SecureMessaging(node, backend="tpu", use_batching=True,
+                        sig_keypair=(b"p", b"s"),
+                        symmetric=type("A", (), {"name": "X"})())
+    out = m.metrics()
+    names = {s["name"] for s in out["slo"]["specs"]}
+    assert {"handshake_p99", "gateway_shed_rate", "device_served_shard0",
+            "breaker_availability"} <= names
+    assert out["slo"]["alerting"] == []
+    assert m.slo_status()["alerts_total"] == 0
+    # budget gauges ride the engine registry -> Prometheus scrape
+    prom = m.registry.to_prometheus()
+    assert 'slo="handshake_p99"' in prom
+
+
+def test_prometheus_scrape_advances_slo_engine(monkeypatch):
+    """A gateway watched ONLY through Prometheus must still evaluate its
+    SLOs: the registry's slo_health collector rides every scrape, so the
+    burn gauges refresh and alert edges can fire without anyone calling
+    metrics() or /slo."""
+    node = P2PNode(node_id="sloprom", host="127.0.0.1", port=0)
+    m = SecureMessaging(node, backend="cpu", sig_keypair=(b"p", b"s"),
+                        symmetric=type("A", (), {"name": "X"})())
+    prom = m.registry.to_prometheus()  # scrape, not metrics()
+    assert 'qrp2p_slo_health_alerts_total' in prom
+    assert 'slo="handshake_p99"' in prom  # evaluation set the gauges
+    snap = m.registry.snapshot()
+    assert snap["collected"]["slo_health"]["alerting_count"] == 0
+
+
+def test_gateway_shed_sli_is_symmetric_per_boundary():
+    """Connection sheds count as bad, so connection ADMISSIONS must count
+    as good: a reconnect wave of admitted peers that never handshake must
+    not read as an admission outage."""
+    node = P2PNode(node_id="slosym", host="127.0.0.1", port=0)
+    m = SecureMessaging(node, backend="cpu", sig_keypair=(b"p", b"s"),
+                        symmetric=type("A", (), {"name": "X"})())
+    # 64 admitted connections, 36 shed, zero handshakes yet
+    node.admitted, node.sheds = 64, 36
+    (spec,) = [s for s in m.slo_status()["specs"]
+               if s["name"] == "gateway_shed_rate"]
+    assert spec["good_total"] == 64.0 and spec["bad_total"] == 36.0
+    assert m.metrics()["gateway"]["connections_admitted"] == 64
+
+
+def test_messaging_without_batching_has_core_slos():
+    node = P2PNode(node_id="slocpu", host="127.0.0.1", port=0)
+    m = SecureMessaging(node, backend="cpu", sig_keypair=(b"p", b"s"),
+                        symmetric=type("A", (), {"name": "X"})())
+    names = set(m.slo.names())
+    assert names == {"handshake_p99", "gateway_shed_rate"}
+
+
+# -- the seeded chaos acceptance ----------------------------------------------
+
+
+def test_breaker_storm_fires_fast_burn_alert_deterministically(
+        run, monkeypatch):
+    """Acceptance (ISSUE 10): a seeded breaker storm — every device
+    dispatch raising, ops degrading to the fallback — deterministically
+    fires the fast-burn SLO alert, and the flight dump tells the story:
+    breaker_open first, then slo_burn, with the burn numbers attached."""
+    rec = FlightRecorder(clock=lambda: 1000.0, mono=lambda: 0.0)
+    monkeypatch.setattr(obs_flight, "RECORDER", rec)
+    breaker = Breaker(cooloff_s=30.0)
+    clock = _Clock()
+    eng = _engine(clock)
+    eng.add(SLOSpec(
+        "device_served_shard0", objective=0.9,
+        probe=counter_pair_probe(lambda: breaker.device_trips,
+                                 lambda: breaker.fallback_trips),
+        description="dispatch steps served by the device path",
+        fast_burn=5.0, slow_burn=2.0,
+    ))
+    eng.tick()  # t=0 baseline: nothing burned
+
+    async def main():
+        q = OpQueue(lambda items: [("dev", i) for i in items],
+                    max_batch=4, max_wait_ms=0.5,
+                    fallback_fn=lambda items: [("cpu", i) for i in items],
+                    breaker=breaker, label="storm.enc")
+        q.mark_warm(1)
+        plan = FaultPlan(seed=23, rules=[
+            FaultRule("device.dispatch", "raise", nth=1, times=64),
+        ])
+        with plan.activate():
+            for i in range(12):
+                assert await q.submit(i) == ("cpu", i)  # degraded, not failed
+        return plan
+
+    plan = run(main())
+    assert plan.injected  # the storm really fired
+    assert breaker.state == "open"
+    clock.t += 60.0
+    report = eng.status()
+    (spec,) = report["specs"]
+    # deterministic trip ledger given the seed: the ONE device attempt
+    # that raised (counted before its outcome), then 12 fallback flushes
+    # -> error rate 12/13 against a 0.1 budget on both windows
+    expected_burn = round((12 / 13) / 0.1, 4)
+    assert spec["good_total"] == 1.0 and spec["bad_total"] == 12.0
+    assert spec["burn_fast"] == expected_burn
+    assert spec["burn_slow"] == expected_burn
+    assert report["alerting"] == ["device_served_shard0"]
+    # the dump narrates: breaker opened, then the SLO burned
+    bundle = rec.dump("chaos", registries={})
+    kinds = [e["kind"] for e in bundle["events"]]
+    assert "breaker_open" in kinds and "slo_burn" in kinds
+    assert kinds.index("breaker_open") < kinds.index("slo_burn")
+    (burn,) = [e for e in bundle["events"] if e["kind"] == "slo_burn"]
+    assert burn["slo"] == "device_served_shard0"
+    assert burn["burn_fast"] == expected_burn
+    assert burn["budget_remaining"] == 0.0
+    # byte-stable snapshot given the injected clocks: same drive -> same
+    # story (the events carry no wall-clock jitter)
+    assert all(e["t"] == 1000.0 for e in bundle["events"])

@@ -181,8 +181,10 @@ class SecretToNetworkFlowRule(_FlowRule):
 class SecretInTraceFlowRule(_FlowRule):
     id = "flow-secret-in-trace"
     description = ("key material reaches an observability sink — span "
-                   "attributes, metric labels, and flight-recorder payloads "
-                   "are exported in cleartext diagnostics (obs/)")
+                   "attributes, metric labels, flight-recorder payloads, and "
+                   "the cross-peer wire-propagation surface (wire_context/"
+                   "adopt_wire_context) are exported in cleartext "
+                   "diagnostics or ride the network (obs/)")
 
 
 class SecretCompareFlowRule(_FlowRule):

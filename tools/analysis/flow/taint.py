@@ -201,11 +201,15 @@ NETWORK_SINKS = {"send_message", "sendall", "sendto"}
 #: observability sinks (obs/): span attributes, metric labels, and
 #: flight-recorder payloads are exported in cleartext diagnostics (trace
 #: files, Prometheus scrapes, flight bundles) — key material must never
-#: reach them.  Unconditional method names first; the generic names below
-#: count only on an obs-looking receiver (``TRACER.span``, ``obs_trace.
-#: span``, ``flight.record``, ``RECORDER.trigger``) so an unrelated
-#: ``foo.record()`` stays quiet.
-TRACE_SINKS = {"set_attr", "add_event", "labels"}
+#: reach them.  ``wire_context``/``adopt_wire_context`` are the
+#: cross-peer propagation surface (obs/trace.py): whatever reaches them
+#: RIDES THE NETWORK in the ``_trace`` frame field, so the same rule
+#: guarantees only correlation ids ever do.  Unconditional method names
+#: first; the generic names below count only on an obs-looking receiver
+#: (``TRACER.span``, ``obs_trace.span``, ``flight.record``,
+#: ``RECORDER.trigger``) so an unrelated ``foo.record()`` stays quiet.
+TRACE_SINKS = {"set_attr", "add_event", "labels",
+               "wire_context", "adopt_wire_context"}
 TRACE_SINKS_BY_RECEIVER = {"span", "record", "record_event", "trigger"}
 TRACE_RECEIVER_HINTS = ("trace", "tracer", "flight", "recorder", "metric")
 

@@ -31,3 +31,53 @@ echo "qr-analysis clean (qrlint + qrflow + qrkernel, within suppression budget)"
 python -m tools.swarm_bench --storm --peers 48 --concurrency 48 \
     --rekey-every 2 --seed 11 >/dev/null
 echo "storm smoke ok (48 sessions, 0 failures)"
+
+# Fleet-observability smoke (docs/observability.md): two processes' span
+# dumps — the child's recv chain parented on the parent's propagated wire
+# context — must merge into ONE chrome trace with two process lanes, one
+# shared trace id, and a cross-node flow edge; and the SLO engine must
+# fire a deterministic fast-burn alert on an injected-clock timeline.
+python - <<'EOF'
+import json, tempfile
+from pathlib import Path
+
+from quantum_resistant_p2p_tpu.obs import slo as obs_slo
+from quantum_resistant_p2p_tpu.obs import trace as obs_trace
+from tools import trace_merge
+
+tmp = Path(tempfile.mkdtemp(prefix="qrp2p_obs_smoke_"))
+# node A: a send whose context "rides the wire"
+ta = obs_trace.Tracer(tag="aaaa")
+with obs_trace.node_scope("alice"), ta.span("net.send", msg_type="ke_init"):
+    wire = {"trace_id": obs_trace.current().trace_id,
+            "span_id": obs_trace.current().span_id}
+a_dump = obs_trace.span_dump(node="alice", tracer=ta)
+# node B: adopts the wire context, as net/p2p_node.py does on recv
+tb = obs_trace.Tracer(tag="bbbb")
+parent = obs_trace.adopt_wire_context(wire)
+assert parent is not None
+with tb.span("net.recv", parent=parent, msg_type="ke_init"):
+    with tb.span("handshake.respond"):
+        pass
+b_dump = obs_trace.span_dump(node="bob", tracer=tb)
+(tmp / "a.json").write_text(json.dumps(a_dump))
+(tmp / "b.json").write_text(json.dumps(b_dump))
+doc = trace_merge.merge_files([tmp / "a.json", tmp / "b.json"])
+assert doc["otherData"]["merged_nodes"] == ["alice", "bob"], doc["otherData"]
+assert doc["otherData"]["cross_node_edges"] == 1, doc["otherData"]
+tids = {e["args"]["trace_id"] for e in doc["traceEvents"] if e["ph"] == "X"}
+assert len(tids) == 1, tids  # one causal chain across both processes
+
+# SLO eval: 100% failures for 2 minutes must alert on the fast window
+clock = iter(range(0, 10_000, 60)).__next__
+bad = {"n": 0.0}
+eng = obs_slo.SLOEngine(clock=lambda: float(clock()))
+eng.add(obs_slo.SLOSpec("smoke", objective=0.9,
+                        probe=lambda: (0.0, bad["n"]),
+                        fast_burn=5.0, slow_burn=2.0))
+for _ in range(3):
+    bad["n"] += 100.0
+    report = eng.status()
+assert report["alerting"] == ["smoke"], report
+print("trace-merge + SLO-eval smoke ok")
+EOF

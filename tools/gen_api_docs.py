@@ -68,12 +68,12 @@ SECTIONS: dict[str, list[str]] = {
         "quantum_resistant_p2p_tpu.config",
         "quantum_resistant_p2p_tpu.parallel.mesh",
         "quantum_resistant_p2p_tpu.utils.benchmarking",
-        "quantum_resistant_p2p_tpu.utils.profiling",
         "quantum_resistant_p2p_tpu.utils.ctr_drbg",
     ],
     "obs": [
         "quantum_resistant_p2p_tpu.obs.trace",
         "quantum_resistant_p2p_tpu.obs.metrics",
+        "quantum_resistant_p2p_tpu.obs.slo",
         "quantum_resistant_p2p_tpu.obs.flight",
     ],
     "analysis": [

@@ -48,13 +48,23 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
         from quantum_resistant_p2p_tpu.utils.benchmarking import enable_compile_cache
 
         enable_compile_cache()
+    # host AEAD: AES-256-GCM when the OpenSSL wheel is present (the
+    # historical r4/r5 configuration); on wheel-less images the bench-only
+    # stdlib AEAD keeps the PQ pipeline measurable — the swap touches only
+    # the ke_test probe + message AEAD, never the KEM/signature device
+    # path, and the emitted JSON says which one ran (the "aead" field)
+    import importlib.util
+
+    aead_kw = {}
+    if importlib.util.find_spec("cryptography") is None:
+        aead_kw = {"symmetric": _StormAEAD()}
     _messaging.KEY_EXCHANGE_TIMEOUT = ke_timeout
     hub_node = P2PNode(node_id="hub", host="127.0.0.1", port=0)
     await hub_node.start()
     hub = SecureMessaging(
         hub_node, backend=backend, use_batching=use_batching,
         max_batch=max_batch, max_wait_ms=max_wait_ms, batch_floor=batch_floor,
-        shard_devices=shard_devices,
+        shard_devices=shard_devices, **aead_kw,
     )
     received = 0
     got_all = asyncio.Event()
@@ -73,7 +83,7 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
         P2PNode(node_id="proto", host="127.0.0.1", port=0),
         backend=backend, use_batching=use_batching,
         max_batch=max_batch, max_wait_ms=max_wait_ms, batch_floor=batch_floor,
-        shard_devices=shard_devices,
+        shard_devices=shard_devices, **aead_kw,
     )
 
     # size-1 buckets precompile in the background at construction; wait so
@@ -204,6 +214,32 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
     elapsed = time.perf_counter() - t_start
     trips_delta = _breaker_trips() - trips0
 
+    slo_report = None
+    if slo:
+        # SLO engine evaluation while the plane is still alive (obs/slo.py):
+        # the hub is the responder/gateway side; the initiator-side latency
+        # split aggregates every client stack's histogram against the same
+        # threshold the engines alert on
+        from quantum_resistant_p2p_tpu.app.messaging import (
+            HANDSHAKE_SLO_THRESHOLD_S)
+        from quantum_resistant_p2p_tpu.obs import slo as obs_slo
+
+        good = bad = 0.0
+        for sm in clients:
+            g, b = obs_slo.latency_probe(sm._handshake_latency,
+                                         HANDSHAKE_SLO_THRESHOLD_S)()
+            good += g
+            bad += b
+        slo_report = {
+            "hub": hub.slo_status(),
+            "client_plane": proto.slo_status(),
+            "initiator_handshake": {
+                "threshold_s": HANDSHAKE_SLO_THRESHOLD_S,
+                "good": good,
+                "bad": bad,
+            },
+        }
+
     for sm in clients:
         await sm.node.stop()
     await hub_node.stop()
@@ -212,6 +248,7 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
     stats = {
         "peers": n_peers,
         "backend": backend,
+        "aead": hub.symmetric.display_name,
         "batching": use_batching,
         "failures": len(failures),
         "elapsed_s": round(elapsed, 3),
@@ -271,6 +308,8 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
             srt = sorted(client_trips)
             stats["initiator_trips_p50"] = srt[len(srt) // 2]
             stats["initiator_trips_max"] = srt[-1]
+    if slo_report is not None:
+        stats["slo"] = slo_report
     return stats
 
 
@@ -278,23 +317,35 @@ def write_obs_artifacts(stats: dict, out_dir: str | Path,
                         stem: str = "swarm") -> dict:
     """Attach the run's observability artifacts to its JSON output
     (bench_results/): a chrome://tracing trace-event file of the recorded
-    spans and a metrics snapshot of every live registry.  Returns the
-    paths added to ``stats``.  CI uploads these next to the qrflow SARIF.
+    spans, the MERGED multi-node flame graph (one process lane per node,
+    flow arrows on the propagated cross-peer parent edges —
+    tools/trace_merge.py), and a metrics snapshot of every live registry.
+    Returns the paths added to ``stats``.  CI uploads these next to the
+    qrflow SARIF.
     """
     from quantum_resistant_p2p_tpu.obs import metrics as obs_metrics
     from quantum_resistant_p2p_tpu.obs import trace as obs_trace
+    from tools import trace_merge
 
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     records = obs_trace.TRACER.snapshot()
     trace_path = out / f"{stem}_trace_events.json"
     trace_path.write_text(json.dumps(obs_trace.to_chrome_trace(records)))
+    # every node in this process recorded into ONE tracer; the records'
+    # per-span node attribution is what the merge groups lanes by
+    merged = trace_merge.merge([obs_trace.span_dump(records=records)])
+    merged_path = out / f"{stem}_merged_trace.json"
+    merged_path.write_text(json.dumps(merged))
     metrics_path = out / f"{stem}_metrics_snapshot.json"
     metrics_path.write_text(json.dumps(obs_metrics.global_snapshot(),
                                        indent=2, default=str))
     stats["obs"] = {
         "spans_recorded": len(records),
         "trace_events_file": str(trace_path),
+        "merged_trace_file": str(merged_path),
+        "merged_nodes": merged["otherData"]["merged_nodes"],
+        "cross_node_edges": merged["otherData"]["cross_node_edges"],
         "metrics_snapshot_file": str(metrics_path),
     }
     return stats["obs"]
@@ -717,6 +768,10 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
             for k in ("max_peers", "handshake_budget", "handshake_sheds")},
         "autotune_hub": hub_metrics["gateway"]["autotune"],
         "autotune_clients": proto_metrics["gateway"]["autotune"],
+        # burn-rate health of both planes at storm end (obs/slo.py):
+        # the consumer-grade signal the raw shed/served counters feed
+        "slo": {"hub": hub_metrics["slo"],
+                "client_plane": proto_metrics["slo"]},
     }
     if plan is not None:
         out["chaos"] = {
@@ -870,8 +925,9 @@ def main(argv=None) -> int:
                          "only, with per-handshake dispatch-trip accounting "
                          "(forces --concurrency 1)")
     ap.add_argument("--obs-dir", default="bench_results",
-                    help="directory for the trace-event + metrics-snapshot "
-                         "artifacts (slo mode; '' disables)")
+                    help="directory for the trace-event, merged multi-node "
+                         "trace, and metrics-snapshot artifacts (slo/storm "
+                         "modes; '' disables)")
     ap.add_argument("--storm", action="store_true",
                     help="sustained-traffic storm: --peers concurrent live "
                          "sessions with arrival pacing, rekey/bulk mix and "
@@ -910,6 +966,8 @@ def main(argv=None) -> int:
             bulk_lane_capacity=args.bulk_lane_capacity,
             shard_devices=args.shard_devices, ke_timeout=args.ke_timeout,
         ))
+        if args.obs_dir:
+            write_obs_artifacts(stats, args.obs_dir, stem="storm")
         print(json.dumps(stats))
         return 0 if stats["failures"] == 0 else 1
     if args.slo:
