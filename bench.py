@@ -250,6 +250,119 @@ def storm_main(out_path: str | None = None, sessions: int = STORM_SESSIONS,
     return rc
 
 
+#: fleet chaos ratchet configuration (docs/fleet.md): the seeded
+#: gateway-death storm the CI gate replays.  gw1 is SIGKILLed on its 8th
+#: fleet health tick (~2 s in, mid-ramp at the paced arrival rate), so a
+#: slice of live and in-flight sessions really does lose its gateway.
+FLEET_GATEWAYS = 3
+FLEET_KILL_GATEWAY = "gw1"
+FLEET_KILL_TICK = 8
+
+
+def fleet_storm_main(out_path: str | None = None,
+                     sessions: int = STORM_SESSIONS,
+                     gateways: int = FLEET_GATEWAYS,
+                     spawn: str = "process") -> int:
+    """Fleet chaos-storm ratchet (docs/fleet.md): replay ONE seeded
+    sustained-traffic trace through ``gateways`` gateway PROCESSES behind
+    the consistent-hash router, SIGKILL ``gw1`` mid-storm via the fault
+    plan's process scope, write ``bench_results/fleet_storm_r0N.json``,
+    and gate on the chaos number:
+
+    * **zero lost established sessions** — every session that completed a
+      handshake finished its workload (re-routed to the ring successor
+      and re-keyed where needed);
+    * **zero plaintext sends** (structural: the engine refuses to send
+      without a shared key);
+    * fleet ``device_served_fraction`` >= ``SLO_MIN_DEVICE_SERVED``
+      across every gateway process plus the client plane;
+    * the kill actually fired (the seeded ``injected`` log is non-empty)
+      and the handshake-failure burst stayed BOUNDED — no larger than one
+      concurrency window of attempts.
+
+    ``--fleet 1`` runs the same harness with a single gateway and no kill
+    (there is no successor to hand off to) — the within-noise comparison
+    point against the single-process ``storm_r0N.json`` gate.
+    """
+    import asyncio
+    import sys
+    from pathlib import Path
+
+    from quantum_resistant_p2p_tpu.fleet.storm import (
+        default_kill_rules, run_fleet_storm, write_fleet_artifacts)
+    from tools.swarm_bench import write_obs_artifacts
+
+    # smoke mode (tools/ci_smoke.sh): a small session count finishes well
+    # before the ratchet's ~2 s kill point, so tighten the heartbeat and
+    # kill tick to keep the death genuinely MID-storm — and skip the
+    # committed-artifact writes, which record official full-size runs only
+    smoke = sessions < 500
+    hb_interval = 0.1 if smoke else 0.25
+    kill_tick = 4 if smoke else FLEET_KILL_TICK
+    rules = (default_kill_rules(FLEET_KILL_GATEWAY, kill_tick)
+             if gateways > 1 else None)
+    # only the full-size CHAOS config owns the committed per-node reports
+    # (the files ci.yml uploads): smoke runs and the --fleet 1 parity run
+    # must not overwrite them — None -> run_fleet_storm uses a tempdir
+    chaos_run = rules is not None
+    report_dir = (Path("bench_results/fleet_reports")
+                  if chaos_run and not smoke else None)
+    out = asyncio.run(run_fleet_storm(
+        sessions, gateways=gateways, seed=STORM_SEED,
+        arrival_rate=STORM_ARRIVAL_RATE, concurrency=STORM_CONCURRENCY,
+        msgs_per_session=2, spawn=spawn, fault_rules=rules,
+        hb_interval=hb_interval, report_dir=report_dir,
+    ))
+    served = out["device_served_fraction"] or 0.0
+    burst_budget = STORM_CONCURRENCY
+    out.update({
+        "metric": f"fleet_storm_{sessions}x{gateways}_lost_established",
+        "value": out["lost_established_sessions"],
+        "unit": "sessions",
+        "vs_baseline": None,
+        "burst_budget": burst_budget,
+    })
+    rc = 0
+    if out["lost_established_sessions"]:
+        print(f"FLEET STORM FAIL: {out['lost_established_sessions']} "
+              "established session(s) lost", file=sys.stderr)
+        rc = 1
+    if out["plaintext_sends"]:
+        print(f"FLEET STORM FAIL: {out['plaintext_sends']} plaintext "
+              "send(s)", file=sys.stderr)
+        rc = 1
+    if served < SLO_MIN_DEVICE_SERVED:
+        print(f"FLEET STORM FAIL: fleet only {served:.1%} device-served "
+              f"(< {SLO_MIN_DEVICE_SERVED:.0%})", file=sys.stderr)
+        rc = 1
+    if rules is not None and not out.get("chaos", {}).get("injected"):
+        print("FLEET STORM FAIL: the seeded gateway kill never fired",
+              file=sys.stderr)
+        rc = 1
+    if out["handshake_failures"] > burst_budget:
+        print(f"FLEET STORM FAIL: handshake-failure burst "
+              f"{out['handshake_failures']} exceeds one concurrency window "
+              f"({burst_budget})", file=sys.stderr)
+        rc = 1
+    out["ok"] = rc == 0
+    line = json.dumps(out)
+    print(line)
+    if not smoke:
+        if chaos_run:
+            # the shared artifact names (traces, merged fleet SLO) record
+            # the flagship chaos run, never the parity comparison point
+            write_obs_artifacts(out, "bench_results", stem="fleet_storm")
+            write_fleet_artifacts(out, "bench_results")
+        Path("bench_results").mkdir(exist_ok=True)
+        n = 1
+        while Path(f"bench_results/fleet_storm_r{n:02d}.json").exists():
+            n += 1
+        Path(f"bench_results/fleet_storm_r{n:02d}.json").write_text(line + "\n")
+    if out_path:
+        Path(out_path).write_text(line + "\n")
+    return rc
+
+
 def multichip_main(out_path: str | None, shards: str, hs_peers: int,
                    emulate: int) -> int:
     """1→N-chip scaling probe (tools/swarm_bench.run_multichip): batch-4096
@@ -370,6 +483,16 @@ if __name__ == "__main__":
                          "sustained-traffic trace, static flush policy vs "
                          "the autotuner, gated on the checked-in budget "
                          "(docs/gateway.md)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="with --storm: run the FLEET chaos ratchet instead "
+                         "— this many gateway processes behind the "
+                         "consistent-hash router, one seeded mid-storm "
+                         "gateway kill, gated on zero lost established "
+                         "sessions (docs/fleet.md)")
+    ap.add_argument("--spawn", default="process",
+                    choices=("process", "task"),
+                    help="fleet gateway isolation (--storm --fleet): real "
+                         "subprocesses or in-process asyncio tasks")
     ap.add_argument("--sessions", type=int, default=STORM_SESSIONS,
                     help="concurrent sessions in the storm ratchet")
     ap.add_argument("--reps", type=int, default=STORM_REPS,
@@ -393,6 +516,9 @@ if __name__ == "__main__":
     args = ap.parse_args()
     if args.slo:
         raise SystemExit(slo_main(args.out, args.peers, args.warmup))
+    if args.storm and args.fleet:
+        raise SystemExit(fleet_storm_main(args.out, args.sessions,
+                                          args.fleet, args.spawn))
     if args.storm:
         raise SystemExit(storm_main(args.out, args.sessions, args.reps))
     if args.multichip:

@@ -563,6 +563,15 @@ class SecureMessaging:
             self._spawn(self.request_peer_settings(peer_id), "settings gossip")
         elif event == "disconnect":
             self.ke_state[peer_id] = KeyExchangeState.NONE
+            # Fail any IN-FLIGHT handshake with the dropped peer now, with
+            # a typed reason: no ke_response can ever resolve its future,
+            # and burning the full protocol timeout on it would stall the
+            # initiator's retry loop — which is exactly the loop a fleet
+            # handoff (fleet/manager.py) relies on to re-route promptly to
+            # the ring successor of a dead gateway.
+            for mid, entry in list(self._ephemeral.items()):
+                if entry[0] == peer_id:
+                    self._fail_pending(mid, "peer_disconnected")
             if (
                 self.auto_heal
                 and peer_id not in self._healing
@@ -1108,6 +1117,29 @@ class SecureMessaging:
         """Evaluate the SLO engine now and return its burn/budget report
         (also served as ``metrics()["slo"]`` and the CLI ``/slo``)."""
         return self.slo.status()
+
+    def slo_report(self) -> dict[str, Any]:
+        """The per-NODE SLO report document: one gateway process's burn
+        evaluation plus the cumulative counters a fleet merge needs.
+        fleet/gateway.py writes this as ``<node>_slo_report.json`` on
+        shutdown; ``tools/slo_merge.py`` (or
+        :func:`obs.slo.merge_reports`) folds N of them into one fleet
+        report with worst-node attribution."""
+        q = self._collect_queues()
+        return {
+            "node": self.node_id,
+            "slo": self.slo.status(),
+            "device_served_fraction": q.get("device_served_fraction"),
+            "device_trips": q.get("device_trips", 0),
+            "fallback_trips": q.get("fallback_trips", 0),
+            "counters": {
+                "handshakes_admitted": self._ctr_hs_admitted.value,
+                "handshake_sheds": self._ctr_handshake_sheds.value,
+                "connections_admitted": self.node.admitted,
+                "connection_sheds": self.node.sheds,
+                "handshake_giveups": self._ctr_handshake_giveups.value,
+            },
+        }
 
     def metrics(self) -> dict[str, Any]:
         """Operational counters: per-queue stats, aggregate dispatch trips,

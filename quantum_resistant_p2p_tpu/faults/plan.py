@@ -12,6 +12,9 @@ outside world:
                        class-creation time by ``provider.base`` (raise on the
                        Nth matching call)
 * ``warmup``         — the background jit warm-up call (kill it)
+* ``process``        — the fleet health loop (fleet/manager.py): kill or
+                       pause a gateway subprocess, or partition the
+                       router<->gateway control link
 
 The hooks are no-ops (one module-global ``None`` check) unless a plan is
 installed, so production code pays nothing.  All randomness — corruption byte
@@ -38,12 +41,19 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any
 
-SCOPES = ("net.send", "device.dispatch", "scalar.op", "warmup")
+SCOPES = ("net.send", "device.dispatch", "scalar.op", "warmup", "process")
 ACTIONS = {
     "net.send": ("drop", "delay", "corrupt"),
     "device.dispatch": ("raise", "poison", "delay"),
     "scalar.op": ("raise",),
     "warmup": ("kill",),
+    # process-scope faults (fleet/manager.py): the fleet health loop polls
+    # process_control(gateway) once per gateway per tick, in sorted gateway
+    # order on ONE loop — so rule counters advance on a deterministic event
+    # stream and the injected log is byte-reproducible from the seed even
+    # though the actions themselves are wall-clock chaos (a SIGKILL, a
+    # SIGSTOP, a dropped control link).
+    "process": ("kill_gateway", "pause_gateway", "partition"),
 }
 
 
@@ -234,6 +244,24 @@ class FaultPlan:
                 self._record(entry)
                 raise FaultInjected(f"injected warm-up kill for {label!r}")
 
+    def process_control(self, gateway: str) -> list[dict[str, Any]]:
+        """-> the process-scope actions firing on this fleet-tick event.
+
+        One call = one matched event for every ``process`` rule matching
+        ``{"gateway": gateway}``; the fleet health loop applies the
+        returned entries (``kill_gateway`` -> SIGKILL the subprocess,
+        ``pause_gateway`` -> SIGSTOP for ``delay_s`` then SIGCONT,
+        ``partition`` -> drop the router<->gateway control traffic for
+        ``delay_s``).  Every fired entry is recorded to ``injected``.
+        """
+        out: list[dict[str, Any]] = []
+        for _i, rule, entry in self._fire("process", {"gateway": gateway}):
+            if rule.action in ("pause_gateway", "partition"):
+                entry["delay_s"] = rule.delay_s
+            self._record(entry)
+            out.append(entry)
+        return out
+
 
 def _corrupt_payload(payload: dict[str, Any], rng: random.Random,
                      field_name: str | None) -> dict[str, Any]:
@@ -346,6 +374,16 @@ def warmup(label: str) -> None:
     plan = _ACTIVE
     if plan is not None:
         plan.warmup(label)
+
+
+def process_control(gateway: str) -> list:
+    """Process-scope fleet hook (fleet/manager.py health loop): the fired
+    kill/pause/partition entries for this gateway's tick, [] without a
+    plan."""
+    plan = _ACTIVE
+    if plan is None:
+        return []
+    return plan.process_control(gateway)
 
 
 # -- provider scalar-op instrumentation ---------------------------------------

@@ -1,0 +1,38 @@
+"""Gateway-pod fleet: the multi-process serving tier (ROADMAP item 1).
+
+Everything below ``fleet/`` exists so the single-process gateway stack
+(app/messaging.py + provider/batched.py + provider/scheduler.py) can run
+as N gateway PROCESSES — one protocol engine per host/chip-group — behind
+a peer-routing tier, with gateway death as the first-class case:
+
+* :mod:`.ring`     — seeded consistent-hash peer→gateway assignment
+                     (bounded virtual nodes; adding/removing one gateway
+                     moves only its arc).
+* :mod:`.control`  — the framed control-plane protocol (hello /
+                     heartbeat / probe / stop / route) between the router
+                     and its gateways, reusing net/p2p_node.py's wire
+                     format.
+* :mod:`.gateway`  — the gateway worker entry point
+                     (``python -m quantum_resistant_p2p_tpu.fleet.gateway``):
+                     one P2PNode + SecureMessaging engine, heartbeats to
+                     the router, per-node ``slo_report.json`` on exit.
+* :mod:`.manager`  — :class:`GatewayFleet`: spawns/watches the gateways,
+                     owns the ring and the fleet-scope breakers (a dead
+                     gateway is a breaker-open shard at fleet scope —
+                     provider/batched.py ``Breaker`` reused at the second
+                     placement level), serves route queries, aggregates
+                     cross-process SLO totals into one burn-rate engine.
+* :mod:`.storm`    — ``run_fleet_storm``: the multi-process chaos storm
+                     (tools/swarm_bench.py ``--storm --fleet N``).
+* :mod:`.stormlib` — the storm workload environment shared by the
+                     single-process storm and every gateway subprocess
+                     (``storm_env()``, the stdlib toy providers).
+
+Design: docs/fleet.md.  Placement, quarantine and rebalance are ONE
+policy at both scopes — :func:`provider.scheduler.select_slot` picks
+among local shards and among fleet gateways alike.
+"""
+
+from .manager import FleetBusy, GatewayFleet, GatewayMember  # noqa: F401
+from .ring import HashRing  # noqa: F401
+from .stormlib import StormAEAD, register_storm_providers, storm_env  # noqa: F401

@@ -1,0 +1,252 @@
+"""Gateway worker: one P2PNode + SecureMessaging engine per process.
+
+Spawned by :class:`fleet.manager.GatewayFleet` as
+``python -m quantum_resistant_p2p_tpu.fleet.gateway '<json config>'``
+(or run in-process as an asyncio task — ``spawn="task"`` — for
+deterministic tests; same code path, same control protocol over real
+localhost TCP).
+
+Lifecycle:
+
+1. enter :func:`fleet.stormlib.storm_env` — per-PROCESS fd limit +
+   protocol-timeout guard (the single-process storm's environment,
+   applied where it actually lives: in this process);
+2. start the P2P node on an ephemeral port, build the engine
+   (``use_batching=True`` — the full queue/scheduler/autotuner plane),
+   wait for warm-up;
+3. dial the router's control port, send ``__gw_hello__`` (the P2P port
+   peers will be routed to), then heartbeat every ``hb_interval`` with
+   liveness stats and the cumulative SLO probe totals the router
+   aggregates fleet-wide;
+4. answer ``__gw_probe__`` (the fleet breaker's half-open canary) with
+   ``__gw_probe_ok__``;
+5. on ``__gw_stop__``: write the per-node ``slo_report.json``
+   (:meth:`app.messaging.SecureMessaging.slo_report`) into
+   ``report_dir``, send ``__gw_bye__`` with final stats, exit 0.
+
+Abrupt death (SIGKILL from the chaos plan, or task cancellation) skips
+4-5 by construction — peers see a dropped TCP session, the router sees
+missed heartbeats, and the fleet handoff machinery takes over.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import sys
+from pathlib import Path
+from typing import Any
+
+from . import control
+from .stormlib import (StormAEAD, prewarm_facades, register_storm_providers,
+                       storm_env)
+
+logger = logging.getLogger(__name__)
+
+#: config defaults; the manager overrides via the JSON blob
+DEFAULTS: dict[str, Any] = {
+    "gateway_id": "gw0",
+    "router_host": "127.0.0.1",
+    "bind_host": "127.0.0.1",
+    "router_port": 0,
+    "providers": "stdlib",
+    "max_peers": 0,
+    "handshake_budget": 0,
+    "bulk_lane_capacity": 0,
+    "max_batch": 4096,
+    "max_wait_ms": 3.0,
+    "autotune": True,
+    "shard_devices": 0,
+    "ke_timeout": 120.0,
+    "hb_interval": 0.25,
+    "report_dir": None,
+    "fd_need": 4096,
+    "prewarm_cap": 64,
+}
+
+
+def _engine_stats(engine, received: int) -> dict[str, Any]:
+    """The compact heartbeat payload: liveness + the counters the fleet
+    sums (device/fallback trips feed the fleet_device_served SLO)."""
+    q = engine._collect_queues()
+    gw = {
+        "msgs_received": received,
+        "connections": len(engine.node.get_peers()),
+        "admitted": engine.node.admitted,
+        "connection_sheds": engine.node.sheds,
+        "handshake_sheds": engine._ctr_handshake_sheds.value,
+        "device_trips": q.get("device_trips", 0),
+        "fallback_trips": q.get("fallback_trips", 0),
+        "breaker_state": q.get("breaker_state"),
+        "device_served_fraction": q.get("device_served_fraction"),
+    }
+    total = fb = 0
+    for fam in ("kem_queue", "sig_queue", "fused_queue"):
+        for qq in q.get(fam, {}).values():
+            total += qq["ops"]
+            fb += qq["fallback_ops"]
+    gw["ops"] = total
+    gw["fallback_ops"] = fb
+    return gw
+
+
+async def run_gateway(cfg: dict[str, Any]) -> None:
+    """Run one gateway until the router says stop (or the task is
+    cancelled — the abrupt-death path)."""
+    cfg = {**DEFAULTS, **cfg}
+    gid = str(cfg["gateway_id"])
+    from ..app.messaging import SecureMessaging
+    from ..net.p2p_node import P2PNode
+    from ..provider import get_kem, get_signature
+
+    with storm_env(float(cfg["ke_timeout"]), fd_need=int(cfg["fd_need"])):
+        if cfg["providers"] == "stdlib":
+            register_storm_providers()
+            kem_name, sig_name = "STORM-KEM", "STORM-SIG"
+            aead: Any = StormAEAD()
+        else:
+            kem_name, sig_name = "ML-KEM-768", "ML-DSA-65"
+            try:
+                from ..provider import get_symmetric
+
+                aead = get_symmetric("AES-256-GCM")
+            except Exception:
+                logger.warning("gateway %s: real AEAD unavailable, "
+                               "degrading to the stdlib storm AEAD", gid,
+                               exc_info=True)
+                aead = StormAEAD()
+        node = P2PNode(node_id=gid, host=str(cfg["bind_host"]), port=0,
+                       max_peers=int(cfg["max_peers"]))
+        await node.start()
+        engine = SecureMessaging(
+            node, kem=get_kem(kem_name, "tpu"), symmetric=aead,
+            signature=get_signature(sig_name, "tpu"),
+            use_batching=True, max_batch=int(cfg["max_batch"]),
+            max_wait_ms=float(cfg["max_wait_ms"]),
+            autotune=bool(cfg["autotune"]),
+            shard_devices=int(cfg["shard_devices"]),
+            max_inflight_handshakes=int(cfg["handshake_budget"]),
+            bulk_lane_capacity=int(cfg["bulk_lane_capacity"]),
+        )
+        received = 0
+
+        def on_msg(peer_id, message):
+            nonlocal received
+            if not message.is_system:
+                received += 1
+
+        engine.register_message_listener(on_msg)
+        await engine.wait_ready()
+
+        cap = int(cfg["prewarm_cap"])
+        if cap and engine._bkem is not None:
+            # warm every pow2 flush bucket this gateway's share of the
+            # storm can hit
+            await prewarm_facades(
+                (engine._bkem, engine._bsig, engine._bfused),
+                min(int(cfg["max_batch"]), cap))
+
+        reader, writer = await asyncio.open_connection(
+            str(cfg["router_host"]), int(cfg["router_port"]))
+        await control.send_ctrl(writer, {
+            "type": control.GW_HELLO, "gateway": gid,
+            "p2p_port": node.port, "pid": os.getpid(),
+            "max_peers": int(cfg["max_peers"]),
+        })
+
+        stop_ev = asyncio.Event()
+        # one writer, two senders (heartbeat task + the read loop's probe
+        # replies): serialize sends — two coroutines suspended in the same
+        # drain() while the router back-pressures the transport trip
+        # asyncio's single-waiter assert and kill the heartbeat task
+        send_lock = asyncio.Lock()
+
+        async def send(frame: dict) -> None:
+            async with send_lock:
+                await control.send_ctrl(writer, frame)
+
+        async def heartbeat() -> None:
+            while not stop_ev.is_set():
+                await asyncio.sleep(float(cfg["hb_interval"]))
+                try:
+                    await send({
+                        "type": control.GW_HEARTBEAT, "gateway": gid,
+                        "stats": _engine_stats(engine, received),
+                        "slo_totals": {
+                            k: list(v)
+                            for k, v in engine.slo.probe_totals().items()
+                        },
+                    })
+                except (ConnectionError, OSError):
+                    stop_ev.set()
+                    return
+
+        hb_task = asyncio.create_task(heartbeat())
+        try:
+            while not stop_ev.is_set():
+                try:
+                    msg = await control.read_ctrl(reader)
+                except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                    break  # router gone: drain and exit
+                mtype = msg.get("type")
+                if mtype == control.GW_PROBE:
+                    try:
+                        await send({
+                            "type": control.GW_PROBE_OK, "gateway": gid,
+                            "n": msg.get("n"),
+                        })
+                    except (ConnectionError, OSError):
+                        break  # router gone mid-probe: drain and exit
+                elif mtype == control.GW_STOP:
+                    break
+            # graceful drain: per-node SLO report first (the fleet merge
+            # input), then the final stats frame
+            stop_ev.set()
+            report_dir = cfg.get("report_dir")
+            if report_dir:
+                path = Path(report_dir) / f"{gid}_slo_report.json"
+                report = json.dumps(engine.slo_report(), indent=2,
+                                    sort_keys=True)
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, path.write_text, report)
+                except OSError:
+                    logger.exception("gateway %s: slo report write failed",
+                                     gid)
+            try:
+                await send({
+                    "type": control.GW_BYE, "gateway": gid,
+                    "stats": _engine_stats(engine, received),
+                })
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            # runs on the graceful path AND on task cancellation (the
+            # in-process abrupt-death mode): close every transport so
+            # peers see the drop immediately
+            stop_ev.set()
+            hb_task.cancel()
+            writer.close()
+            await node.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m quantum_resistant_p2p_tpu.fleet.gateway "
+              "'<json config>'", file=sys.stderr)
+        return 2
+    # the single argument is an inline JSON blob, or a path to one
+    blob = argv[0]
+    if not blob.lstrip().startswith("{") and Path(blob).is_file():
+        blob = Path(blob).read_text()
+    cfg = json.loads(blob)
+    logging.basicConfig(level=logging.WARNING)
+    asyncio.run(run_gateway(cfg))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,821 @@
+"""GatewayFleet: N gateway processes behind a peer-routing tier, with
+gateway death as the first-class case (docs/fleet.md).
+
+The design seed (ISSUE 11, generalizing PR 3/6): **a dead gateway is a
+breaker-open shard at fleet scope**.  Each :class:`GatewayMember` owns a
+:class:`provider.batched.Breaker` — the SAME closed → open → half-open →
+closed state machine that guards a chip's dispatch path — driven by
+fleet-level evidence instead of dispatch latency:
+
+* missed heartbeats  → ``record_failure`` (non-probe): the breaker opens,
+  the member's ring arc drains to its successors, in-flight handshakes on
+  it are retried by their initiators under the existing typed busy/retry
+  machinery;
+* the half-open canary is a CONTROL probe (one ``__gw_probe__``
+  round-trip), never a client session: ``probe_ready()`` members get
+  exactly one probe per cool-off, failures escalate the backoff
+  exponentially (capped) exactly like a sick chip's canary;
+* probe success → ``record_success("probe")`` closes the breaker and the
+  member takes its ring ownership back — membership never changed, so
+  the arc snaps back with zero reshuffling of other members' peers.
+
+Placement, quarantine and rebalance are ONE policy at both scopes:
+:func:`provider.scheduler.select_slot` — the local shard axis's placement
+rule — picks among :class:`GatewayMember`\\ s too (they expose the same
+``breaker`` / ``inflight`` / ``index`` slot protocol): the health loop
+routes the next canary probe through it, and routing falls back to it
+(quarantine-aware, least-loaded) when the ring walk finds no closed
+member.
+
+Admission: the fleet budget is the SUM of per-gateway budgets over the
+currently-closed members; an over-budget route query is shed AT THE
+ROUTER with the same typed ``__busy__`` frame a gateway's connection
+budget uses, so clients treat both scopes with one retry policy.
+
+Cross-process SLO aggregation: each heartbeat carries the gateway's
+cumulative SLO probe totals (:meth:`obs.slo.SLOEngine.probe_totals`); the
+fleet sums them per spec and evaluates ONE :class:`obs.slo.SLOEngine`
+over the sums — the per-node ``slo_report.json`` files the gateways write
+on shutdown are the offline twin (``tools/slo_merge.py``).
+
+Everything here runs on the event loop (the breakers' own locks cover
+their cross-thread surface); the clock is injectable so handoff/heal
+tests drive deterministic timelines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+from ..faults import plan as _faults
+from ..obs import flight as obs_flight
+from ..obs import slo as obs_slo
+from ..obs.metrics import Registry
+from ..provider.batched import Breaker
+from ..provider.scheduler import select_slot
+from . import control
+from .ring import HashRing
+
+logger = logging.getLogger(__name__)
+
+#: heartbeat cadence and the miss budget: a member whose last heartbeat is
+#: older than ``hb_miss_limit * hb_interval`` is declared dead (breaker
+#: opens).  Defaults favor fast CI storms; production deployments pass
+#: their own (docs/fleet.md sizes the detection-latency/false-positive
+#: trade).
+HB_INTERVAL_S = 0.25
+HB_MISS_LIMIT = 4
+
+
+class FleetBusy(RuntimeError):
+    """The fleet admission budget is exhausted: this route query was shed
+    at the router (the wire twin is the typed ``__busy__`` frame)."""
+
+
+class GatewayMember:
+    """Router-side state for one gateway process — a fleet-scope slot.
+
+    Satisfies the :func:`provider.scheduler.select_slot` slot protocol
+    (``index`` / ``inflight`` / ``breaker``), which is what lets the
+    shard-placement policy pick among gateways unchanged."""
+
+    def __init__(self, gateway_id: str, index: int, cooloff_s: float = 1.0,
+                 cooloff_max_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.gateway_id = gateway_id
+        self.index = index
+        #: fleet-scope breaker: the provider-layer state machine reused at
+        #: the second placement level (module docstring)
+        self.breaker = Breaker(cooloff_s, cooloff_max_s, clock=clock)
+        self.breaker.label = gateway_id
+        #: live sessions the router believes are on this gateway
+        self.inflight = 0
+        #: routes issued in the current / previous heartbeat window (not
+        #: yet necessarily visible in the gateway's own connection count —
+        #: the reconcile slack below)
+        self.routed_since_hb = 0
+        self.routed_prev_hb = 0
+        #: cumulative sessions routed here
+        self.assigned = 0
+        # -- liveness / transport ------------------------------------------
+        self.host: str | None = None
+        self.port: int | None = None  # the P2P port peers dial
+        self.pid: int | None = None
+        self.proc: Any = None  # asyncio subprocess (spawn="process")
+        self.task: asyncio.Task | None = None  # spawn="task"
+        self.writer: asyncio.StreamWriter | None = None
+        self.last_hb: float | None = None
+        self.hb_count = 0
+        #: latest heartbeat stats / cumulative SLO probe totals
+        self.stats: dict[str, Any] = {}
+        self.slo_totals: dict[str, Any] = {}
+        #: final stats from the gateway's ``__gw_bye__``
+        self.final_stats: dict[str, Any] | None = None
+        #: chaos partition: control traffic dropped until this clock time
+        self.partitioned_until = 0.0
+        #: True once stop()/kill() decided this member's life is over —
+        #: excluded from routing and probing
+        self.stopped = False
+        self.killed = False
+        self._probe_fut: asyncio.Future | None = None
+        self._probe_n = 0
+
+    @property
+    def registered(self) -> bool:
+        return self.port is not None
+
+    def snapshot(self) -> dict[str, Any]:
+        b = self.breaker
+        return {
+            "gateway": self.gateway_id,
+            "index": self.index,
+            "port": self.port,
+            "pid": self.pid,
+            "inflight": self.inflight,
+            "assigned": self.assigned,
+            "heartbeats": self.hb_count,
+            "breaker_state": b.state,
+            "breaker_opens": b.opens,
+            "breaker_closes": b.closes,
+            "killed": self.killed,
+            "stopped": self.stopped,
+            "stats": self.stats,
+        }
+
+
+class GatewayFleet:
+    """Spawns, watches, routes to, and heals a pod of gateway processes."""
+
+    def __init__(
+        self,
+        gateways: int = 3,
+        *,
+        spawn: str = "process",
+        providers: str = "stdlib",
+        seed: int = 0,
+        ring_vnodes: int = 64,
+        hb_interval: float = HB_INTERVAL_S,
+        hb_miss_limit: int = HB_MISS_LIMIT,
+        cooloff_s: float = 1.0,
+        cooloff_max_s: float = 30.0,
+        per_gateway_max_peers: int = 0,
+        handshake_budget: int = 0,
+        gateway_kw: dict[str, Any] | None = None,
+        report_dir: str | Path | None = None,
+        host: str = "127.0.0.1",
+        clock: Callable[[], float] = time.monotonic,
+        register_timeout: float = 60.0,
+    ):
+        if spawn not in ("process", "task"):
+            raise ValueError(f"spawn must be 'process' or 'task', got {spawn!r}")
+        self.spawn = spawn
+        self.providers = providers
+        self.seed = seed
+        self.hb_interval = hb_interval
+        self.hb_miss_limit = hb_miss_limit
+        self.per_gateway_max_peers = per_gateway_max_peers
+        self.handshake_budget = handshake_budget
+        self.gateway_kw = dict(gateway_kw or {})
+        self.report_dir = Path(report_dir) if report_dir is not None else None
+        self.host = host
+        self._clock = clock
+        #: fleet birth on the injected clock: the availability SLO measures
+        #: gateway-seconds SINCE START — the raw monotonic value is time
+        #: since boot, which would dilute any outage into un-alertable noise
+        self._t0 = clock()
+        self._register_timeout = register_timeout
+        ids = [f"gw{i}" for i in range(gateways)]
+        self.members: dict[str, GatewayMember] = {
+            gid: GatewayMember(gid, i, cooloff_s, cooloff_max_s, clock)
+            for i, gid in enumerate(ids)
+        }
+        #: consistent-hash peer→gateway assignment (fleet/ring.py): seeded,
+        #: bounded virtual nodes; membership is STABLE across deaths —
+        #: liveness is the breakers' business, so a healed gateway's arc
+        #: snaps back without reshuffling anyone else's peers
+        self.ring = HashRing(ids, vnodes=ring_vnodes, seed=seed)
+        self._server: asyncio.Server | None = None
+        self.ctrl_port: int | None = None
+        self._running = False
+        self._health_task: asyncio.Task | None = None
+        self._bg: set[asyncio.Task] = set()
+        self._watchers: list[Callable[[str, str], None]] = []
+        self._registered_ev = asyncio.Event()
+        # -- fleet counters (the router-side half of the admission SLI) ----
+        self.routes_ok = 0
+        self.route_sheds = 0
+        self.rebalance_picks = 0
+        self.handoffs = 0
+        self._last_healthy: frozenset[str] = frozenset(ids)
+        self.registry = Registry(name="fleet")
+        self.slo = self._build_slo_engine()
+
+    # -- events ---------------------------------------------------------------
+
+    def on_event(self, handler: Callable[[str, str], None]) -> None:
+        """Register a fleet transition callback ``handler(event, gateway)``
+        — fired from the control read loops and the health tick (loop
+        domain; qrflow models on_event registrations as loop-callback
+        edges).  Events: registered / gateway_dead / gateway_healed /
+        probe_failed / bye."""
+        if handler not in self._watchers:
+            self._watchers.append(handler)
+
+    def _fire(self, event: str, gateway: str) -> None:
+        for h in list(self._watchers):
+            try:
+                h(event, gateway)
+            except Exception:
+                logger.exception("fleet event handler failed")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> None:
+        """Start the control/route server, spawn every gateway, and wait
+        until all of them registered (hello received)."""
+        self._server = await asyncio.start_server(self._on_ctrl, self.host, 0)
+        self.ctrl_port = self._server.sockets[0].getsockname()[1]
+        self._running = True
+        if self.report_dir is not None:
+            self.report_dir.mkdir(parents=True, exist_ok=True)
+            # a previous run's per-node reports would leak into this run's
+            # collect_reports() merge (a killed gateway writes none,
+            # leaving its stale twin behind to impersonate it)
+            for stale in self.report_dir.glob("*_slo_report.json"):
+                stale.unlink()
+        for member in self._members_sorted():
+            await self._spawn_member(member)
+        try:
+            await asyncio.wait_for(self._registered_ev.wait(),
+                                   self._register_timeout)
+        except asyncio.TimeoutError:
+            missing = [m.gateway_id for m in self.members.values()
+                       if not m.registered]
+            await self.stop()
+            raise RuntimeError(
+                f"fleet start: gateways never registered: {missing}")
+        self._health_task = asyncio.create_task(self._health_loop())
+        logger.info("fleet up: %d gateways on router port %s",
+                    len(self.members), self.ctrl_port)
+
+    def _members_sorted(self) -> list[GatewayMember]:
+        return [self.members[g] for g in sorted(self.members)]
+
+    def _gateway_config(self, member: GatewayMember) -> dict[str, Any]:
+        cfg = {
+            "gateway_id": member.gateway_id,
+            "router_host": self.host,
+            # the gateway binds its P2P listener where the router will
+            # advertise it (_route_reply hands clients member.host)
+            "bind_host": self.host,
+            "router_port": self.ctrl_port,
+            "providers": self.providers,
+            "max_peers": self.per_gateway_max_peers,
+            "handshake_budget": self.handshake_budget,
+            "hb_interval": self.hb_interval,
+            "report_dir": str(self.report_dir) if self.report_dir else None,
+        }
+        cfg.update(self.gateway_kw)
+        return cfg
+
+    async def _spawn_member(self, member: GatewayMember) -> None:
+        cfg = self._gateway_config(member)
+        if self.spawn == "task":
+            from .gateway import run_gateway
+
+            member.task = asyncio.create_task(run_gateway(cfg))
+            return
+        stderr = asyncio.subprocess.DEVNULL
+        log_f = None
+        if self.report_dir is not None:
+            log_path = self.report_dir / f"{member.gateway_id}.log"
+            stderr = log_f = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: open(log_path, "wb"))
+        try:
+            member.proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m",
+                "quantum_resistant_p2p_tpu.fleet.gateway", json.dumps(cfg),
+                stdout=asyncio.subprocess.DEVNULL, stderr=stderr,
+                start_new_session=True,
+            )
+        finally:
+            if log_f is not None:
+                # the child holds its own dup of the fd; keeping the
+                # router-side file object open would pin one fd per
+                # gateway per fleet for the driver's lifetime
+                log_f.close()
+        member.pid = member.proc.pid
+
+    async def stop(self) -> None:
+        """Graceful drain: ask every live gateway to write its per-node
+        SLO report and exit; SIGKILL/cancel whatever does not comply."""
+        self._running = False
+        if self._health_task is not None:
+            self._health_task.cancel()
+        for member in self._members_sorted():
+            member.stopped = True
+            if member.proc is not None and member.pid is not None:
+                # un-freeze a pause-chaos'd gateway so it can process the
+                # stop frame and write its slo report instead of burning
+                # the drain deadline SIGSTOPped (harmless if running)
+                try:
+                    os.kill(member.pid, signal.SIGCONT)
+                except (OSError, ProcessLookupError):  # pragma: no cover
+                    pass
+            if member.writer is not None:
+                try:
+                    await control.send_ctrl(member.writer,
+                                            {"type": control.GW_STOP})
+                except (ConnectionError, OSError, RuntimeError):
+                    pass
+        deadline = 10.0
+        for member in self._members_sorted():
+            if member.proc is not None:
+                try:
+                    await asyncio.wait_for(member.proc.wait(), deadline)
+                except asyncio.TimeoutError:
+                    member.proc.kill()
+                    await member.proc.wait()
+            elif member.task is not None:
+                try:
+                    await asyncio.wait_for(member.task, deadline)
+                except asyncio.TimeoutError:
+                    member.task.cancel()
+                except asyncio.CancelledError:
+                    pass  # a chaos-killed in-process gateway: already dead
+                except Exception:
+                    logger.exception("gateway %s task died with an error "
+                                     "during stop", member.gateway_id)
+        for t in list(self._bg):
+            t.cancel()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def kill(self, gateway_id: str) -> None:
+        """Abrupt gateway death (chaos ``kill_gateway``): SIGKILL the
+        subprocess / cancel the in-process task.  The member stays in the
+        ring — death is the breakers' business, detected by missed
+        heartbeats exactly like an unplanned crash."""
+        member = self.members[gateway_id]
+        member.killed = True
+        if member.proc is not None:
+            try:
+                member.proc.kill()
+            except ProcessLookupError:  # pragma: no cover - already gone
+                pass
+        elif member.task is not None:
+            member.task.cancel()
+        obs_flight.record("fleet_gateway_killed", gateway=gateway_id)
+
+    def pause(self, gateway_id: str, seconds: float) -> None:
+        """Chaos ``pause_gateway``: SIGSTOP the subprocess for ``seconds``
+        then SIGCONT (in-process gateways degrade to a partition — a task
+        cannot be frozen)."""
+        member = self.members[gateway_id]
+        if member.proc is not None and member.pid is not None:
+            try:
+                os.kill(member.pid, signal.SIGSTOP)
+                asyncio.get_running_loop().call_later(
+                    seconds, self._resume, member)
+            except (OSError, ProcessLookupError):  # pragma: no cover
+                pass
+        else:
+            self.partition(gateway_id, seconds)
+
+    def _resume(self, member: GatewayMember) -> None:
+        # no `stopped` gate: resuming a stopping/gone process is harmless,
+        # while skipping it would leave a paused gateway frozen through
+        # stop()'s drain
+        if member.pid is not None:
+            try:
+                os.kill(member.pid, signal.SIGCONT)
+            except (OSError, ProcessLookupError):  # pragma: no cover
+                pass
+
+    def partition(self, gateway_id: str, seconds: float) -> None:
+        """Chaos ``partition``: drop router<->gateway control traffic
+        (heartbeats in, probes out) for ``seconds``.  The gateway keeps
+        serving peers — the fleet just cannot SEE it, the exact
+        false-dead case the half-open re-entry machinery must handle."""
+        member = self.members[gateway_id]
+        member.partitioned_until = max(
+            member.partitioned_until, self._clock() + seconds)
+
+    # -- control server -------------------------------------------------------
+
+    async def _on_ctrl(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        try:
+            msg = await asyncio.wait_for(control.read_ctrl(reader), 10.0)
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionError, OSError, ValueError):
+            # slow/garbled/dropped first frame: untrusted dialer, drop it
+            writer.close()
+            return
+        mtype = msg.get("type")
+        if mtype == control.GW_HELLO:
+            await self._gateway_conn(msg, reader, writer)
+        elif mtype == control.ROUTE:
+            try:
+                await control.send_ctrl(writer, self._route_reply(msg))
+            except (ConnectionError, OSError):
+                pass
+            writer.close()
+        elif mtype == control.ROUTE_DONE:
+            self.session_done(str(msg.get("gateway", "")))
+            writer.close()
+        else:
+            writer.close()
+
+    async def _gateway_conn(self, hello: dict, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        gid = str(hello.get("gateway", ""))
+        member = self.members.get(gid)
+        if member is None:
+            logger.warning("hello from unknown gateway %r", gid)
+            writer.close()
+            return
+        member.host = self.host
+        member.port = int(hello.get("p2p_port", 0))
+        member.pid = int(hello.get("pid") or 0) or member.pid
+        member.writer = writer
+        member.last_hb = self._clock()
+        logger.info("gateway %s registered (p2p port %s)", gid, member.port)
+        self._fire("registered", gid)
+        if all(m.registered for m in self.members.values()):
+            self._registered_ev.set()
+        try:
+            while True:
+                msg = await control.read_ctrl(reader)
+                mtype = msg.get("type")
+                if mtype == control.GW_HEARTBEAT:
+                    self._on_heartbeat(member, msg)
+                elif mtype == control.GW_PROBE_OK:
+                    self._on_probe_ok(member, msg)
+                elif mtype == control.GW_BYE:
+                    member.final_stats = msg.get("stats") or {}
+                    self._fire("bye", gid)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            if member.writer is writer:
+                member.writer = None
+            writer.close()
+
+    def _on_heartbeat(self, member: GatewayMember, msg: dict) -> None:
+        if self._clock() < member.partitioned_until:
+            return  # chaos partition: the router never saw it
+        member.last_hb = self._clock()
+        member.hb_count += 1
+        member.stats = msg.get("stats") or {}
+        # Reconcile the router's inflight BELIEF with the gateway's own
+        # connection count: a client whose ``__route_done__`` frame was
+        # lost (its open_connection error is swallowed client-side) would
+        # otherwise leak its admission slot FOREVER and eventually wedge
+        # the fleet budget in permanent FleetBusy.  The cap pads for
+        # routes granted in the last TWO heartbeat windows, which the
+        # gateway cannot be assumed to see as connections yet (a saturated
+        # client loop can take more than one window to finish its dial) —
+        # so a leak ages out once its peer disconnects plus two
+        # heartbeats, and a slow-dialing live session is not clamped away.
+        reported = member.stats.get("connections")
+        if reported is not None:
+            cap = (int(reported) + member.routed_since_hb
+                   + member.routed_prev_hb)
+            if member.inflight > cap:
+                member.inflight = cap
+        member.routed_prev_hb = member.routed_since_hb
+        member.routed_since_hb = 0
+        totals = msg.get("slo_totals") or {}
+        if isinstance(totals, dict):
+            member.slo_totals = totals
+
+    def _on_probe_ok(self, member: GatewayMember, msg: dict) -> None:
+        if self._clock() < member.partitioned_until:
+            return  # a partitioned member's probe reply is lost too
+        fut = member._probe_fut
+        if fut is not None and not fut.done() and msg.get("n") == member._probe_n:
+            fut.set_result(True)
+
+    # -- health loop / handoff ------------------------------------------------
+
+    async def _health_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.hb_interval)
+            self._health_tick()
+
+    def _health_tick(self) -> None:
+        """One fleet health pass (also driven directly by tests on an
+        injected clock): chaos hooks, death detection, probe routing."""
+        now = self._clock()
+        # chaos first, in sorted order on ONE loop: the process-scope rule
+        # counters advance on a deterministic event stream (faults/plan.py)
+        for member in self._members_sorted():
+            if member.stopped:
+                continue
+            for entry in _faults.process_control(member.gateway_id):
+                self._apply_chaos(member, entry)
+        for member in self._members_sorted():
+            if member.stopped or member.last_hb is None:
+                continue
+            missed_for = now - member.last_hb
+            if (member.breaker.state == "closed"
+                    and missed_for > self.hb_miss_limit * self.hb_interval):
+                # a dead gateway is a breaker-open shard at fleet scope:
+                # non-probe failure — open at the base cool-off, arc drains
+                # to the ring successors, probes decide re-entry
+                member.breaker.record_failure("device")
+                logger.warning(
+                    "gateway %s missed heartbeats for %.2fs: fleet breaker "
+                    "OPEN; ring arc handed to successors",
+                    member.gateway_id, missed_for)
+                obs_flight.trigger("fleet_gateway_dead",
+                                   gateway=member.gateway_id,
+                                   missed_for_s=round(missed_for, 3))
+                self._fire("gateway_dead", member.gateway_id)
+        self._note_rebalance()
+        # probe routing through the SHARED placement policy: select_slot
+        # prefers a probe-eligible slot — at fleet scope the unit of work
+        # it receives is a control canary, never a client session
+        live = [m for m in self._members_sorted() if not m.stopped]
+        slot = select_slot(live)
+        if slot is None or not slot.breaker.probe_ready():
+            return
+        claim = slot.breaker.acquire_dispatch()
+        if claim != "probe":
+            slot.breaker.release(claim)
+            return
+        slot._probe_n += 1
+        self._spawn(self._probe_gateway(slot, slot._probe_n),
+                    f"probe:{slot.gateway_id}")
+
+    def _apply_chaos(self, member: GatewayMember, entry: dict) -> None:
+        action = entry.get("action")
+        logger.warning("chaos: %s on %s", action, member.gateway_id)
+        if action == "kill_gateway":
+            self.kill(member.gateway_id)
+        elif action == "pause_gateway":
+            self.pause(member.gateway_id, float(entry.get("delay_s", 1.0)))
+        elif action == "partition":
+            self.partition(member.gateway_id,
+                           float(entry.get("delay_s", 1.0)))
+
+    async def _probe_call(self, member: GatewayMember, n: int) -> None:
+        """ONE half-open canary round-trip: send ``__gw_probe__``, await
+        the matching reply.  Raises on a dead/partitioned/slow gateway —
+        the caller records the outcome to the member's fleet breaker
+        (qrlint dispatch-except-no-breaker polices that contract)."""
+        if member.writer is None:
+            raise ConnectionError(f"{member.gateway_id}: no control link")
+        if self._clock() < member.partitioned_until:
+            raise ConnectionError(f"{member.gateway_id}: partitioned")
+        loop = asyncio.get_running_loop()
+        member._probe_fut = loop.create_future()
+        await control.send_ctrl(member.writer,
+                                {"type": control.GW_PROBE, "n": n})
+        await asyncio.wait_for(member._probe_fut,
+                               self.hb_miss_limit * self.hb_interval)
+
+    async def _probe_gateway(self, member: GatewayMember, n: int) -> None:
+        try:
+            await self._probe_call(member, n)
+        except (asyncio.TimeoutError, ConnectionError, OSError,
+                RuntimeError) as e:
+            # failed canary: the fleet breaker re-opens with escalating
+            # backoff — a SIGKILLed gateway costs one bounded probe per
+            # (growing) cool-off, never a client session
+            member.breaker.record_failure("probe")
+            logger.warning("gateway %s canary probe failed (%s)",
+                           member.gateway_id, e)
+            self._fire("probe_failed", member.gateway_id)
+            return
+        member.breaker.record_success("probe")
+        # the probe round-trip IS fresh liveness evidence: without this the
+        # next health tick would re-declare the just-healed member dead off
+        # its stale pre-outage heartbeat timestamp and flap the arc
+        member.last_hb = self._clock()
+        logger.warning(
+            "gateway %s canary probe succeeded: fleet breaker CLOSED; "
+            "ring ownership restored", member.gateway_id)
+        obs_flight.record("fleet_gateway_healed", gateway=member.gateway_id,
+                          probes=n)
+        self._fire("gateway_healed", member.gateway_id)
+        self._note_rebalance()
+
+    def _note_rebalance(self) -> None:
+        healthy = frozenset(
+            m.gateway_id for m in self.members.values()
+            if not m.stopped and m.breaker.state == "closed")
+        if healthy != self._last_healthy:
+            obs_flight.record(
+                "fleet_rebalance", healthy=sorted(healthy),
+                avoided=sorted(set(self.members) - healthy))
+            self._last_healthy = healthy
+
+    def _spawn(self, coro, what: str) -> None:
+        task = asyncio.create_task(coro, name=what)
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    # -- routing --------------------------------------------------------------
+
+    def fleet_budget(self) -> int | None:
+        """Current fleet admission budget: the sum of per-gateway budgets
+        over CLOSED members (a dead gateway's capacity is not capacity).
+        None = unlimited (no per-gateway budget configured) — distinct
+        from 0, which means a configured fleet with ZERO healthy capacity
+        and must shed, not admit unbounded."""
+        if not self.per_gateway_max_peers:
+            return None
+        healthy = sum(1 for m in self.members.values()
+                      if not m.stopped and m.breaker.state == "closed")
+        return self.per_gateway_max_peers * healthy
+
+    def route(self, peer_id: str,
+              exclude: tuple[str, ...] = ()) -> GatewayMember | None:
+        """Assign ``peer_id`` a gateway: ring owner first, then ring
+        successors that are closed, then the shared placement policy's
+        quarantine-aware last resort.  Raises :class:`FleetBusy` when the
+        fleet admission budget is exhausted (the wire reply is the typed
+        ``__busy__`` frame); returns None when no member is routable.
+
+        ``exclude`` lists gateways the CLIENT just watched fail — honored
+        for this query even when their breakers have not opened yet (the
+        router may be one heartbeat behind the truth), but never treated
+        as failure evidence on its own."""
+        budget = self.fleet_budget()
+        if budget is not None:
+            # count load on the same members the budget counts capacity
+            # for: a dead gateway's still-claimed sessions are being
+            # re-routed — charging them against the shrunken budget would
+            # over-shed during exactly the handoff window
+            inflight = sum(m.inflight for m in self.members.values()
+                           if not m.stopped and m.breaker.state == "closed")
+            if inflight >= budget:
+                self.route_sheds += 1
+                if self.route_sheds == 1 or self.route_sheds % 64 == 0:
+                    logger.warning(
+                        "fleet admission budget reached (%d live sessions, "
+                        "budget %d): shedding route query (%d shed so far)",
+                        inflight, budget, self.route_sheds)
+                    obs_flight.record("load_shed", where="fleet_router",
+                                      inflight=inflight, budget=budget,
+                                      sheds=self.route_sheds)
+                raise FleetBusy(
+                    f"fleet at capacity ({inflight}/{budget} sessions)")
+        chosen: GatewayMember | None = None
+        owner: str | None = None
+        for gid in self.ring.successors(peer_id):
+            if owner is None:
+                owner = gid
+            member = self.members[gid]
+            if gid in exclude or member.stopped or not member.registered:
+                continue
+            if member.breaker.state == "closed":
+                chosen = member
+                break
+        if chosen is None:
+            # no closed member on the ring walk: the shared two-level
+            # policy's degraded placement (least-loaded non-quarantined).
+            # Unlike the shard scope, the routed unit here is a CLIENT
+            # session, never a canary — prefer members that are NOT
+            # probe-eligible (a probe-ready member is the one most likely
+            # freshly dead; its probe is the health loop's job), falling
+            # back to anyone only when every survivor is probe-ready.
+            pool = [m for m in self._members_sorted()
+                    if not m.stopped and m.registered
+                    and m.gateway_id not in exclude]
+            non_probe = [m for m in pool if not m.breaker.probe_ready()]
+            chosen = select_slot(non_probe or pool)
+            if chosen is None:
+                return None
+            self.rebalance_picks += 1
+        if owner is not None and chosen.gateway_id != owner:
+            self.handoffs += 1
+        chosen.inflight += 1
+        chosen.routed_since_hb += 1
+        chosen.assigned += 1
+        self.routes_ok += 1
+        return chosen
+
+    def session_done(self, gateway_id: str) -> None:
+        """A routed session ended (client-side signal): release its
+        admission slot."""
+        member = self.members.get(gateway_id)
+        if member is not None and member.inflight > 0:
+            member.inflight -= 1
+
+    def _route_reply(self, msg: dict) -> dict:
+        peer_id = str(msg.get("peer_id", ""))
+        exclude = tuple(str(g) for g in msg.get("exclude") or ())
+        try:
+            member = self.route(peer_id, exclude)
+        except FleetBusy:
+            return {"type": control.BUSY, "scope": "fleet"}
+        if member is None:
+            return {"type": control.NO_ROUTE}
+        return {"type": control.ROUTE_OK, "gateway": member.gateway_id,
+                "host": member.host or self.host, "port": member.port}
+
+    # -- fleet SLO aggregation ------------------------------------------------
+
+    def _sum_totals(self, name: str) -> tuple[float, float]:
+        good = bad = 0.0
+        for m in self.members.values():
+            pair = m.slo_totals.get(name)
+            if isinstance(pair, (list, tuple)) and len(pair) == 2:
+                good += float(pair[0])
+                bad += float(pair[1])
+        return good, bad
+
+    def _sum_stat(self, key: str) -> float:
+        return float(sum(float(m.stats.get(key) or 0.0)
+                         for m in self.members.values()))
+
+    def _build_slo_engine(self) -> obs_slo.SLOEngine:
+        """ONE multi-window burn engine over the SUMS of every gateway's
+        probe totals (heartbeat feed) — the per-node reports merged live;
+        tools/slo_merge.py computes the same aggregation offline from the
+        slo_report.json files."""
+        eng = obs_slo.SLOEngine(registry=self.registry, clock=self._clock)
+        eng.add(obs_slo.SLOSpec(
+            "fleet_handshake_p99", objective=0.99,
+            probe=lambda: self._sum_totals("handshake_p99"),
+            description="fleet-wide initiated handshakes within the "
+                        "latency threshold (sum of per-gateway totals)",
+        ))
+        eng.add(obs_slo.SLOSpec(
+            "fleet_shed_rate", objective=0.99,
+            probe=self._shed_probe,
+            description="admission decisions accepted vs shed across the "
+                        "router and every gateway boundary",
+            fast_burn=10.0, slow_burn=1.0,
+        ))
+        eng.add(obs_slo.SLOSpec(
+            "fleet_device_served", objective=0.9,
+            probe=lambda: (self._sum_stat("device_trips"),
+                           self._sum_stat("fallback_trips")),
+            description="dispatch steps served from the device path "
+                        "across every gateway (vs cpu fallback)",
+            fast_burn=5.0, slow_burn=2.0,
+        ))
+        eng.add(obs_slo.SLOSpec(
+            "fleet_gateway_availability", objective=0.95,
+            probe=self._availability_probe,
+            description="gateway-seconds the fleet breakers were closed "
+                        "vs degraded (dead/partitioned/probing)",
+            fast_burn=5.0, slow_burn=1.0,
+        ))
+        return eng
+
+    def _shed_probe(self) -> tuple[float, float]:
+        good, bad = self._sum_totals("gateway_shed_rate")
+        return good + self.routes_ok, bad + self.route_sheds
+
+    def _availability_probe(self) -> tuple[float, float]:
+        bad = sum(m.breaker.degraded_seconds()
+                  for m in self.members.values())
+        total = len(self.members) * (self._clock() - self._t0)
+        return max(0.0, total - bad), bad
+
+    def slo_status(self) -> dict[str, Any]:
+        return self.slo.status()
+
+    # -- reporting ------------------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "gateways": len(self.members),
+            "spawn": self.spawn,
+            "seed": self.seed,
+            "ring_vnodes": self.ring.vnodes,
+            "routes_ok": self.routes_ok,
+            "route_sheds": self.route_sheds,
+            "rebalance_picks": self.rebalance_picks,
+            "handoffs": self.handoffs,
+            "fleet_budget": self.fleet_budget(),
+            "members": [m.snapshot() for m in self._members_sorted()],
+        }
+
+    def collect_reports(self) -> list[dict[str, Any]]:
+        """The per-node ``slo_report.json`` documents the gateways wrote
+        on shutdown (report_dir), for :func:`obs.slo.merge_reports`."""
+        if self.report_dir is None:
+            return []
+        out = []
+        for path in sorted(self.report_dir.glob("*_slo_report.json")):
+            try:
+                out.append(json.loads(path.read_text()))
+            except (OSError, ValueError):
+                logger.warning("unreadable slo report %s", path)
+        return out

@@ -1,0 +1,377 @@
+"""Multi-process fleet chaos storm: ``--storm --fleet N``.
+
+Drives ``sessions`` live peers through a :class:`fleet.manager.GatewayFleet`
+over real TCP — every session asks the router which gateway owns it
+(consistent-hash assignment, typed ``__busy__`` shed at the fleet
+admission budget), dials that gateway's OWN process, runs the full
+authenticated handshake, and delivers its bulk messages.  Gateway death
+is the measured case, not an abort: a session whose gateway dies —
+mid-handshake or mid-session — re-routes to the ring successor, re-keys,
+and resumes delivery from where it stopped (undelivered messages are
+preserved client-side and re-sent under the NEW session key; nothing is
+ever sent in plaintext because the engine refuses to send without a
+shared key).  The acceptance currency (ISSUE 11 /
+``bench_results/fleet_storm_r0N.json``):
+
+* ``lost_established_sessions == 0`` — no session that completed a
+  handshake failed to finish its workload;
+* a BOUNDED handshake-failure burst (``handshake_failures`` counts
+  failed attempts; the kill makes some inevitable, the ring handoff
+  makes them finite);
+* fleet ``device_served_fraction >= 0.9`` summed across every gateway
+  process and the client plane.
+
+Chaos rides the seeded fault plan's new ``process`` scope
+(faults/plan.py): the fleet health loop polls
+``process_control(gateway)`` per gateway per tick in sorted order, so
+the ``injected`` log is byte-reproducible from the seed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+import tempfile
+import time
+from pathlib import Path
+from typing import Any
+
+from ..faults import FaultPlan
+from ..obs import slo as obs_slo
+from . import control
+from .manager import GatewayFleet
+from .stormlib import (StormAEAD, prewarm_facades, register_storm_providers,
+                       storm_env)
+
+logger = logging.getLogger(__name__)
+
+
+def default_kill_rules(gateway: str = "gw1", tick: int = 8) -> list:
+    """The canonical mid-storm chaos: SIGKILL one gateway on its Nth
+    health tick (~``tick * hb_interval`` seconds in)."""
+    from ..faults import FaultRule
+
+    return [FaultRule("process", "kill_gateway",
+                      match={"gateway": gateway}, nth=tick)]
+
+
+async def run_fleet_storm(
+    sessions: int = 1000,
+    gateways: int = 3,
+    providers: str = "stdlib",
+    seed: int = 0,
+    arrival_rate: float = 0.0,
+    concurrency: int = 256,
+    msgs_per_session: int = 2,
+    spawn: str = "process",
+    per_gateway_max_peers: int = 0,
+    handshake_budget: int = 0,
+    max_batch: int = 4096,
+    max_wait_ms: float = 3.0,
+    autotune: bool = True,
+    hb_interval: float = 0.25,
+    ke_timeout: float = 120.0,
+    session_attempts: int = 4,
+    prewarm_cap: int = 256,
+    fault_rules=None,
+    report_dir: str | Path | None = None,
+) -> dict[str, Any]:
+    """One seeded fleet storm; returns the JSON-ready report."""
+    register_storm_providers()
+    from ..app.messaging import SecureMessaging
+    from ..net.p2p_node import P2PNode
+    from ..provider import get_kem, get_signature
+
+    if providers == "stdlib":
+        kem_name, sig_name = "STORM-KEM", "STORM-SIG"
+    else:
+        kem_name, sig_name = "ML-KEM-768", "ML-DSA-65"
+    aead = StormAEAD()
+    rng = random.Random(seed)
+    tmp_reports = report_dir is None
+    if tmp_reports:
+        report_dir = Path(tempfile.mkdtemp(prefix="qrp2p_fleet_"))
+    report_dir = Path(report_dir)
+
+    fleet = GatewayFleet(
+        gateways, spawn=spawn, providers=providers, seed=seed,
+        hb_interval=hb_interval,
+        per_gateway_max_peers=per_gateway_max_peers,
+        handshake_budget=handshake_budget,
+        report_dir=report_dir,
+        gateway_kw={
+            "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+            "autotune": autotune, "ke_timeout": ke_timeout,
+            # the ring does not split load perfectly: a gateway's share of
+            # the concurrent window can exceed concurrency/N, so warm each
+            # gateway up to the FULL concurrency (capped) — a cold bucket
+            # silently degrades its whole share to the cpu fallback
+            "prewarm_cap": min(prewarm_cap, max(1, concurrency)),
+        },
+    )
+
+    clients: list[Any] = []
+    established_sessions = 0
+    completed = 0
+    failures = 0
+    lost_established = 0
+    handoffs = 0
+    handshake_failures = 0
+    route_busy = 0
+    msgs_delivered = 0
+    first_lat: list[float] = []
+
+    proto = None
+    with storm_env(ke_timeout, fd_need=4 * sessions + 128):
+        # Everything below unwinds through the finally: the gateway
+        # subprocesses are spawned start_new_session=True, so a raising
+        # session task that skipped fleet.stop() would ORPHAN them (holding
+        # their ports) and leak every client socket — the fleet-scope twin
+        # of the storm_env restore guarantee.
+        try:
+            await fleet.start()
+            # shared client-side batching plane (the storm-bench proto
+            # pattern): every client coalesces into one set of queues
+            proto = SecureMessaging(
+                P2PNode(node_id="proto", host="127.0.0.1", port=0),
+                kem=get_kem(kem_name, "tpu"), symmetric=aead,
+                signature=get_signature(sig_name, "tpu"),
+                use_batching=True, max_batch=max_batch,
+                max_wait_ms=max_wait_ms, autotune=autotune,
+            )
+            await proto.wait_ready()
+            if prewarm_cap and proto._bkem is not None:
+                # the client plane sees the FULL concurrency (every initiator
+                # coalesces into these queues): warm its reachable buckets
+                await prewarm_facades(
+                    (proto._bkem, proto._bsig, proto._bfused),
+                    min(max_batch, max(concurrency, 1), prewarm_cap))
+            kp_pks, kp_sks = proto.signature.generate_keypair_batch(sessions)
+            sem = asyncio.Semaphore(concurrency)
+
+            def make_client(i: int):
+                node = P2PNode(node_id=f"peer{i:05d}", host="127.0.0.1", port=0)
+                sm = SecureMessaging(
+                    node, kem=proto.kem, symmetric=proto.symmetric,
+                    signature=proto.signature,
+                    sig_keypair=(bytes(kp_pks[i]), bytes(kp_sks[i])),
+                    # fleet handoff replaces single-peer healing: a dead
+                    # gateway must be LEFT dead and its arc re-routed, not
+                    # redialed at its last known (now vacant) address
+                    auto_heal=False,
+                )
+                sm._bkem, sm._bsig, sm._bfused = (proto._bkem, proto._bsig,
+                                                  proto._bfused)
+                sm.use_batching = True
+                clients.append(sm)
+                return sm
+
+            async def route(peer_id: str, exclude: list[str]):
+                """Bounded route-query retry: BUSY backs off (the typed fleet
+                shed), transport errors retry, NO_ROUTE gives up."""
+                nonlocal route_busy
+                delay = 0.1
+                for _ in range(6):
+                    try:
+                        reply = await control.route_query(
+                            fleet.host, fleet.ctrl_port, peer_id, exclude)
+                    except (OSError, asyncio.TimeoutError, ValueError):
+                        await asyncio.sleep(delay)
+                        delay *= 2
+                        continue
+                    rtype = reply.get("type")
+                    if rtype == control.ROUTE_OK:
+                        return reply
+                    if rtype == control.BUSY:
+                        route_busy += 1
+                        await asyncio.sleep(delay)
+                        delay *= 2
+                        continue
+                    return None  # NO_ROUTE: nothing routable
+                return None
+
+            async def one_session(i: int, start_at: float,
+                                  t_origin: float) -> None:
+                nonlocal established_sessions, completed, failures
+                nonlocal lost_established, handoffs, handshake_failures
+                nonlocal msgs_delivered
+                delay = start_at - (time.perf_counter() - t_origin)
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                async with sem:
+                    peer_id = f"peer{i:05d}"
+                    sm = make_client(i)
+                    exclude: list[str] = []
+                    was_established = False
+                    delivered = 0
+                    for attempt in range(session_attempts):
+                        reply = await route(peer_id, exclude)
+                        if reply is None:
+                            break
+                        gid = reply["gateway"]
+                        if await sm.node.connect_to_peer(
+                                reply["host"], reply["port"], retries=2) != gid:
+                            # dead/unreachable gateway the router has not
+                            # noticed yet: exclude it and walk the ring
+                            exclude.append(gid)
+                            await control.route_done(fleet.host, fleet.ctrl_port,
+                                                     gid)
+                            continue
+                        t0 = time.perf_counter()
+                        ok = await sm.initiate_key_exchange(gid)
+                        if not ok:
+                            handshake_failures += 1
+                            await control.route_done(fleet.host, fleet.ctrl_port,
+                                                     gid)
+                            if not sm.node.is_connected(gid):
+                                # the gateway died mid-handshake: the typed
+                                # retry machinery already backed off; hand the
+                                # arc to the ring successor
+                                exclude.append(gid)
+                            continue
+                        if not was_established:
+                            first_lat.append(time.perf_counter() - t0)
+                            established_sessions += 1
+                            was_established = True
+                        while delivered < msgs_per_session:
+                            sent = await sm.send_message(
+                                gid, b"fleet storm %d/%d" % (i, delivered))
+                            if sent is None:
+                                break
+                            delivered += 1
+                            msgs_delivered += 1
+                        if delivered >= msgs_per_session:
+                            completed += 1
+                            await control.route_done(fleet.host, fleet.ctrl_port,
+                                                     gid)
+                            return
+                        # mid-session death: preserve the undelivered tail and
+                        # hand off to the ring successor (re-key, resume)
+                        handoffs += 1
+                        exclude.append(gid)
+                        await control.route_done(fleet.host, fleet.ctrl_port, gid)
+                    failures += 1
+                    if was_established:
+                        lost_established += 1
+
+            offsets = []
+            t = 0.0
+            for _ in range(sessions):
+                if arrival_rate > 0:
+                    t += rng.uniform(0.0, 2.0 / arrival_rate)
+                offsets.append(t)
+
+            plan = FaultPlan(seed, list(fault_rules)) if fault_rules else None
+            ctx = plan.activate() if plan is not None else None
+            if ctx is not None:
+                ctx.__enter__()
+            t_origin = time.perf_counter()
+            try:
+                await asyncio.gather(*(one_session(i, offsets[i], t_origin)
+                                       for i in range(sessions)))
+            finally:
+                if ctx is not None:
+                    ctx.__exit__(None, None, None)
+            elapsed = time.perf_counter() - t_origin
+
+            fleet_slo = fleet.slo_status()
+            fleet_stats = fleet.stats()
+            proto_metrics = proto.metrics()
+        finally:
+            await fleet.stop()
+            for sm in clients:
+                try:
+                    await sm.node.stop()
+                except (ConnectionError, OSError, RuntimeError):
+                    logger.exception("client node stop failed")
+            if proto is not None:
+                await proto.node.stop()
+
+    # fleet-wide device-served: every gateway process's queue totals (the
+    # final __gw_bye__ stats; heartbeat stats as fallback for a killed
+    # gateway) plus the driver-side client plane
+    total_ops = fb_ops = 0
+    per_gateway: dict[str, Any] = {}
+    for m in fleet._members_sorted():
+        stats = m.final_stats or m.stats
+        per_gateway[m.gateway_id] = stats
+        total_ops += int(stats.get("ops") or 0)
+        fb_ops += int(stats.get("fallback_ops") or 0)
+    for fam in ("kem_queue", "sig_queue", "fused_queue"):
+        for q in proto_metrics.get(fam, {}).values():
+            total_ops += q["ops"]
+            fb_ops += q["fallback_ops"]
+    reports = fleet.collect_reports()
+    merged = obs_slo.merge_reports(reports) if reports else None
+    if tmp_reports:
+        # scratch report dir (smoke / parity runs): reports are merged
+        # above, so don't leak one /tmp/qrp2p_fleet_* per invocation
+        import shutil
+
+        shutil.rmtree(report_dir, ignore_errors=True)
+
+    f_sorted = sorted(first_lat)
+
+    def pct(p: float):
+        if not f_sorted:
+            return None
+        return round(f_sorted[min(len(f_sorted) - 1,
+                                  int(len(f_sorted) * p / 100.0))], 4)
+
+    out: dict[str, Any] = {
+        "workload": "fleet_storm",
+        "sessions": sessions,
+        "gateways": gateways,
+        "spawn": spawn,
+        "providers": ("stdlib-toy (serving-loop workload)"
+                      if providers == "stdlib"
+                      else f"{kem_name}+{sig_name}"),
+        "seed": seed,
+        "arrival_rate": arrival_rate,
+        "concurrency": concurrency,
+        "msgs_per_session": msgs_per_session,
+        "elapsed_s": round(elapsed, 3),
+        "established_sessions": established_sessions,
+        "completed_sessions": completed,
+        "failures": failures,
+        "lost_established_sessions": lost_established,
+        "handoffs": handoffs,
+        "handshake_failures": handshake_failures,
+        "route_busy": route_busy,
+        "msgs_delivered": msgs_delivered,
+        # the engine refuses to send without a shared key (fail-closed,
+        # tests/test_faults.py pins it) and this harness only sends
+        # through send_message — plaintext on the wire is structurally
+        # impossible; the field records the claim the chaos gate makes
+        "plaintext_sends": 0,
+        "handshakes_per_s": (round(established_sessions / elapsed, 2)
+                             if elapsed else None),
+        "p50_handshake_s": pct(50),
+        "p99_handshake_s": pct(99),
+        "device_served_fraction": (
+            round((total_ops - fb_ops) / total_ops, 4) if total_ops else None),
+        "fleet": fleet_stats,
+        "per_gateway": per_gateway,
+        "fleet_slo": fleet_slo,
+        "fleet_slo_merged": merged,
+    }
+    if plan is not None:
+        out["chaos"] = {
+            "seed": plan.seed,
+            "injected": len(plan.injected),
+            "injected_log": plan.injected,
+        }
+    return out
+
+
+def write_fleet_artifacts(out: dict[str, Any], out_dir: str | Path) -> None:
+    """Write the merged fleet SLO report next to the storm artifacts
+    (CI uploads both)."""
+    d = Path(out_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    if out.get("fleet_slo_merged") is not None:
+        (d / "fleet_slo_report.json").write_text(
+            json.dumps({"merged": out["fleet_slo_merged"],
+                        "live": out.get("fleet_slo")}, indent=2) + "\n")

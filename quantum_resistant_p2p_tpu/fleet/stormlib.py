@@ -1,0 +1,196 @@
+"""Storm workload environment — shared by the single-process storm bench
+(tools/swarm_bench.py) and every fleet gateway subprocess (fleet/gateway.py).
+
+Three things live here because BOTH sides need them:
+
+* :func:`storm_env` — the process-environment guard a storm run needs:
+  raise the fd soft limit (thousands of live TCP sessions in one
+  process) and save/restore the module-global ``KEY_EXCHANGE_TIMEOUT``.
+  Both effects are PROCESS-LOCAL, which is exactly why this is a context
+  manager the fleet harness applies inside each gateway subprocess —
+  applying them once in the driver would leave every other process at
+  the defaults, and a raising storm session must never poison the next
+  run's timeouts (the restore runs in the ``finally``).
+* :class:`StormAEAD` — bench-only stdlib encrypt-then-MAC AEAD so the
+  full handshake (incl. the ke_test probe) and bulk messaging run on
+  images without the ``cryptography`` wheel.  Never registered as a
+  provider.
+* :func:`register_storm_providers` — idempotent registration of the
+  hash-based STORM-KEM / STORM-SIG toys for both backends, so a storm
+  measures the SERVING LOOP (transport, protocol, queues, batching,
+  admission) rather than raw crypto throughput.
+* :func:`prewarm_facades` — warm every pow2 flush bucket a live storm
+  can land in (the run_swarm --prewarm lesson: a cold bucket silently
+  degrades its whole window to the cpu fallback), shared by the swarm
+  bench's planes and each gateway subprocess's engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import hmac
+import os
+from typing import Iterator
+
+
+def raise_fd_limit(need: int) -> None:
+    """A 10k-session storm needs ~2 fds per session in one process: lift
+    the soft RLIMIT_NOFILE to the hard cap (best-effort)."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < need:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(max(need, soft), hard), hard))
+    except (ImportError, ValueError, OSError):  # pragma: no cover
+        pass
+
+
+@contextlib.contextmanager
+def storm_env(ke_timeout: float, fd_need: int = 0) -> Iterator[None]:
+    """Enter the storm process environment: generous protocol timeout
+    (cold compiles / batched flushes must not race the 20 s default),
+    raised fd limit.  Restores ``KEY_EXCHANGE_TIMEOUT`` on exit even when
+    the storm raises — a failed fleet session cannot poison the next
+    run's timeouts in the same process."""
+    from ..app import messaging as _messaging
+
+    if fd_need:
+        raise_fd_limit(fd_need)
+    old_timeout = _messaging.KEY_EXCHANGE_TIMEOUT
+    _messaging.KEY_EXCHANGE_TIMEOUT = ke_timeout
+    try:
+        yield
+    finally:
+        _messaging.KEY_EXCHANGE_TIMEOUT = old_timeout
+
+
+async def prewarm_facades(facades, limit: int, floor: int = 1) -> list[int]:
+    """Warm every pow2 flush bucket from ``floor`` up through ``limit``
+    on each (non-None) batching facade, off-loop; returns the sizes
+    warmed.  Without this a traffic burst lands on cold buckets and the
+    degrade path quietly serves the whole window from the cpu fallback —
+    warming always includes the ``floor`` bucket itself, which is what
+    every flush uses when the floor exceeds the concurrency level."""
+    sizes, b = [], max(1, floor)
+    while b <= limit or not sizes:
+        sizes.append(b)
+        b *= 2
+    loop = asyncio.get_running_loop()
+    for facade in facades:
+        if facade is None:
+            continue
+        await loop.run_in_executor(None, facade.warmup, tuple(sizes))
+    return sizes
+
+
+class StormAEAD:
+    """Stdlib encrypt-then-MAC AEAD (HMAC-SHA256 over a SHA-256 keystream)
+    — bench-only: lets the FULL handshake (incl. the ke_test AEAD probe)
+    and bulk messaging run on images without the ``cryptography`` wheel.
+    Mirrors the test suites' ToyAEAD; never registered as a provider."""
+
+    name = "STORM-AEAD"
+    display_name = "STORM-AEAD (bench-only stdlib)"
+    key_size = 32
+    nonce_size = 16
+
+    @staticmethod
+    def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+        out = b""
+        ctr = 0
+        while len(out) < n:
+            out += hashlib.sha256(key + nonce + ctr.to_bytes(8, "big")).digest()
+            ctr += 1
+        return out[:n]
+
+    def encrypt(self, key, plaintext, associated_data=None):
+        nonce = os.urandom(self.nonce_size)
+        ct = bytes(a ^ b for a, b in
+                   zip(plaintext, self._keystream(key, nonce, len(plaintext))))
+        tag = hmac.new(key, nonce + ct + (associated_data or b""),
+                       hashlib.sha256).digest()
+        return nonce + ct + tag
+
+    def decrypt(self, key, data, associated_data=None):
+        if len(data) < self.nonce_size + 32:
+            raise ValueError("ciphertext too short")
+        nonce, ct, tag = (data[: self.nonce_size], data[self.nonce_size:-32],
+                          data[-32:])
+        want = hmac.new(key, nonce + ct + (associated_data or b""),
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise ValueError("authentication failed")
+        return bytes(a ^ b for a, b in
+                     zip(ct, self._keystream(key, nonce, len(ct))))
+
+
+_STORM_REGISTERED = False
+
+
+def register_storm_providers() -> None:
+    """Register the stdlib STORM-KEM/STORM-SIG toys for BOTH backends (the
+    'tpu' registration rides the device-path queue machinery; 'cpu' arms
+    the degrade fallback) — idempotent."""
+    global _STORM_REGISTERED
+    if _STORM_REGISTERED:
+        return
+
+    from ..provider.base import KeyExchangeAlgorithm, SignatureAlgorithm
+    from ..provider.registry import register_kem, register_signature
+
+    class StormKEM(KeyExchangeAlgorithm):
+        name = "STORM-KEM"
+        display_name = "STORM-KEM (bench-only stdlib)"
+        public_key_len = 32
+        secret_key_len = 32
+        ciphertext_len = 32
+        shared_secret_len = 32
+
+        def __init__(self, backend="cpu"):
+            self.backend = backend
+
+        def generate_keypair(self):
+            sk = os.urandom(32)
+            return hashlib.sha256(b"pk" + sk).digest(), sk
+
+        def encapsulate(self, public_key):
+            ct = os.urandom(32)
+            return ct, hashlib.sha256(public_key + ct).digest()
+
+        def decapsulate(self, secret_key, ciphertext):
+            pk = hashlib.sha256(b"pk" + secret_key).digest()
+            return hashlib.sha256(pk + ciphertext).digest()
+
+    class StormSig(SignatureAlgorithm):
+        name = "STORM-SIG"
+        display_name = "STORM-SIG (bench-only stdlib)"
+        public_key_len = 32
+        secret_key_len = 32
+        signature_len = 32
+
+        def __init__(self, backend="cpu"):
+            self.backend = backend
+
+        def generate_keypair(self):
+            sk = os.urandom(32)
+            return hashlib.sha256(b"pk" + sk).digest(), sk
+
+        def sign(self, secret_key, message):
+            pk = hashlib.sha256(b"pk" + secret_key).digest()
+            return hashlib.sha256(b"sig" + pk + message).digest()
+
+        def verify(self, public_key, message, signature):
+            return hmac.compare_digest(
+                signature,
+                hashlib.sha256(b"sig" + public_key + message).digest())
+
+    register_kem("STORM-KEM", lambda backend, devices=0: StormKEM(backend),
+                 ("cpu", "tpu"))
+    register_signature("STORM-SIG",
+                       lambda backend, devices=0: StormSig(backend),
+                       ("cpu", "tpu"))
+    _STORM_REGISTERED = True

@@ -225,6 +225,26 @@ class SLOEngine:
                 if len(st.samples) > MAX_SAMPLES:
                     _decimate(st.samples)
 
+    def probe_totals(self) -> dict[str, tuple[float, float]]:
+        """Sample every probe once and return the cumulative ``(good,
+        bad)`` totals by spec name — the cross-process aggregation feed:
+        a fleet router (fleet/manager.py) sums these across its gateways'
+        heartbeats and evaluates ONE engine (this same multi-window burn
+        machinery) over the sums; :func:`merge_reports` is the offline
+        twin over written ``slo_report.json`` files."""
+        out: dict[str, tuple[float, float]] = {}
+        with self._lock:
+            states = list(self._states.values())
+        for st in states:
+            try:
+                good, bad = st.spec.probe()
+            except Exception:
+                logger.debug("slo probe %s failed", st.spec.name,
+                             exc_info=True)
+                continue
+            out[st.spec.name] = (float(good), float(bad))
+        return out
+
     def evaluate(self) -> list[dict[str, Any]]:
         """Sample, compute burn rates, update gauges, fire alert edges."""
         self.tick()
@@ -316,6 +336,69 @@ class SLOEngine:
             "alerting": [s["name"] for s in specs if s["alerting"]],
             "alerts_total": sum(s["alerts"] for s in specs),
         }
+
+
+# -- cross-process aggregation ------------------------------------------------
+
+
+def merge_reports(reports: "list[dict[str, Any]]") -> dict[str, Any]:
+    """Merge N per-node SLO reports (``SecureMessaging.slo_report()``
+    documents, one ``slo_report.json`` per gateway process) into ONE
+    fleet report: per-SLO fleet totals and burn (cumulative — the
+    offline twin of the fleet router's live windowed engine), plus
+    worst-node attribution so a fleet-level burn points at the gateway
+    eating the budget.  Specs are merged BY NAME, so per-node specs that
+    share a name (every gateway's ``handshake_p99``) aggregate while
+    node-unique ones pass through with one contributor."""
+    slos: dict[str, dict[str, Any]] = {}
+    nodes: list[str] = []
+    for rep in reports:
+        node = str(rep.get("node", f"node{len(nodes)}"))
+        nodes.append(node)
+        for spec in (rep.get("slo") or {}).get("specs", []):
+            name = spec.get("name")
+            if not name:
+                continue
+            e = slos.setdefault(name, {
+                "name": name,
+                "objective": spec.get("objective"),
+                "good_total": 0.0,
+                "bad_total": 0.0,
+                "nodes": 0,
+                "worst_node": None,
+                "worst_node_burn_fast": None,
+                "alerting_nodes": [],
+            })
+            e["good_total"] += float(spec.get("good_total") or 0.0)
+            e["bad_total"] += float(spec.get("bad_total") or 0.0)
+            e["nodes"] += 1
+            burn = float(spec.get("burn_fast") or 0.0)
+            if (e["worst_node_burn_fast"] is None
+                    or burn > e["worst_node_burn_fast"]):
+                e["worst_node_burn_fast"] = round(burn, 4)
+                e["worst_node"] = node
+            if spec.get("alerting"):
+                e["alerting_nodes"].append(node)
+    worst_node = None
+    worst_burn = -1.0
+    for e in slos.values():
+        total = e["good_total"] + e["bad_total"]
+        err = (e["bad_total"] / total) if total else 0.0
+        budget = 1.0 - (e["objective"] or 0.0)
+        e["fleet_error_rate"] = round(err, 6)
+        e["fleet_burn"] = round(err / budget, 4) if budget > 0 else None
+        e["good_total"] = round(e["good_total"], 6)
+        e["bad_total"] = round(e["bad_total"], 6)
+        if (e["worst_node_burn_fast"] or 0.0) > worst_burn:
+            worst_burn = e["worst_node_burn_fast"] or 0.0
+            worst_node = e["worst_node"]
+    return {
+        "nodes": nodes,
+        "slos": {name: slos[name] for name in sorted(slos)},
+        "worst_node": worst_node,
+        "alerting": sorted({n for e in slos.values()
+                            for n in e["alerting_nodes"]}),
+    }
 
 
 # -- probe builders over the counters other layers already keep ---------------

@@ -201,8 +201,15 @@ class Breaker(CoalescingHub):
     the default executor the fallback runs on.
     """
 
-    def __init__(self, cooloff_s: float = 30.0, cooloff_max_s: float = 480.0):
+    def __init__(self, cooloff_s: float = 30.0, cooloff_max_s: float = 480.0,
+                 clock: Callable[[], float] = time.monotonic):
         import threading
+
+        #: injectable monotonic clock: the fleet manager (fleet/manager.py)
+        #: reuses this exact state machine for its per-GATEWAY breakers and
+        #: drives handoff/heal tests on deterministic timelines; production
+        #: callers never pass it
+        self._clock = clock
 
         #: guards every state-machine mutation: the breaker is shared between
         #: the event loop (dispatch outcomes) and the warmup thread (the
@@ -248,7 +255,7 @@ class Breaker(CoalescingHub):
         with self._lock:
             if self.state == "quarantined":
                 return True
-            return self.state == "open" and time.monotonic() < self._open_until
+            return self.state == "open" and self._clock() < self._open_until
 
     def probe_ready(self) -> bool:
         """True when the next :meth:`acquire_dispatch` would route a canary
@@ -262,7 +269,7 @@ class Breaker(CoalescingHub):
                 return False
             if self.state == "half_open":
                 return True
-            return self.state == "open" and time.monotonic() >= self._open_until
+            return self.state == "open" and self._clock() >= self._open_until
 
     def _set_state(self, new: str, why: str = "") -> None:
         """Transition + loud log + structured flight-recorder event (the
@@ -276,7 +283,7 @@ class Breaker(CoalescingHub):
             old = self.state
             self.state = new
             # degraded-time ledger (the breaker-availability SLO feed)
-            now = time.monotonic()
+            now = self._clock()
             if old == "closed" and new != "closed":
                 self._degraded_since = now
             elif new == "closed" and self._degraded_since is not None:
@@ -337,7 +344,7 @@ class Breaker(CoalescingHub):
                 self.cooloff_s = min(self.cooloff_s * 2.0, self.cooloff_max_s)
             elif self.state == "closed":
                 self.cooloff_s = self.base_cooloff_s
-            self._open_until = time.monotonic() + self.cooloff_s
+            self._open_until = self._clock() + self.cooloff_s
             if self.state == "open":
                 logging.getLogger(__name__).debug(
                     "circuit breaker already open: cool-off clock refreshed "
@@ -356,7 +363,7 @@ class Breaker(CoalescingHub):
         with self._lock:
             total = self._degraded_s
             if self._degraded_since is not None:
-                total += time.monotonic() - self._degraded_since
+                total += self._clock() - self._degraded_since
             return total
 
     def quarantine(self, why: str) -> None:
@@ -379,7 +386,7 @@ class Breaker(CoalescingHub):
             if self.state == "quarantined":
                 return "fallback"
             if self.state == "open":
-                if time.monotonic() < self._open_until:
+                if self._clock() < self._open_until:
                     return "fallback"
                 self._set_state("half_open")
             if self._probe_in_flight:

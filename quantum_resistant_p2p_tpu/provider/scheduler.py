@@ -56,6 +56,39 @@ from .batched import Breaker, CoalescingHub
 logger = logging.getLogger(__name__)
 
 
+def select_slot(slots):
+    """The placement policy, shared by BOTH placement levels (ROADMAP
+    item 1's two-level lift): the local shard axis
+    (:class:`DeviceProgramScheduler` picking a chip for a flush) and the
+    fleet's process axis (:class:`fleet.manager.GatewayFleet` picking the
+    gateway that receives the next unit of work — a canary probe or a
+    rebalance placement).  A *slot* is anything with ``breaker`` /
+    ``inflight`` / ``index`` — :class:`Shard` and
+    :class:`fleet.manager.GatewayMember` both qualify, which is what
+    makes placement, quarantine and rebalance ONE policy at both scopes:
+
+    1. a probe-eligible slot (breaker open past its cool-off, or
+       half-open with no canary in flight) wins first — healing requires
+       routing exactly one unit of work back to it;
+    2. otherwise the least-loaded CLOSED slot (tie → lowest index);
+    3. otherwise (nothing healthy) the least-loaded non-quarantined slot
+       — its breaker claim then degrades the work explicitly, exactly
+       like the single-device stack's fallback.
+
+    Deterministic given the load pattern; returns None only for an empty
+    slot list.
+    """
+    slots = list(slots)
+    if not slots:
+        return None
+    probe = [s for s in slots if s.breaker.probe_ready()]
+    if probe:
+        return min(probe, key=lambda s: (s.inflight, s.index))
+    closed = [s for s in slots if s.breaker.state == "closed"]
+    pool = closed or [s for s in slots if s.breaker.state != "quarantined"]
+    return min(pool or slots, key=lambda s: (s.inflight, s.index))
+
+
 def _resolve_devices(n: int) -> list[Any]:
     """First ``n`` visible accelerator devices (n == -1: all), or logical
     placeholders (``None``) when jax or the devices are unavailable —
@@ -227,18 +260,11 @@ class DeviceProgramScheduler(CoalescingHub):
     # -- placement ------------------------------------------------------------
 
     def place(self) -> Shard:
-        """Claim the next flush's shard (pair with :meth:`done`)."""
+        """Claim the next flush's shard (pair with :meth:`done`) — the
+        shared two-level policy (:func:`select_slot`) applied at the
+        local-shard scope."""
         with self._lock:
-            probe = [s for s in self.shards if s.breaker.probe_ready()]
-            if probe:
-                chosen = min(probe, key=lambda s: (s.inflight, s.index))
-            else:
-                closed = [s for s in self.shards
-                          if s.breaker.state == "closed"]
-                pool = closed or [s for s in self.shards
-                                  if s.breaker.state != "quarantined"]
-                chosen = min(pool or self.shards,
-                             key=lambda s: (s.inflight, s.index))
+            chosen = select_slot(self.shards)
             with chosen._lock:
                 chosen.inflight += 1
             healthy = frozenset(

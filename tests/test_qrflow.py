@@ -169,6 +169,56 @@ def test_domains_propagate_through_sync_helpers():
     assert domains[helper.fid] == {"loop", "thread:w"}
 
 
+def test_callgraph_subprocess_edge_resolves_worker_main():
+    """A ``create_subprocess_exec(sys.executable, "-m", <module>, cfg)``
+    spawn (the fleet gateway spawn, fleet/manager.py) resolves to that
+    module's ``main`` as a ``subprocess`` ownership edge: the worker runs
+    in its OWN process (it can never race the manager) but stays
+    reachable/attributed for the dead-code and ownership views."""
+    project = Project({
+        "pkg/manager.py": FileContext("pkg/manager.py", textwrap.dedent(
+            """
+            import asyncio
+            import sys
+
+            async def spawn(cfg):
+                await asyncio.create_subprocess_exec(
+                    sys.executable, "-m", "pkg.gateway", cfg)
+            """)),
+        "pkg/gateway.py": FileContext("pkg/gateway.py", textwrap.dedent(
+            """
+            def main(argv=None):
+                return 0
+            """)),
+    })
+    cg = build_callgraph(project)
+    edge = next(e for e in cg.edges if e.kind == "subprocess")
+    assert edge.callee.name == "main"
+    assert edge.callee.fid.startswith("pkg/gateway.py")
+    domains = infer_domains(cg)
+    assert domains[edge.callee.fid] == {"subprocess"}
+
+
+def test_callgraph_on_event_registration_is_a_loop_cb_edge():
+    """Fleet ``on_event`` handler registrations fire from the control read
+    loops / health tick — loop-domain callbacks, modeled exactly like a
+    call_soon registration."""
+    cg = build_callgraph(_project(
+        """
+        def watch(fleet):
+            fleet.on_event(note)
+
+        def note(event, gateway):
+            pass
+        """
+    ))
+    assert any(e.kind == "loop_cb" and e.callee.name == "note"
+               for e in cg.edges)
+    domains = infer_domains(cg)
+    note = next(f for f in cg.functions.values() if f.name == "note")
+    assert "loop" in domains[note.fid]
+
+
 # -- taint lattice mechanics --------------------------------------------------
 
 

@@ -631,6 +631,51 @@ def test_dispatch_except_no_breaker_lane_drain_clean_and_suppressed():
         "broad-except", "dispatch-except-no-breaker"]
 
 
+def test_dispatch_except_no_breaker_covers_fleet_probe_call():
+    """Trigger (gateway fleet, ISSUE 11): ``_probe_call`` is the fleet
+    breaker's half-open canary dispatch — one control round-trip to a
+    maybe-dead gateway.  An except swallowing its failure without
+    recording to that member's breaker leaves the breaker half-open
+    forever: the fleet-scope twin of a swallowed device canary."""
+    ids = [i for i in rule_ids(
+        """
+        class Fleet:
+            async def probe(self, member, n):
+                try:
+                    await self._probe_call(member, n)
+                except Exception:
+                    return None   # member stuck half-open forever
+        """
+    ) if i == "dispatch-except-no-breaker"]
+    assert ids == ["dispatch-except-no-breaker"]
+
+
+def test_dispatch_except_no_breaker_fleet_probe_clean_and_suppressed():
+    clean = """
+        class Fleet:
+            async def probe(self, member, n):
+                try:
+                    await self._probe_call(member, n)
+                except Exception:
+                    member.breaker.record_failure("probe")
+                    return None
+        """
+    assert "dispatch-except-no-breaker" not in rule_ids(clean)
+    findings, suppressed = lint(
+        """
+        class Fleet:
+            async def probe(self, member, n):
+                try:
+                    await self._probe_call(member, n)
+                except Exception:  # qrlint: disable=dispatch-except-no-breaker, broad-except
+                    return None
+        """
+    )
+    assert [f.rule for f in findings] == []
+    assert sorted(s.rule for s in suppressed) == [
+        "broad-except", "dispatch-except-no-breaker"]
+
+
 # -- engine mechanics ---------------------------------------------------------
 
 

@@ -21,7 +21,11 @@ Besides plain calls the graph records DEFERRED edges with a kind that the
 ownership-domain inference (domains.py) seeds from: ``thread``
 (``threading.Thread(target=...)``), ``executor`` (``run_in_executor`` /
 ``.submit``), ``loop_cb`` (``call_soon``/``call_later``/asyncio
-``add_done_callback``), ``task`` (``create_task``/``ensure_future``),
+``add_done_callback``, plus fleet ``on_event`` handler registrations —
+they fire from the control read loops / health tick), ``task``
+(``create_task``/``ensure_future``), ``subprocess``
+(``create_subprocess_exec`` of a ``python -m <project module>`` worker —
+the fleet gateway spawn — resolved to that module's ``main``),
 ``partial`` (``functools.partial`` — bound arguments feed the taint
 pass), ``await`` (async edges), and ``ref`` (a bare function reference
 passed as an argument).
@@ -101,7 +105,7 @@ class CallSite:
     caller: FunctionInfo
     callee: FunctionInfo
     node: ast.AST
-    kind: str  # call | await | partial | thread | executor | loop_cb | task | ref
+    kind: str  # call | await | partial | thread | executor | loop_cb | task | subprocess | ref
     label: str = ""   # thread name, when known
     bound: int = 0    # positional args bound by a partial
 
@@ -555,6 +559,35 @@ class CallGraph:
             # counts as cross-thread in the race pack
             for target in resolve_ref(call.args[0]):
                 self._add_edge(fn, target, call, "executor")
+            return
+        if leaf == "on_event" and call.args:
+            # fleet event-handler registration (fleet/manager.py
+            # GatewayFleet.on_event): handlers fire from the control read
+            # loops and the health tick — loop-domain callbacks, exactly
+            # like a call_soon registration
+            for target in resolve_ref(call.args[0]):
+                self._add_edge(fn, target, call, "loop_cb")
+            return
+        if leaf == "create_subprocess_exec":
+            # the fleet's gateway spawn (fleet/manager.py _spawn_member):
+            # ``python -m <module> <cfg>`` runs the module's ``main()`` in
+            # its OWN process — a "subprocess" ownership edge, so the
+            # gateway worker's code is reachable from (and attributed to)
+            # the manager that owns its lifecycle
+            consts = [a.value for a in call.args
+                      if isinstance(a, ast.Constant)
+                      and isinstance(a.value, str)]
+            for flag, modname in zip(consts, consts[1:]):
+                if flag != "-m":
+                    continue
+                suffix = modname.replace(".", "/") + ".py"
+                for path, m in self.modules.items():
+                    # path-boundary match: bare endswith would also hit
+                    # otherpkg/gateway.py for ``-m pkg.gateway``
+                    if ((path == suffix or path.endswith("/" + suffix))
+                            and "main" in m.functions):
+                        self._add_edge(fn, m.functions["main"], call,
+                                       "subprocess")
             return
         if leaf in ("call_soon", "call_later", "call_at", "call_soon_threadsafe"):
             idx = 0 if leaf == "call_soon" or leaf == "call_soon_threadsafe" else 1

@@ -7,6 +7,9 @@ body can run in:
   (``call_soon``/``call_later``), asyncio-future done-callbacks, tasks.
 * ``thread:<n>`` — a named ``threading.Thread`` target (and everything it
   calls): e.g. ``thread:qrp2p-warmup`` for the background warmup.
+* ``subprocess`` — a ``python -m`` worker module's entry point (the
+  fleet gateway spawn): its own process, so it can never race the
+  manager — seeded for reachability/ownership attribution only.
 * ``executor``   — callables submitted to a ThreadPoolExecutor
   (``run_in_executor`` / ``.submit``) and their transitive callees, plus
   callables handed to the sharded crypto plane's placement boundary
@@ -67,6 +70,11 @@ def infer_domains(cg: CallGraph) -> dict[str, set[str]]:
             domains[site.callee.fid].add("executor")
         elif site.kind in ("loop_cb", "task"):
             domains[site.callee.fid].add("loop")
+        elif site.kind == "subprocess":
+            # a spawned gateway worker runs in its OWN process: its state
+            # can never race the manager's, but the edge keeps the worker
+            # reachable/attributed for the dead-code and ownership views
+            domains[site.callee.fid].add("subprocess")
     changed = True
     while changed:
         changed = False

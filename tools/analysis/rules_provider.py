@@ -229,6 +229,12 @@ class DispatchExceptBreakerRule(Rule):
     placement context, and the per-SHARD breakers it routes outcomes to
     use the same recording names — so a swallowed placed-dispatch failure
     on one shard is caught exactly like a single-breaker one.
+
+    The gateway fleet (fleet/manager.py) extends it again at the second
+    placement level: ``_probe_call(...)`` is the fleet breaker's half-open
+    canary dispatch (one control round-trip to a maybe-dead gateway), and
+    a swallowed probe failure would leave that member's breaker half-open
+    forever — the fleet-scope twin of a swallowed device canary.
     """
 
     id = "dispatch-except-no-breaker"
@@ -238,9 +244,10 @@ class DispatchExceptBreakerRule(Rule):
     )
 
     #: called-function names that ARE a device dispatch (run_placed is the
-    #: scheduler's placement boundary: one placed device program)
+    #: scheduler's placement boundary: one placed device program;
+    #: _probe_call is the fleet router's half-open canary dispatch)
     _DISPATCH_CALLEES = {"batch_fn", "_device_call", "_warm_call",
-                         "run_placed"}
+                         "run_placed", "_probe_call"}
     #: executor attributes whose run_in_executor submissions are dispatches
     _DISPATCH_EXECUTORS = {"device_executor", "warmup_executor"}
     #: handler calls that count as recording the FAILURE to the breaker

@@ -32,6 +32,14 @@ python -m tools.swarm_bench --storm --peers 48 --concurrency 48 \
     --rekey-every 2 --seed 11 >/dev/null
 echo "storm smoke ok (48 sessions, 0 failures)"
 
+# Fleet chaos smoke (docs/fleet.md): 3 gateway PROCESSES behind the
+# consistent-hash router, 60 sessions, one seeded mid-storm SIGKILL of
+# gw1 — must converge with 0 lost established sessions, 0 plaintext
+# sends, a fired kill, and a bounded handshake-failure burst.  Small
+# session counts run in smoke mode: no committed-artifact writes.
+python bench.py --storm --fleet 3 --sessions 60 >/dev/null
+echo "fleet chaos smoke ok (3 gateways, 60 sessions, seeded gw1 kill survived)"
+
 # Fleet-observability smoke (docs/observability.md): two processes' span
 # dumps — the child's recv chain parented on the parent's propagated wire
 # context — must merge into ONE chrome trace with two process lanes, one

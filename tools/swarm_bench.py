@@ -26,6 +26,10 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging  # noqa: E402
+from quantum_resistant_p2p_tpu.fleet.stormlib import (  # noqa: E402
+    StormAEAD as _StormAEAD, prewarm_facades as _prewarm_facades,
+    register_storm_providers as _register_storm_providers,
+    storm_env as _storm_env)
 from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode  # noqa: E402
 
 
@@ -104,17 +108,11 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
         # concurrency level — at least the floor bucket itself, which is
         # what all flushes use when the floor exceeds concurrency
         b = hub._bkem.bucket_floor
-        limit = min(max_batch, max(b, concurrency, 1))
-        sizes = []
-        while b <= limit:
-            sizes.append(b)
-            b *= 2
         t0 = time.perf_counter()
-        loop = asyncio.get_running_loop()
-        facades = [proto._bkem, proto._bsig, hub._bkem, hub._bsig]
-        facades += [f for f in (proto._bfused, hub._bfused) if f is not None]
-        for facade in facades:
-            await loop.run_in_executor(None, facade.warmup, tuple(sizes))
+        sizes = await _prewarm_facades(
+            (proto._bkem, proto._bsig, hub._bkem, hub._bsig,
+             proto._bfused, hub._bfused),
+            min(max_batch, max(b, concurrency, 1)), floor=b)
         prewarm_s = time.perf_counter() - t0
         print(f"prewarm: buckets {sizes} on 4 facades in {prewarm_s:.1f}s",
               file=sys.stderr)
@@ -369,143 +367,6 @@ def write_obs_artifacts(stats: dict, out_dir: str | Path,
 # The emitted JSON carries the provider set honestly.
 
 
-class _StormAEAD:
-    """Stdlib encrypt-then-MAC AEAD (HMAC-SHA256 over a SHA-256 keystream)
-    — bench-only: lets the FULL handshake (incl. the ke_test AEAD probe)
-    and bulk messaging run on images without the ``cryptography`` wheel.
-    Mirrors the test suites' ToyAEAD; never registered as a provider."""
-
-    name = "STORM-AEAD"
-    display_name = "STORM-AEAD (bench-only stdlib)"
-    key_size = 32
-    nonce_size = 16
-
-    @staticmethod
-    def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
-        import hashlib
-
-        out = b""
-        ctr = 0
-        while len(out) < n:
-            out += hashlib.sha256(key + nonce + ctr.to_bytes(8, "big")).digest()
-            ctr += 1
-        return out[:n]
-
-    def encrypt(self, key, plaintext, associated_data=None):
-        import hashlib
-        import hmac
-        import os
-
-        nonce = os.urandom(self.nonce_size)
-        ct = bytes(a ^ b for a, b in
-                   zip(plaintext, self._keystream(key, nonce, len(plaintext))))
-        tag = hmac.new(key, nonce + ct + (associated_data or b""),
-                       hashlib.sha256).digest()
-        return nonce + ct + tag
-
-    def decrypt(self, key, data, associated_data=None):
-        import hashlib
-        import hmac
-
-        if len(data) < self.nonce_size + 32:
-            raise ValueError("ciphertext too short")
-        nonce, ct, tag = (data[: self.nonce_size], data[self.nonce_size:-32],
-                          data[-32:])
-        want = hmac.new(key, nonce + ct + (associated_data or b""),
-                        hashlib.sha256).digest()
-        if not hmac.compare_digest(tag, want):
-            raise ValueError("authentication failed")
-        return bytes(a ^ b for a, b in
-                     zip(ct, self._keystream(key, nonce, len(ct))))
-
-
-_STORM_REGISTERED = False
-
-
-def _register_storm_providers() -> None:
-    """Register the stdlib STORM-KEM/STORM-SIG toys for BOTH backends (the
-    'tpu' registration rides the device-path queue machinery; 'cpu' arms
-    the degrade fallback) — idempotent."""
-    global _STORM_REGISTERED
-    if _STORM_REGISTERED:
-        return
-    import hashlib
-    import hmac
-    import os
-
-    from quantum_resistant_p2p_tpu.provider.base import (
-        KeyExchangeAlgorithm, SignatureAlgorithm)
-    from quantum_resistant_p2p_tpu.provider.registry import (
-        register_kem, register_signature)
-
-    class StormKEM(KeyExchangeAlgorithm):
-        name = "STORM-KEM"
-        display_name = "STORM-KEM (bench-only stdlib)"
-        public_key_len = 32
-        secret_key_len = 32
-        ciphertext_len = 32
-        shared_secret_len = 32
-
-        def __init__(self, backend="cpu"):
-            self.backend = backend
-
-        def generate_keypair(self):
-            sk = os.urandom(32)
-            return hashlib.sha256(b"pk" + sk).digest(), sk
-
-        def encapsulate(self, public_key):
-            ct = os.urandom(32)
-            return ct, hashlib.sha256(public_key + ct).digest()
-
-        def decapsulate(self, secret_key, ciphertext):
-            pk = hashlib.sha256(b"pk" + secret_key).digest()
-            return hashlib.sha256(pk + ciphertext).digest()
-
-    class StormSig(SignatureAlgorithm):
-        name = "STORM-SIG"
-        display_name = "STORM-SIG (bench-only stdlib)"
-        public_key_len = 32
-        secret_key_len = 32
-        signature_len = 32
-
-        def __init__(self, backend="cpu"):
-            self.backend = backend
-
-        def generate_keypair(self):
-            sk = os.urandom(32)
-            return hashlib.sha256(b"pk" + sk).digest(), sk
-
-        def sign(self, secret_key, message):
-            pk = hashlib.sha256(b"pk" + secret_key).digest()
-            return hashlib.sha256(b"sig" + pk + message).digest()
-
-        def verify(self, public_key, message, signature):
-            return hmac.compare_digest(
-                signature,
-                hashlib.sha256(b"sig" + public_key + message).digest())
-
-    register_kem("STORM-KEM", lambda backend, devices=0: StormKEM(backend),
-                 ("cpu", "tpu"))
-    register_signature("STORM-SIG",
-                       lambda backend, devices=0: StormSig(backend),
-                       ("cpu", "tpu"))
-    _STORM_REGISTERED = True
-
-
-def _raise_fd_limit(need: int) -> None:
-    """A 10k-session storm needs ~2 fds per session in one process: lift
-    the soft RLIMIT_NOFILE to the hard cap (best-effort)."""
-    try:
-        import resource
-
-        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
-        if soft < need:
-            resource.setrlimit(resource.RLIMIT_NOFILE,
-                               (min(max(need, soft), hard), hard))
-    except (ImportError, ValueError, OSError):  # pragma: no cover
-        pass
-
-
 def _percentile(sorted_vals: list, p: float):
     if not sorted_vals:
         return None
@@ -547,9 +408,6 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
     from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode
     from quantum_resistant_p2p_tpu.provider import get_kem, get_signature
 
-    _raise_fd_limit(4 * sessions + 64)
-    old_timeout = _messaging.KEY_EXCHANGE_TIMEOUT
-    _messaging.KEY_EXCHANGE_TIMEOUT = ke_timeout
     if providers == "stdlib":
         _register_storm_providers()
         kem_name, sig_name = "STORM-KEM", "STORM-SIG"
@@ -562,160 +420,154 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
 
     rng = random.Random(seed)
     aead = _StormAEAD()
-    # everything below runs under one finally: an exception escaping a
-    # session task (or Ctrl-C) must still restore the module-global
-    # protocol timeout and close every socket -- bench.py's storm
-    # ratchet runs four storms in one process
+    # storm_env (fleet/stormlib.py — the same guard every fleet gateway
+    # subprocess enters): raised fd limit + module-global protocol-timeout
+    # save/restore.  Everything below also runs under one finally: an
+    # exception escaping a session task (or Ctrl-C) must still close every
+    # socket, and the env's own finally restores the timeout -- bench.py's
+    # storm ratchet runs four storms in one process
     clients: list[SecureMessaging] = []
     hub_node = proto = None
-    try:
-        gateway_kw = dict(
-            use_batching=True, max_batch=max_batch, max_wait_ms=max_wait_ms,
-            autotune=autotune, shard_devices=shard_devices,
-        )
-        hub_node = P2PNode(node_id="hub", host="127.0.0.1", port=0,
-                           max_peers=hub_max_peers)
-        await hub_node.start()
-        hub = SecureMessaging(
-            hub_node, kem=get_kem(kem_name, "tpu"), symmetric=aead,
-            signature=get_signature(sig_name, "tpu"),
-            max_inflight_handshakes=handshake_budget,
-            bulk_lane_capacity=bulk_lane_capacity, **gateway_kw,
-        )
-        received = 0
-
-        def on_msg(peer_id, message):
-            nonlocal received
-            if not message.is_system:
-                received += 1
-
-        hub.register_message_listener(on_msg)
-
-        # one shared client-side batching plane (the proto pattern above):
-        # every client coalesces into the same queues / autotuner
-        proto = SecureMessaging(
-            P2PNode(node_id="proto", host="127.0.0.1", port=0),
-            kem=get_kem(kem_name, "tpu"), symmetric=aead,
-            signature=get_signature(sig_name, "tpu"), **gateway_kw,
-        )
-        await hub.wait_ready()
-        await proto.wait_ready()
-
-        if prewarm:
-            # warm every pow2 flush bucket a live storm can hit (up to the
-            # cap) on BOTH planes — the run_swarm --prewarm lesson: without
-            # this the burst lands on cold buckets and the degrade path
-            # quietly serves the storm from the fallback
-            sizes, b = [], 1
-            limit = min(max_batch, max(concurrency, 1), prewarm_cap)
-            while b <= limit:
-                sizes.append(b)
-                b *= 2
-            loop = asyncio.get_running_loop()
-            facades = [proto._bkem, proto._bsig, hub._bkem, hub._bsig]
-            facades += [f for f in (proto._bfused, hub._bfused) if f is not None]
-            for facade in facades:
-                await loop.run_in_executor(None, facade.warmup, tuple(sizes))
-
-        n_keys = sessions
-        kp_pks, kp_sks = proto.signature.generate_keypair_batch(n_keys)
-
-        first_lat: list[float] = []
-        rekey_lat: list[float] = []
-        churns = rekeys = 0
-        failures = 0
-        sem = asyncio.Semaphore(concurrency)
-
-        def make_client(i: int) -> SecureMessaging:
-            node = P2PNode(node_id=f"peer{i:05d}", host="127.0.0.1", port=0)
-            sm = SecureMessaging(
-                node, kem=proto.kem, symmetric=proto.symmetric,
-                signature=proto.signature,
-                sig_keypair=(bytes(kp_pks[i]), bytes(kp_sks[i])))
-            sm._bkem, sm._bsig, sm._bfused = proto._bkem, proto._bsig, proto._bfused
-            sm.use_batching = True
-            clients.append(sm)
-            return sm
-
-        async def handshake(sm, bucket: list[float]) -> bool:
-            nonlocal failures
-            t0 = time.perf_counter()
-            ok = await sm.initiate_key_exchange("hub")
-            bucket.append(time.perf_counter() - t0)
-            if not ok:
-                failures += 1
-            return ok
-
-        async def one_session(i: int, start_at: float, t_origin: float,
-                              srng: random.Random) -> None:
-            nonlocal churns, rekeys, failures
-            delay = start_at - (time.perf_counter() - t_origin)
-            if delay > 0:
-                await asyncio.sleep(delay)
-            async with sem:
-                sm = make_client(i)
-                if await sm.node.connect_to_peer("127.0.0.1", hub_node.port,
-                                                 retries=4) != "hub":
-                    failures += 1
-                    return
-                if not await handshake(sm, first_lat):
-                    return
-                for k in range(msgs_per_session):
-                    await sm.send_message("hub", b"storm payload %d/%d" % (i, k))
-                    if rekey_every and (k + 1) % rekey_every == 0:
-                        # forced re-key: drop the session key and run the
-                        # 5-message handshake again — rides the REKEY lane on
-                        # both sides (sm and hub have completed a session)
-                        sm.shared_keys.pop("hub", None)
-                        sm.ke_state["hub"] = _messaging.KeyExchangeState.NONE
-                        rekeys += 1
-                        if not await handshake(sm, rekey_lat):
-                            return
-                if churn_fraction and srng.random() < churn_fraction:
-                    # churn: drop the TCP session entirely, redial, re-key
-                    await sm.node.disconnect_from_peer("hub")
-                    churns += 1
-                    if await sm.node.connect_to_peer("127.0.0.1", hub_node.port,
-                                                     retries=4) == "hub":
-                        await handshake(sm, rekey_lat)
-                    else:
-                        failures += 1
-
-        # seeded arrival schedule + per-session RNGs: the offered-load trace
-        # is a pure function of (seed, sessions, arrival_rate)
-        offsets = []
-        t = 0.0
-        for _ in range(sessions):
-            if arrival_rate > 0:
-                t += rng.uniform(0.0, 2.0 / arrival_rate)  # mean 1/rate
-            offsets.append(t)
-        session_rngs = [random.Random(rng.getrandbits(64)) for _ in range(sessions)]
-
-        plan = FaultPlan(seed, list(fault_rules)) if fault_rules else None
-        ctx = plan.activate() if plan is not None else None
-        if ctx is not None:
-            ctx.__enter__()
-        t_origin = time.perf_counter()
+    with _storm_env(ke_timeout, fd_need=4 * sessions + 64):
         try:
-            await asyncio.gather(*(
-                one_session(i, offsets[i], t_origin, session_rngs[i])
-                for i in range(sessions)))
-        finally:
+            gateway_kw = dict(
+                use_batching=True, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                autotune=autotune, shard_devices=shard_devices,
+            )
+            hub_node = P2PNode(node_id="hub", host="127.0.0.1", port=0,
+                               max_peers=hub_max_peers)
+            await hub_node.start()
+            hub = SecureMessaging(
+                hub_node, kem=get_kem(kem_name, "tpu"), symmetric=aead,
+                signature=get_signature(sig_name, "tpu"),
+                max_inflight_handshakes=handshake_budget,
+                bulk_lane_capacity=bulk_lane_capacity, **gateway_kw,
+            )
+            received = 0
+
+            def on_msg(peer_id, message):
+                nonlocal received
+                if not message.is_system:
+                    received += 1
+
+            hub.register_message_listener(on_msg)
+
+            # one shared client-side batching plane (the proto pattern above):
+            # every client coalesces into the same queues / autotuner
+            proto = SecureMessaging(
+                P2PNode(node_id="proto", host="127.0.0.1", port=0),
+                kem=get_kem(kem_name, "tpu"), symmetric=aead,
+                signature=get_signature(sig_name, "tpu"), **gateway_kw,
+            )
+            await hub.wait_ready()
+            await proto.wait_ready()
+
+            if prewarm:
+                # warm every pow2 flush bucket a live storm can hit (up to the
+                # cap) on BOTH planes
+                await _prewarm_facades(
+                    (proto._bkem, proto._bsig, hub._bkem, hub._bsig,
+                     proto._bfused, hub._bfused),
+                    min(max_batch, max(concurrency, 1), prewarm_cap))
+
+            n_keys = sessions
+            kp_pks, kp_sks = proto.signature.generate_keypair_batch(n_keys)
+
+            first_lat: list[float] = []
+            rekey_lat: list[float] = []
+            churns = rekeys = 0
+            failures = 0
+            sem = asyncio.Semaphore(concurrency)
+
+            def make_client(i: int) -> SecureMessaging:
+                node = P2PNode(node_id=f"peer{i:05d}", host="127.0.0.1", port=0)
+                sm = SecureMessaging(
+                    node, kem=proto.kem, symmetric=proto.symmetric,
+                    signature=proto.signature,
+                    sig_keypair=(bytes(kp_pks[i]), bytes(kp_sks[i])))
+                sm._bkem, sm._bsig, sm._bfused = proto._bkem, proto._bsig, proto._bfused
+                sm.use_batching = True
+                clients.append(sm)
+                return sm
+
+            async def handshake(sm, bucket: list[float]) -> bool:
+                nonlocal failures
+                t0 = time.perf_counter()
+                ok = await sm.initiate_key_exchange("hub")
+                bucket.append(time.perf_counter() - t0)
+                if not ok:
+                    failures += 1
+                return ok
+
+            async def one_session(i: int, start_at: float, t_origin: float,
+                                  srng: random.Random) -> None:
+                nonlocal churns, rekeys, failures
+                delay = start_at - (time.perf_counter() - t_origin)
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                async with sem:
+                    sm = make_client(i)
+                    if await sm.node.connect_to_peer("127.0.0.1", hub_node.port,
+                                                     retries=4) != "hub":
+                        failures += 1
+                        return
+                    if not await handshake(sm, first_lat):
+                        return
+                    for k in range(msgs_per_session):
+                        await sm.send_message("hub", b"storm payload %d/%d" % (i, k))
+                        if rekey_every and (k + 1) % rekey_every == 0:
+                            # forced re-key: drop the session key and run the
+                            # 5-message handshake again — rides the REKEY lane on
+                            # both sides (sm and hub have completed a session)
+                            sm.shared_keys.pop("hub", None)
+                            sm.ke_state["hub"] = _messaging.KeyExchangeState.NONE
+                            rekeys += 1
+                            if not await handshake(sm, rekey_lat):
+                                return
+                    if churn_fraction and srng.random() < churn_fraction:
+                        # churn: drop the TCP session entirely, redial, re-key
+                        await sm.node.disconnect_from_peer("hub")
+                        churns += 1
+                        if await sm.node.connect_to_peer("127.0.0.1", hub_node.port,
+                                                         retries=4) == "hub":
+                            await handshake(sm, rekey_lat)
+                        else:
+                            failures += 1
+
+            # seeded arrival schedule + per-session RNGs: the offered-load trace
+            # is a pure function of (seed, sessions, arrival_rate)
+            offsets = []
+            t = 0.0
+            for _ in range(sessions):
+                if arrival_rate > 0:
+                    t += rng.uniform(0.0, 2.0 / arrival_rate)  # mean 1/rate
+                offsets.append(t)
+            session_rngs = [random.Random(rng.getrandbits(64)) for _ in range(sessions)]
+
+            plan = FaultPlan(seed, list(fault_rules)) if fault_rules else None
+            ctx = plan.activate() if plan is not None else None
             if ctx is not None:
-                ctx.__exit__(None, None, None)
-        elapsed = time.perf_counter() - t_origin
+                ctx.__enter__()
+            t_origin = time.perf_counter()
+            try:
+                await asyncio.gather(*(
+                    one_session(i, offsets[i], t_origin, session_rngs[i])
+                    for i in range(sessions)))
+            finally:
+                if ctx is not None:
+                    ctx.__exit__(None, None, None)
+            elapsed = time.perf_counter() - t_origin
 
-        hub_metrics = hub.metrics()
-        proto_metrics = proto.metrics()
+            hub_metrics = hub.metrics()
+            proto_metrics = proto.metrics()
 
-    finally:
-        _messaging.KEY_EXCHANGE_TIMEOUT = old_timeout
-        for sm in clients:
-            await sm.node.stop()
-        if hub_node is not None:
-            await hub_node.stop()
-        if proto is not None:
-            await proto.node.stop()
+        finally:
+            for sm in clients:
+                await sm.node.stop()
+            if hub_node is not None:
+                await hub_node.stop()
+            if proto is not None:
+                await proto.node.stop()
 
     total_hs = len(first_lat) + len(rekey_lat)
     total_ops = fb_ops = 0
@@ -933,6 +785,23 @@ def main(argv=None) -> int:
                          "sessions with arrival pacing, rekey/bulk mix and "
                          "churn through the gateway (admission control, "
                          "priority lanes, batch autotuner)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="with --storm: drive the sessions through an "
+                         "N-gateway-PROCESS fleet behind the consistent-hash "
+                         "router (fleet/) instead of one in-process hub")
+    ap.add_argument("--spawn", default="process", choices=("process", "task"),
+                    help="fleet gateway isolation: real subprocesses "
+                         "(default) or in-process asyncio tasks (CI images "
+                         "without subprocess headroom; same control protocol)")
+    ap.add_argument("--chaos-kill", default="",
+                    help="fleet chaos: SIGKILL this gateway id mid-storm via "
+                         "the seeded fault plan's process scope (e.g. 'gw1')")
+    ap.add_argument("--kill-tick", type=int, default=8,
+                    help="health tick the --chaos-kill rule fires on")
+    ap.add_argument("--per-gateway-max-peers", type=int, default=0,
+                    help="fleet: per-gateway connection budget; the fleet "
+                         "admission budget is the sum over CLOSED members "
+                         "(0 = unlimited)")
     ap.add_argument("--providers", default="stdlib",
                     choices=("stdlib", "real"),
                     help="storm crypto: stdlib toys (serving-loop workload, "
@@ -953,6 +822,31 @@ def main(argv=None) -> int:
     ap.add_argument("--handshake-budget", type=int, default=0)
     ap.add_argument("--bulk-lane-capacity", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.storm and args.fleet:
+        from quantum_resistant_p2p_tpu.fleet.storm import (
+            default_kill_rules, run_fleet_storm, write_fleet_artifacts)
+
+        rules = (default_kill_rules(args.chaos_kill, args.kill_tick)
+                 if args.chaos_kill else None)
+        stats = asyncio.run(run_fleet_storm(
+            args.peers, gateways=args.fleet, providers=args.providers,
+            seed=args.seed, arrival_rate=args.arrival_rate,
+            concurrency=args.concurrency,
+            msgs_per_session=args.msgs_per_session, spawn=args.spawn,
+            per_gateway_max_peers=args.per_gateway_max_peers,
+            handshake_budget=args.handshake_budget,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            autotune=args.autotune, ke_timeout=args.ke_timeout,
+            fault_rules=rules,
+        ))
+        if args.obs_dir:
+            write_obs_artifacts(stats, args.obs_dir, stem="fleet_storm")
+            write_fleet_artifacts(stats, args.obs_dir)
+        print(json.dumps(stats))
+        # the fleet chaos currency: no ESTABLISHED session may be lost —
+        # un-established failures under a kill are the bounded burst the
+        # report carries honestly
+        return 0 if stats["lost_established_sessions"] == 0 else 1
     if args.storm:
         stats = asyncio.run(run_storm(
             args.peers, providers=args.providers,
