@@ -70,6 +70,21 @@ def _write_slo_report(mode: str, slo: dict | None) -> None:
         json.dumps({"mode": mode, "slo": slo}, indent=2) + "\n")
 
 
+def _write_cost_snapshot(mode: str, cost: dict | None) -> None:
+    """The device-cost ledger snapshot for the bench run (obs/cost.py):
+    padding waste, compile counts/seconds, opcache hit rates — written as
+    ``bench_results/{mode}_cost_snapshot.json`` next to the storm
+    artifacts and uploaded by ci.yml (``if-no-files-found: ignore``)."""
+    from pathlib import Path
+
+    if cost is None:
+        return
+    Path("bench_results").mkdir(exist_ok=True)
+    Path(f"bench_results/{mode}_cost_snapshot.json").write_text(
+        json.dumps({"mode": mode, "cost": cost}, indent=2,
+                   sort_keys=True) + "\n")
+
+
 def slo_main(out_path: str | None = None, peers: int = SLO_PEERS,
              warmup: int = 4) -> int:
     """Single-handshake SLO probe as a first-class bench output.
@@ -212,9 +227,11 @@ def storm_main(out_path: str | None = None, sessions: int = STORM_SESSIONS,
         "ok": True,
     }
     # obs artifacts for the LAST (tuned) storm window: merged multi-node
-    # trace + metrics snapshot, plus the SLO engines' burn report
+    # trace + metrics snapshot, plus the SLO engines' burn report and the
+    # device-cost ledger snapshot (padding waste / compiles / opcache)
     write_obs_artifacts(out, "bench_results", stem="storm")
     _write_slo_report("storm", runs[True][-1].get("slo"))
+    _write_cost_snapshot("storm", runs[True][-1].get("cost"))
     rc = 0
     if failures:
         print(f"STORM FAIL: {failures} handshake failure(s)", file=sys.stderr)
@@ -307,11 +324,19 @@ def fleet_storm_main(out_path: str | None = None,
     chaos_run = rules is not None
     report_dir = (Path("bench_results/fleet_reports")
                   if chaos_run and not smoke else None)
+    # live telemetry rides every fleet ratchet run: one scrapeable
+    # endpoint per gateway (announced via hello/heartbeat) and a mid-storm
+    # qrtop --snapshot against them — the committed
+    # fleet_storm_cost_snapshot.json is produced by the SAME scrape path
+    # a human's dashboard uses (tools/qrtop.py)
+    from tools.qrtop import snapshot_endpoints
+
     out = asyncio.run(run_fleet_storm(
         sessions, gateways=gateways, seed=STORM_SEED,
         arrival_rate=STORM_ARRIVAL_RATE, concurrency=STORM_CONCURRENCY,
         msgs_per_session=2, spawn=spawn, fault_rules=rules,
         hb_interval=hb_interval, report_dir=report_dir,
+        telemetry=True, scrape_cb=snapshot_endpoints,
     ))
     served = out["device_served_fraction"] or 0.0
     burst_budget = STORM_CONCURRENCY
@@ -353,6 +378,11 @@ def fleet_storm_main(out_path: str | None = None,
             # the flagship chaos run, never the parity comparison point
             write_obs_artifacts(out, "bench_results", stem="fleet_storm")
             write_fleet_artifacts(out, "bench_results")
+            _write_cost_snapshot("fleet_storm", {
+                "snapshot": out.get("cost_snapshot"),
+                "fleet_totals": out.get("fleet_cost"),
+                "telemetry": out.get("telemetry"),
+            })
         Path("bench_results").mkdir(exist_ok=True)
         n = 1
         while Path(f"bench_results/fleet_storm_r{n:02d}.json").exists():

@@ -28,6 +28,7 @@ import hashlib
 import hmac
 import json
 import logging
+import os
 import time
 import uuid
 from pathlib import Path
@@ -36,6 +37,7 @@ from typing import Any, Callable
 from ..net.p2p_node import P2PNode
 from ..obs import flight as obs_flight
 from ..obs import trace as obs_trace
+from ..obs.cost import CostLedger
 from ..obs.metrics import Registry
 from ..provider import get_fused, get_kem, get_signature, get_symmetric
 from ..provider.base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricAlgorithm
@@ -201,6 +203,7 @@ class SecureMessaging:
         autotune: bool | None = None,
         max_inflight_handshakes: int = 0,
         bulk_lane_capacity: int = 0,
+        telemetry_port: int | None = None,
     ):
         self.node = node
         self.key_storage = key_storage
@@ -270,6 +273,19 @@ class SecureMessaging:
             "handshake_latency_s", "initiated handshake attempt latency (s)")
         self.registry.register_collector("queues", self._collect_queues)
         self.registry.register_collector("opcaches", self._collect_opcaches)
+        #: engine birth (uptime for /healthz and snapshot-mode hs/s rates)
+        self._t0 = time.monotonic()
+        #: the device-cost ledger (obs/cost.py): padding waste, compile
+        #: attribution, device seconds per op family, opcache windows, and
+        #: the autotuner decision journal — registered on this registry so
+        #: one Prometheus scrape exports the serving economics
+        self.cost = CostLedger(registry=self.registry)
+        # both halves of the handshake work feed the per-1k denominator:
+        # a pure fleet gateway only RESPONDS (admitted ke_inits), so an
+        # initiator-only count would leave the headline gauge permanently
+        # None on exactly the processes the ledger exists to price
+        self.cost.set_handshakes_fn(
+            lambda: self._handshake_latency.count + self._ctr_hs_admitted.value)
         #: responder-side concurrent-handshake budget (0 = unlimited):
         #: over it, ke_init draws a typed BUSY rejection instead of joining
         #: a pile-up that times every initiator out
@@ -306,6 +322,7 @@ class SecureMessaging:
                 registry=self.registry,
             )
             self._queue_breaker = self._scheduler.shards[0].breaker
+            self._scheduler.attach_cost(self.cost)
             # the adaptive batch/flush autotuner (provider/autotune.py):
             # replaces the static flush policy on the hot path when armed;
             # autotune=None reads the QRP2P_AUTOTUNE env default, and OFF
@@ -317,7 +334,8 @@ class SecureMessaging:
                        else autotune)
             if enabled:
                 self._autotuner = Autotuner(registry=self.registry,
-                                            scheduler=self._scheduler)
+                                            scheduler=self._scheduler,
+                                            cost=self.cost)
             self._bkem = BatchedKEM(self.kem, max_batch, max_wait_ms,
                                     fallback=self._cpu_fallback_kem(),
                                     scheduler=self._scheduler,
@@ -330,6 +348,7 @@ class SecureMessaging:
                                           lane_capacity=self._lane_capacity)
             self._bfused = self._make_fused()
             self._attach_tuners()
+            self._attach_cost()
             self._spawn_warmup()
 
         # the SLO engine (obs/slo.py): burn-rate evaluation over the
@@ -385,6 +404,30 @@ class SecureMessaging:
         ):
             node.register_message_handler(msg_type, handler)
         node.register_connection_handler(self._on_connection_event)
+
+        # live telemetry endpoints (obs/http.py), started LAST so a scrape
+        # can never race a partially constructed engine.  OFF by default —
+        # no listener, no thread, not even the module import.  An explicit
+        # telemetry_port wins; otherwise QRP2P_HTTP_PORT decides (unset/
+        # empty = disabled, 0 = ephemeral, N = fixed port).
+        self.telemetry = None
+        if telemetry_port is None and os.environ.get("QRP2P_HTTP_PORT"):
+            from ..obs.http import env_port
+
+            telemetry_port = env_port()
+        if telemetry_port is not None:
+            from ..obs.http import TelemetryServer
+
+            try:
+                self.telemetry = TelemetryServer.for_engine(
+                    self, port=telemetry_port)
+            except OSError as e:
+                # same policy as a malformed env value: an optional
+                # observability listener (port in use, privileged port)
+                # must degrade loudly, never kill the serving engine
+                logger.warning(
+                    "telemetry endpoints disabled: cannot bind port %s "
+                    "(%s)", telemetry_port, e)
 
     # ------------------------------------------------------------------ util
 
@@ -458,6 +501,25 @@ class SecureMessaging:
         if self._autotuner is not None:
             self._autotuner.attach_facades(self._bkem, self._bsig,
                                            self._bfused)
+
+    def _attach_cost(self) -> None:
+        """(Re-)attach the cost ledger to every live facade queue and the
+        providers' opcaches — called at construction and after every
+        hot-swap facade/provider rebuild (fresh queue and cache objects
+        each time; attach is a plain attribute set, so re-running is
+        idempotent)."""
+        from ..provider.batched import facade_queues
+
+        for facade in (self._bkem, self._bsig, self._bfused):
+            if facade is None:
+                continue
+            facade.cost = self.cost
+            for q in facade_queues(facade):
+                q.cost = self.cost
+        for algo, kind in ((self.kem, "kem"), (self.signature, "sig")):
+            cache = getattr(algo, "opcache", None)
+            if cache is not None and hasattr(cache, "attach_cost"):
+                cache.attach_cost(self.cost, kind)
 
     def _is_rekey(self, peer_id: str) -> bool:
         """True while ``peer_id`` has a RECENT completed session (within
@@ -1118,6 +1180,55 @@ class SecureMessaging:
         (also served as ``metrics()["slo"]`` and the CLI ``/slo``)."""
         return self.slo.status()
 
+    # ------------------------------------------------------- live telemetry
+
+    @property
+    def telemetry_port(self) -> int | None:
+        """The bound telemetry port (None when telemetry is disabled)."""
+        return self.telemetry.port if self.telemetry is not None else None
+
+    def stop_telemetry(self) -> None:
+        """Close the telemetry listener (engine drain; idempotent)."""
+        srv, self.telemetry = self.telemetry, None
+        if srv is not None:
+            srv.stop()
+
+    def health_doc(self) -> dict[str, Any]:
+        """The ``/healthz`` document: liveness + uptime (a process that
+        answers at all is alive; readiness is :meth:`ready_status`)."""
+        return {
+            "ok": True,
+            "node": self.node_id,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            # both halves of the handshake work: initiated attempts AND
+            # inbound ke_inits admitted (a pure gateway only responds, so
+            # a dashboard's hs/s must not read 0 off the initiator count)
+            "handshake_attempts": self._handshake_latency.count,
+            "handshakes_admitted": self._ctr_hs_admitted.value,
+        }
+
+    def ready_status(self) -> dict[str, Any]:
+        """The ``/readyz`` document: ready = the background warm-up sweep
+        finished (every warm bucket compiled — a cold gateway serves its
+        first handshakes from the cpu fallback at cpu latency) AND no
+        breaker is away from ``closed`` (an open/quarantined plane is
+        serving degraded).  A load balancer keys on the 200/503 status;
+        the body says WHY."""
+        warm = self._warmup_thread is None or not self._warmup_thread.is_alive()
+        breakers: dict[str, str] = {}
+        if self._scheduler is not None:
+            breakers = {f"shard{s.index}": s.breaker.state
+                        for s in self._scheduler.shards}
+        elif self._bkem is not None:
+            breakers = {"breaker": self._bkem.breaker.state}
+        degraded = sorted(k for k, st in breakers.items() if st != "closed")
+        return {
+            "ready": warm and not degraded,
+            "warm": warm,
+            "breakers": breakers,
+            "degraded": degraded,
+        }
+
     def slo_report(self) -> dict[str, Any]:
         """The per-NODE SLO report document: one gateway process's burn
         evaluation plus the cumulative counters a fleet merge needs.
@@ -1194,6 +1305,9 @@ class SecureMessaging:
         # snapshot/Prometheus scrape — whichever surface a gateway is
         # watched through, the burn windows advance.
         out["slo"] = self.slo.status()
+        # the device-cost ledger (obs/cost.py; docs/observability.md
+        # "Reading the cost ledger") — additive key, same contract
+        out["cost"] = self.cost.snapshot()
         return out
 
     def _spawn_warmup(self, kem: bool = True, sig: bool = True) -> None:
@@ -1884,6 +1998,7 @@ class SecureMessaging:
                                     lane_capacity=self._lane_capacity)
             self._bfused = self._make_fused()
             self._attach_tuners()
+            self._attach_cost()
             self._spawn_warmup(kem=True, sig=False)
         peers = list(self.shared_keys)
         self.shared_keys.clear()
@@ -1909,6 +2024,7 @@ class SecureMessaging:
             # JSON, so the fused facade's baked-in pk offset just moved
             self._bfused = self._make_fused()
             self._attach_tuners()
+            self._attach_cost()
             self._spawn_warmup(kem=False, sig=False)
         for peer_id, secret in self.raw_secrets.items():
             self.shared_keys[peer_id] = derive_message_key(
@@ -1934,6 +2050,7 @@ class SecureMessaging:
                                            lane_capacity=self._lane_capacity)
             self._bfused = self._make_fused()
             self._attach_tuners()
+            self._attach_cost()
             self._spawn_warmup(kem=False, sig=True)
         self._sig_keypair = self._load_or_generate_sig_keypair()
         self._log("crypto_settings_changed", component="signature", algorithm=name)

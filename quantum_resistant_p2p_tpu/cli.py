@@ -116,6 +116,7 @@ class CLI:
         use_batching: bool = False,
         mesh_devices: int = 0,
         enable_discovery: bool = True,
+        telemetry_port: int | None = None,
         out=sys.stdout,
     ):
         self.out = out
@@ -123,6 +124,7 @@ class CLI:
         self.backend = backend
         self.use_batching = use_batching
         self.mesh_devices = mesh_devices
+        self.telemetry_port = telemetry_port
         self.enable_discovery = enable_discovery
         self.storage = KeyStorage(vault_path)
         self.node: P2PNode | None = None
@@ -171,8 +173,13 @@ class CLI:
             backend=self.backend,
             use_batching=self.use_batching,
             mesh_devices=self.mesh_devices,
+            telemetry_port=self.telemetry_port,
         )
         self.messaging.register_message_listener(self._on_message)
+        if self.messaging.telemetry_port is not None:
+            self.print(f"telemetry endpoints on "
+                       f"http://127.0.0.1:{self.messaging.telemetry_port} "
+                       "(/metrics /healthz /readyz /slo /trace /cost)")
         self.secure_logger.log_event("initialization", node_id=node_id, port=self.node.port)
         # Explicit native-core availability, the role of the reference's
         # status-bar OQS chip (ui/oqs_status_widget.py:29-31).  load() may
@@ -195,6 +202,8 @@ class CLI:
                    f"(backend={self.backend}, batching={self.use_batching}, {core})")
 
     async def stop(self) -> None:
+        if self.messaging:
+            self.messaging.stop_telemetry()
         if self.discovery:
             await self.discovery.stop()
         if self.node:
@@ -305,7 +314,11 @@ class CLI:
             self.print("adopted peer settings" if ok else "no gossiped settings for peer")
         elif cmd == "/metrics":
             if args and args[0] == "prom":
-                self.print(m.registry.to_prometheus())
+                # the SAME exposition path the HTTP GET /metrics endpoint
+                # serves (obs/http.py) — one serializer, two surfaces
+                from .obs.metrics import prometheus_text
+
+                self.print(prometheus_text(m.registry))
             else:
                 self.print(json.dumps(
                     {
@@ -511,6 +524,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--batch", action="store_true", help="enable the TPU batch queue")
     ap.add_argument("--mesh-devices", type=int, default=None,
                     help="shard TPU batches across this many chips (0 = one, -1 = all)")
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    help="serve live read-only telemetry endpoints on this "
+                         "localhost port (0 = ephemeral; default off, or "
+                         "QRP2P_HTTP_PORT)")
     ap.add_argument("--config", default=None, help="config file path")
     ap.add_argument("--no-discovery", action="store_true")
     ap.add_argument("--tui", action="store_true",
@@ -539,6 +556,7 @@ def main(argv: list[str] | None = None) -> int:
         use_batching=cfg.use_batching,
         mesh_devices=cfg.mesh_devices,
         enable_discovery=not args.no_discovery,
+        telemetry_port=args.telemetry_port,
     )
     if not cli.login_interactive():
         return 1

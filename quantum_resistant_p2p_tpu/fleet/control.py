@@ -9,10 +9,15 @@ Message types (all prefixed ``__gw_``/``__route`` so they can never
 collide with application message types):
 
 * ``__gw_hello__``     gateway -> router: registration (gateway id, the
-                       P2P listen port peers dial, pid).
+                       P2P listen port peers dial, pid, and — when live
+                       telemetry is armed — the gateway's own HTTP
+                       telemetry port, so the router's ``/fleet`` view
+                       and ``tools/qrtop.py`` can find every scrape).
 * ``__gw_heartbeat__`` gateway -> router: liveness + the cross-process
                        SLO aggregation feed (cumulative probe totals,
-                       device/fallback trip counters, admission stats).
+                       device/fallback trip counters, admission stats,
+                       the device-cost ledger totals the router sums
+                       fleet-wide, and the telemetry port again).
 * ``__gw_probe__``     router -> gateway: the HALF-OPEN canary.  A
                        gateway that missed heartbeats is a breaker-open
                        shard at fleet scope; one probe round-trip is the

@@ -64,12 +64,17 @@ DEFAULTS: dict[str, Any] = {
     "report_dir": None,
     "fd_need": 4096,
     "prewarm_cap": 64,
+    #: live telemetry endpoints (obs/http.py): None = off (the global
+    #: default), 0 = ephemeral port — announced through hello/heartbeat
+    #: so the router and tools/qrtop.py can find each gateway's scrape
+    "telemetry_port": None,
 }
 
 
 def _engine_stats(engine, received: int) -> dict[str, Any]:
     """The compact heartbeat payload: liveness + the counters the fleet
-    sums (device/fallback trips feed the fleet_device_served SLO)."""
+    sums (device/fallback trips feed the fleet_device_served SLO; the
+    cost totals feed the router's aggregated ``/fleet`` economics)."""
     q = engine._collect_queues()
     gw = {
         "msgs_received": received,
@@ -81,6 +86,9 @@ def _engine_stats(engine, received: int) -> dict[str, Any]:
         "fallback_trips": q.get("fallback_trips", 0),
         "breaker_state": q.get("breaker_state"),
         "device_served_fraction": q.get("device_served_fraction"),
+        "handshake_attempts": engine._handshake_latency.count,
+        "telemetry_port": engine.telemetry_port,
+        "cost": engine.cost.totals(),
     }
     total = fb = 0
     for fam in ("kem_queue", "sig_queue", "fused_queue"):
@@ -120,6 +128,7 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
         node = P2PNode(node_id=gid, host=str(cfg["bind_host"]), port=0,
                        max_peers=int(cfg["max_peers"]))
         await node.start()
+        telemetry_port = cfg.get("telemetry_port")
         engine = SecureMessaging(
             node, kem=get_kem(kem_name, "tpu"), symmetric=aead,
             signature=get_signature(sig_name, "tpu"),
@@ -129,6 +138,8 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
             shard_devices=int(cfg["shard_devices"]),
             max_inflight_handshakes=int(cfg["handshake_budget"]),
             bulk_lane_capacity=int(cfg["bulk_lane_capacity"]),
+            telemetry_port=(int(telemetry_port)
+                            if telemetry_port is not None else None),
         )
         received = 0
 
@@ -154,6 +165,9 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
             "type": control.GW_HELLO, "gateway": gid,
             "p2p_port": node.port, "pid": os.getpid(),
             "max_peers": int(cfg["max_peers"]),
+            # announce the scrape surface: the router's /fleet view and
+            # tools/qrtop.py find each gateway's endpoints through this
+            "telemetry_port": engine.telemetry_port,
         })
 
         stop_ev = asyncio.Event()
@@ -228,6 +242,7 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
             # peers see the drop immediately
             stop_ev.set()
             hb_task.cancel()
+            engine.stop_telemetry()
             writer.close()
             await node.stop()
 

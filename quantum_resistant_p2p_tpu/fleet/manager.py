@@ -109,6 +109,9 @@ class GatewayMember:
         self.host: str | None = None
         self.port: int | None = None  # the P2P port peers dial
         self.pid: int | None = None
+        #: the gateway's own telemetry listener (obs/http.py), announced
+        #: in its hello/heartbeats; None when it runs without one
+        self.telemetry_port: int | None = None
         self.proc: Any = None  # asyncio subprocess (spawn="process")
         self.task: asyncio.Task | None = None  # spawn="task"
         self.writer: asyncio.StreamWriter | None = None
@@ -147,6 +150,7 @@ class GatewayMember:
             "breaker_closes": b.closes,
             "killed": self.killed,
             "stopped": self.stopped,
+            "telemetry_port": self.telemetry_port,
             "stats": self.stats,
         }
 
@@ -173,6 +177,7 @@ class GatewayFleet:
         host: str = "127.0.0.1",
         clock: Callable[[], float] = time.monotonic,
         register_timeout: float = 60.0,
+        telemetry_port: int | None = None,
     ):
         if spawn not in ("process", "task"):
             raise ValueError(f"spawn must be 'process' or 'task', got {spawn!r}")
@@ -217,6 +222,12 @@ class GatewayFleet:
         self._last_healthy: frozenset[str] = frozenset(ids)
         self.registry = Registry(name="fleet")
         self.slo = self._build_slo_engine()
+        #: router-side telemetry (obs/http.py): None = off (the default).
+        #: When armed, the router serves the aggregated /fleet view and
+        #: every gateway (unless gateway_kw overrides) opens its OWN
+        #: ephemeral telemetry listener, announced via hello/heartbeat.
+        self._telemetry_port = telemetry_port
+        self.telemetry = None
 
     # -- events ---------------------------------------------------------------
 
@@ -244,6 +255,31 @@ class GatewayFleet:
         self._server = await asyncio.start_server(self._on_ctrl, self.host, 0)
         self.ctrl_port = self._server.sockets[0].getsockname()[1]
         self._running = True
+        if self._telemetry_port is not None:
+            from ..obs.http import TelemetryServer, json_route
+            from ..obs.metrics import (PROMETHEUS_CONTENT_TYPE,
+                                       prometheus_text)
+
+            def prom():
+                return 200, PROMETHEUS_CONTENT_TYPE, prometheus_text(
+                    self.registry).encode()
+
+            try:
+                self.telemetry = TelemetryServer({
+                    "/fleet": json_route(self.fleet_view),
+                    "/metrics": prom,
+                    "/metrics.json": json_route(self.registry.snapshot),
+                    "/slo": json_route(self.slo_status),
+                    "/healthz": json_route(lambda: {
+                        "ok": True, "role": "fleet-router",
+                        "gateways": len(self.members),
+                    }),
+                }, host=self.host, port=self._telemetry_port).start()
+            except OSError as e:
+                # an optional observability listener must never stop the
+                # fleet from starting (same degrade policy as the engine)
+                logger.warning("fleet telemetry disabled: cannot bind "
+                               "port %s (%s)", self._telemetry_port, e)
         if self.report_dir is not None:
             self.report_dir.mkdir(parents=True, exist_ok=True)
             # a previous run's per-node reports would leak into this run's
@@ -282,6 +318,10 @@ class GatewayFleet:
             "handshake_budget": self.handshake_budget,
             "hb_interval": self.hb_interval,
             "report_dir": str(self.report_dir) if self.report_dir else None,
+            # a telemetry-armed fleet scrapes its gateways too: each opens
+            # an ephemeral listener, announced back through hello
+            "telemetry_port": (0 if self._telemetry_port is not None
+                               else None),
         }
         cfg.update(self.gateway_kw)
         return cfg
@@ -318,6 +358,9 @@ class GatewayFleet:
         """Graceful drain: ask every live gateway to write its per-node
         SLO report and exit; SIGKILL/cancel whatever does not comply."""
         self._running = False
+        if self.telemetry is not None:
+            srv, self.telemetry = self.telemetry, None
+            srv.stop()
         if self._health_task is not None:
             self._health_task.cancel()
         for member in self._members_sorted():
@@ -448,6 +491,8 @@ class GatewayFleet:
         member.host = self.host
         member.port = int(hello.get("p2p_port", 0))
         member.pid = int(hello.get("pid") or 0) or member.pid
+        tport = hello.get("telemetry_port")
+        member.telemetry_port = int(tport) if tport is not None else None
         member.writer = writer
         member.last_hb = self._clock()
         logger.info("gateway %s registered (p2p port %s)", gid, member.port)
@@ -478,6 +523,9 @@ class GatewayFleet:
         member.last_hb = self._clock()
         member.hb_count += 1
         member.stats = msg.get("stats") or {}
+        tport = member.stats.get("telemetry_port")
+        if tport is not None:
+            member.telemetry_port = int(tport)
         # Reconcile the router's inflight BELIEF with the gateway's own
         # connection count: a client whose ``__route_done__`` frame was
         # lost (its open_connection error is swallowed client-side) would
@@ -790,6 +838,46 @@ class GatewayFleet:
 
     def slo_status(self) -> dict[str, Any]:
         return self.slo.status()
+
+    def fleet_cost_totals(self) -> dict[str, Any]:
+        """Fleet-wide device-cost economics: the numeric cost totals each
+        gateway's heartbeat carries (obs/cost.py ``CostLedger.totals``),
+        summed — plus the derived fleet padding-waste fraction."""
+        sums: dict[str, Any] = {}
+        per_gateway: dict[str, Any] = {}
+        for m in self._members_sorted():
+            cost = m.stats.get("cost")
+            if not isinstance(cost, dict):
+                continue
+            per_gateway[m.gateway_id] = cost
+            for k, v in cost.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    # int seed keeps event counts ints in the artifact
+                    # (float fields stay float through float addition)
+                    sums[k] = sums.get(k, 0) + v
+        # the ratio fields must be re-derived from the summed raw counts,
+        # not summed themselves (a sum of fractions is meaningless)
+        for ratio in ("padding_waste_fraction", "opcache_hit_rate_cumulative"):
+            sums.pop(ratio, None)
+        total = sums.get("items_real", 0) + sums.get("items_padded", 0)
+        sums["padding_waste_fraction"] = (
+            round(sums.get("items_padded", 0) / total, 6) if total else None)
+        looked = sums.get("opcache_hits", 0) + sums.get("opcache_misses", 0)
+        sums["opcache_hit_rate_cumulative"] = (
+            round(sums.get("opcache_hits", 0) / looked, 6) if looked else None)
+        return {"fleet": sums, "per_gateway": per_gateway}
+
+    def fleet_view(self) -> dict[str, Any]:
+        """The aggregated ``/fleet`` document the router's telemetry
+        endpoint serves: the summed SLO engine's burn report + the
+        heartbeat cost totals + per-member routing/liveness state (each
+        member row carries its own telemetry port, so a dashboard can
+        walk from the router to every gateway's scrape)."""
+        return {
+            "router": self.stats(),
+            "slo": self.slo_status(),
+            "cost": self.fleet_cost_totals(),
+        }
 
     # -- reporting ------------------------------------------------------------
 

@@ -77,8 +77,18 @@ async def run_fleet_storm(
     prewarm_cap: int = 256,
     fault_rules=None,
     report_dir: str | Path | None = None,
+    telemetry: bool = False,
+    scrape_cb=None,
 ) -> dict[str, Any]:
-    """One seeded fleet storm; returns the JSON-ready report."""
+    """One seeded fleet storm; returns the JSON-ready report.
+
+    ``telemetry=True`` arms the live HTTP endpoints (obs/http.py): the
+    router serves ``/fleet`` and every gateway its own ephemeral scrape
+    surface.  ``scrape_cb(endpoints)`` — e.g.
+    ``tools.qrtop.snapshot_endpoints`` — is called WHILE the gateways
+    are still alive (just before drain) with ``{gateway_id: "host:port"}``
+    and its return value lands in the report as ``cost_snapshot`` (the
+    committed ``fleet_storm_cost_snapshot.json`` artifact)."""
     register_storm_providers()
     from ..app.messaging import SecureMessaging
     from ..net.p2p_node import P2PNode
@@ -101,6 +111,7 @@ async def run_fleet_storm(
         per_gateway_max_peers=per_gateway_max_peers,
         handshake_budget=handshake_budget,
         report_dir=report_dir,
+        telemetry_port=0 if telemetry else None,
         gateway_kw={
             "max_batch": max_batch, "max_wait_ms": max_wait_ms,
             "autotune": autotune, "ke_timeout": ke_timeout,
@@ -279,6 +290,35 @@ async def run_fleet_storm(
             fleet_slo = fleet.slo_status()
             fleet_stats = fleet.stats()
             proto_metrics = proto.metrics()
+            # fleet-wide device-cost economics (obs/cost.py): the heartbeat
+            # totals the router summed, plus the driver-side client plane
+            fleet_cost = fleet.fleet_cost_totals()
+            fleet_cost["client_plane"] = proto.cost.totals()
+            telemetry_info = None
+            cost_snapshot = None
+            if telemetry:
+                telemetry_info = {
+                    "router_port": (fleet.telemetry.port
+                                    if fleet.telemetry is not None else None),
+                    "gateways": {m.gateway_id: m.telemetry_port
+                                 for m in fleet._members_sorted()},
+                }
+                if scrape_cb is not None:
+                    # scrape the LIVE per-gateway endpoints before drain —
+                    # this is the qrtop --snapshot path run in-harness, so
+                    # the committed artifact comes from the same code a
+                    # human's dashboard uses.  A killed gateway's endpoint
+                    # is gone; the scraper reports it unreachable.
+                    endpoints = {
+                        m.gateway_id: f"{fleet.host}:{m.telemetry_port}"
+                        for m in fleet._members_sorted()
+                        if m.telemetry_port
+                    }
+                    try:
+                        cost_snapshot = await asyncio.get_running_loop(
+                        ).run_in_executor(None, scrape_cb, endpoints)
+                    except Exception:
+                        logger.exception("telemetry scrape failed")
         finally:
             await fleet.stop()
             for sm in clients:
@@ -356,7 +396,12 @@ async def run_fleet_storm(
         "per_gateway": per_gateway,
         "fleet_slo": fleet_slo,
         "fleet_slo_merged": merged,
+        "fleet_cost": fleet_cost,
     }
+    if telemetry_info is not None:
+        out["telemetry"] = telemetry_info
+    if cost_snapshot is not None:
+        out["cost_snapshot"] = cost_snapshot
     if plan is not None:
         out["chaos"] = {
             "seed": plan.seed,

@@ -20,6 +20,14 @@ jax/cryptography dependency):
   over multi-window burn rates (fast 5 m / slow 1 h): error-budget
   gauges in the registry, structured ``slo_burn`` flight events, and the
   ``metrics()["slo"]`` / CLI ``/slo`` health report.
+* :mod:`.cost`    — device-cost ledger: batch-occupancy / padding-waste
+  accounting, warmup-vs-in-flush compile attribution, device seconds per
+  op family and shard, opcache hit windows, and the deterministic
+  autotuner decision journal.
+* :mod:`.http`    — live per-process telemetry endpoints (``/metrics``
+  ``/healthz`` ``/readyz`` ``/slo`` ``/trace`` ``/cost``): read-only,
+  localhost-bound, OFF by default (``QRP2P_HTTP_PORT`` /
+  ``telemetry_port=``); the scrape surface ``tools/qrtop.py`` polls.
 
 Every layer above reports through here: the batch queue and breaker
 (provider/batched.py), the protocol engine (app/messaging.py), the

@@ -487,6 +487,21 @@ def _numeric_leaves(obj: Any, prefix: str = "") -> list[tuple[str, Any]]:
     return out
 
 
+#: the Prometheus exposition content type (RFC'd by the text format spec;
+#: obs/http.py serves it on GET /metrics)
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def prometheus_text(registry: "Registry", prefix: str = "qrp2p") -> str:
+    """THE Prometheus text exposition path.  Every surface that renders a
+    registry as Prometheus text — the CLI ``/metrics prom`` command
+    (cli.py) and the HTTP ``GET /metrics`` endpoint (obs/http.py) — calls
+    through here, so there is exactly one copy of the exposition logic
+    (:meth:`Registry.to_prometheus`) and the two surfaces can never
+    drift."""
+    return registry.to_prometheus(prefix)
+
+
 #: process-wide default registry (module-level counters; the flight
 #: recorder's dump bundles snapshot EVERY live registry, this one included)
 REGISTRY = Registry(name="process")

@@ -168,7 +168,7 @@ class QueueTuner:
 
     def __init__(self, queue, cfg: TunerConfig,
                  clock: Callable[[], float] = time.monotonic,
-                 scheduler=None):
+                 scheduler=None, cost=None):
         #: guards every read/write of decision state: written on the event
         #: loop (step), read from gauge/exporter/dump threads (qrflow
         #: cross-thread-state — set_fn callbacks are executor-domain)
@@ -178,6 +178,10 @@ class QueueTuner:
         self.cfg = cfg
         self._clock = clock
         self._scheduler = scheduler
+        #: decision journal sink (obs/cost.py CostLedger): EVERY step is
+        #: journaled with its inputs — the flight ``tuner_step`` event
+        #: covers changes only; None (the default) journals nothing
+        self._cost = cost
         self._floor = max(1, _next_pow2(queue.bucket_floor))
         #: cold-start prior: None = the STATIC configuration (flush at
         #: max_batch, the constructor window) until the first informed
@@ -288,6 +292,23 @@ class QueueTuner:
             if changed:
                 self.changes += 1
             bucket, window_s = self.bucket, self.window_s
+            saturated = self.saturated
+        if self._cost is not None:
+            # the full trajectory: every decide() step with its inputs,
+            # stamped with the tuner's own (injectable) clock — a seeded
+            # storm's tuning history replays deterministically from it
+            self._cost.tuner_decision(
+                self.label, now,
+                {
+                    "avg_batch": round(avg_batch, 4),
+                    "rate_ops_s": round(rate, 2),
+                    "p50_device_ms": (round(p50_device * 1e3, 3)
+                                      if p50_device is not None else None),
+                    "p50_dispatch_ms": (round(p50_dispatch * 1e3, 3)
+                                        if p50_dispatch is not None else None),
+                },
+                bucket, window_s, saturated, degraded,
+            )
         if changed:
             # decision CHANGES are flight events (every step would be
             # noise); the dump narrates why the serving loop re-shaped
@@ -326,10 +347,11 @@ class Autotuner:
 
     def __init__(self, registry=None, cfg: TunerConfig | None = None,
                  clock: Callable[[], float] = time.monotonic,
-                 scheduler=None):
+                 scheduler=None, cost=None):
         self.cfg = cfg if cfg is not None else TunerConfig()
         self._clock = clock
         self._scheduler = scheduler
+        self._cost = cost
         self._lock = threading.Lock()
         #: queue -> tuner (weak keys: hot-swapped facades' queues die)
         self._tuners: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
@@ -346,7 +368,7 @@ class Autotuner:
             if tuner is not None:
                 return tuner
             tuner = QueueTuner(queue, self.cfg, self._clock,
-                               scheduler=self._scheduler)
+                               scheduler=self._scheduler, cost=self._cost)
             self._tuners[queue] = tuner
         queue.tuner = tuner
         if self._g_bucket is not None:
@@ -366,13 +388,13 @@ class Autotuner:
     def attach_facades(self, *facades) -> None:
         """Attach every OpQueue of the given batched facades (None entries
         are skipped — the fused facade is optional)."""
+        from .batched import facade_queues
+
         for facade in facades:
             if facade is None:
                 continue
-            for attr in ("_kg", "_enc", "_dec", "_sign", "_verify"):
-                q = getattr(facade, attr, None)
-                if q is not None:
-                    self.attach_queue(q)
+            for q in facade_queues(facade):
+                self.attach_queue(q)
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:

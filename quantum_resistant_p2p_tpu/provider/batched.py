@@ -537,6 +537,11 @@ class OpQueue:
         #: the hot path; None (the default, and QRP2P_AUTOTUNE=0) reads
         #: the static constructor values — bit-for-bit the old behavior
         self.tuner = None
+        #: device-cost ledger (obs/cost.py CostLedger): when attached,
+        #: flushes record their occupancy (real vs padded slots), cold
+        #: buckets their compile seconds, dispatches their device time.
+        #: Observation only — never steers when/what a flush dispatches
+        self.cost = None
         self._items: list[Any] = []
         self._futures: list[asyncio.Future] = []
         #: lane tag per pending item (parallel to _items), plus O(1)
@@ -739,7 +744,12 @@ class OpQueue:
                     # warmup-compile durations must not pollute it — a
                     # recovery phase would otherwise tune its windows to
                     # cpu/compile time instead of device time
-                    self.stats.device_hist.record(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    self.stats.device_hist.record(dt)
+                    if self.cost is not None:
+                        # the cost ledger's device-seconds feed shares the
+                        # same purity rule: device-program time only
+                        self.cost.device_time(self.label, dt)
 
     def _count_trip(self, breaker: Breaker | None = None) -> None:
         """One serial device round trip (device or warmup executor): the
@@ -802,6 +812,7 @@ class OpQueue:
                 flush_span.set_attr("shard", shard.index)
             try:
                 self._count_trip(shard.breaker if shard is not None else None)
+                self._cost_occupancy(items, lane, shard)
                 return await loop.run_in_executor(
                     shard.breaker.device_executor if shard is not None else None,
                     self._traced_call, self._direct_fn(shard, lane),
@@ -820,6 +831,21 @@ class OpQueue:
         finally:
             if shard is not None:
                 self.scheduler.done(shard)
+
+    def _cost_occupancy(self, items: list[Any], lane: int | None,
+                        shard) -> None:
+        """Ledger hook for one DEVICE-path flush: real items vs the padded
+        pow2 bucket the batch fn will dispatch (cpu-fallback flushes pad
+        nothing and never reach here)."""
+        if self.cost is None:
+            return
+        bucket = max(self.bucket_floor, _next_pow2(len(items)))
+        self.cost.flush_occupancy(
+            self.label,
+            LANE_NAMES.get(lane, str(lane)) if lane is not None else "?",
+            len(items), bucket,
+            shard=shard.index if shard is not None else None,
+        )
 
     def _direct_fn(self, shard, lane: int | None = None):
         """Bind the shard index and flush lane into the fault-hooked device
@@ -852,19 +878,30 @@ class OpQueue:
             breaker.release(claim)  # nothing dispatches on this claim
             if start_warm:
                 self._count_trip(breaker)
+                warm_t0 = time.perf_counter()
                 warm = loop.run_in_executor(
                     breaker.warmup_executor, self._traced_call,
                     self._warm_call, "device.dispatch", "warmup",
                     obs_trace.current(), items,
                 )
 
-                def _mark(f, b=bucket):
+                def _mark(f, b=bucket, t0=warm_t0):
                     if f.cancelled():
                         with self._warm_lock:
                             self._warming.discard(b)
                         return
                     if f.exception() is None:
                         self.mark_warm(b)
+                        if self.cost is not None:
+                            # in-flush cold compile: a live flush hit this
+                            # bucket cold and these are the wall seconds
+                            # until the device path could take over (the
+                            # 1-thread warmup pool's queueing included —
+                            # that wait IS part of the observed cost)
+                            self.cost.compile_event(
+                                self.label, b, time.perf_counter() - t0,
+                                where="in_flush",
+                            )
                     else:
                         with self._warm_lock:
                             self._warming.discard(b)
@@ -895,6 +932,7 @@ class OpQueue:
             return await self._run_fallback(items, breaker)
         t0 = time.perf_counter()
         self._count_trip(breaker)
+        self._cost_occupancy(items, lane, shard)
         # Dedicated 2-thread device pool PER BREAKER (per shard, under a
         # scheduler — placed flushes on different shards genuinely run in
         # parallel): an abandoned hung dispatch can never starve the
@@ -1029,25 +1067,53 @@ def _facade_breaker(breaker, cooloff_s, scheduler=None):
 
 
 def _shard_placements(scheduler):
-    """Placement contexts a facade warmup must compile under: one per
-    CLOSED shard (jit caches are per device — a program warmed only on
-    shard 0 would cold-compile inside shard 3's first live dispatch; a
-    sick shard is skipped so its hung device cannot stall the sweep), or
-    one null context for the classic single-device path (also the
-    no-healthy-shard fallback: compiling the default-device program keeps
-    the warmup contract's shape, and every claim routes to the cpu
-    fallback until a shard heals anyway)."""
+    """``(shard_index, placement context)`` pairs a facade warmup must
+    compile under: one per CLOSED shard (jit caches are per device — a
+    program warmed only on shard 0 would cold-compile inside shard 3's
+    first live dispatch; a sick shard is skipped so its hung device
+    cannot stall the sweep), or one ``(None, null context)`` for the
+    classic single-device path (also the no-healthy-shard fallback:
+    compiling the default-device program keeps the warmup contract's
+    shape, and every claim routes to the cpu fallback until a shard
+    heals anyway).  The index rides into the cost ledger's compile
+    attribution (obs/cost.py)."""
     import contextlib
 
     if scheduler is None:
-        yield contextlib.nullcontext()
+        yield None, contextlib.nullcontext()
         return
     warm = scheduler.warmable_shards()
     if not warm:
-        yield contextlib.nullcontext()
+        yield None, contextlib.nullcontext()
         return
     for sh in warm:
-        yield sh.placement()
+        yield sh.index, sh.placement()
+
+
+def facade_queues(facade):
+    """The live OpQueues of one batched facade — BatchedKEM owns
+    ``_kg``/``_enc``/``_dec``, BatchedSignature ``_sign``/``_verify``,
+    BatchedFused the first three.  THE single source the engine-side
+    attach loops iterate (the autotuner's ``attach_facades`` and the cost
+    ledger's ``_attach_cost``): a queue added to a facade joins every
+    observer by appearing here, instead of in N copied attribute lists."""
+    for attr in ("_kg", "_enc", "_dec", "_sign", "_verify"):
+        q = getattr(facade, attr, None)
+        if q is not None:
+            yield q
+
+
+def _timed_warm(facade, n: int, shard_idx: int | None) -> None:
+    """Run one facade ``_warm_one`` under the clock and attribute its
+    compile wall seconds to the cost ledger (obs/cost.py): one
+    ``where="warmup"`` event per (shard, bucket) the background sweep
+    compiled — the other half of the warmup-vs-in-flush attribution."""
+    t0 = time.perf_counter()
+    facade._warm_one(n)
+    if facade.cost is not None:
+        facade.cost.compile_event(
+            facade.name, max(facade.bucket_floor, _next_pow2(n)),
+            time.perf_counter() - t0, where="warmup", shard=shard_idx)
 
 
 class BatchedKEM:
@@ -1073,6 +1139,8 @@ class BatchedKEM:
         self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
         #: placement axis shared with the sibling facades (None = classic)
         self.scheduler = scheduler
+        #: cost ledger (obs/cost.py): warmup compile attribution
+        self.cost = None
         # one breaker across keygen/encaps/decaps: the device is shared, so
         # any op discovering slowness shields the others immediately (per
         # SHARD under a scheduler — each shard carries its own)
@@ -1137,10 +1205,10 @@ class BatchedKEM:
         are per device; the opcache partitions per shard) before the
         bucket is marked warm — a warm bucket means warm wherever the
         placement policy can put a flush."""
-        for placement in _shard_placements(self.scheduler):
+        for shard_idx, placement in _shard_placements(self.scheduler):
             with placement:
                 for n in sizes:
-                    self._warm_one(n)
+                    _timed_warm(self, n, shard_idx)
         for n in sizes:
             n2 = max(self.bucket_floor, _next_pow2(n))
             for q in (self._kg, self._enc, self._dec):
@@ -1200,6 +1268,8 @@ class BatchedSignature:
         self.name = algo.name
         self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
         self.scheduler = scheduler
+        #: cost ledger (obs/cost.py): warmup compile attribution
+        self.cost = None
         self.breaker = _facade_breaker(breaker, cooloff_s, scheduler)
         self._sign, self._verify = _make_queues(
             algo, fallback, None if scheduler is not None else self.breaker,
@@ -1261,10 +1331,10 @@ class BatchedSignature:
 
         Under a scheduler every size compiles on EVERY shard before the
         bucket is marked warm (see BatchedKEM.warmup)."""
-        for placement in _shard_placements(self.scheduler):
+        for shard_idx, placement in _shard_placements(self.scheduler):
             with placement:
                 for n in sizes:
-                    self._warm_one(n)
+                    _timed_warm(self, n, shard_idx)
         for n in sizes:
             n2 = max(self.bucket_floor, _next_pow2(n))
             for q in (self._sign, self._verify):
@@ -1345,6 +1415,8 @@ class BatchedFused:
         self.ct_off = ct_off
         self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
         self.scheduler = scheduler
+        #: cost ledger (obs/cost.py): warmup compile attribution
+        self.cost = None
         self.breaker = _facade_breaker(breaker, cooloff_s, scheduler)
         self.fallback_kem = fallback_kem
         self.fallback_sig = fallback_sig
@@ -1556,10 +1628,19 @@ class BatchedFused:
         buckets warm that were never compiled.  Under a scheduler the
         composite programs compile on every shard before marking."""
         buckets = sorted({max(self.bucket_floor, _next_pow2(n)) for n in sizes})
-        for placement in _shard_placements(self.scheduler):
+        for shard_idx, placement in _shard_placements(self.scheduler):
             with placement:
-                self.fused.warmup(tuple(buckets), pk_off=self.pk_off,
-                                  ct_off=self.ct_off)
+                for b in buckets:
+                    # per-bucket calls so each compile's wall seconds can
+                    # be attributed individually (the sweep compiles the
+                    # same shapes either way)
+                    t0 = time.perf_counter()
+                    self.fused.warmup((b,), pk_off=self.pk_off,
+                                      ct_off=self.ct_off)
+                    if self.cost is not None:
+                        self.cost.compile_event(
+                            self.name, b, time.perf_counter() - t0,
+                            where="warmup", shard=shard_idx)
         for q in (self._kg, self._enc, self._dec):
             for b in buckets:
                 q.mark_warm(b)  # runs on the warmup thread: locked handoff

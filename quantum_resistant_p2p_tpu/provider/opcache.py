@@ -75,6 +75,17 @@ class DeviceOperandCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        #: cost-ledger feed (obs/cost.py): sliding-window hit rates next
+        #: to the cumulative counters above — attached by the engine,
+        #: None (the default) records nothing extra
+        self._cost = None
+        self._cost_kind = ""
+
+    def attach_cost(self, ledger, kind: str) -> None:
+        """Feed hit/miss events into a :class:`obs.cost.CostLedger` under
+        cache label ``kind`` ("kem" / "sig")."""
+        self._cost = ledger
+        self._cost_kind = kind
 
     @staticmethod
     def _key(kind: str, key_bytes: bytes) -> tuple[str, int, bytes]:
@@ -94,9 +105,14 @@ class DeviceOperandCache:
             if k in self._entries:
                 self._entries.move_to_end(k)
                 self.hits += 1
-                return self._entries[k]
-            self.misses += 1
-            return None
+                hit, out = True, self._entries[k]
+            else:
+                self.misses += 1
+                hit, out = False, None
+        if self._cost is not None:
+            # outside the lock: the ledger takes its own (obs/cost.py)
+            self._cost.opcache_event(self._cost_kind, hit)
+        return out
 
     def put(self, kind: str, key_bytes: bytes, val: Any) -> None:
         k = self._key(kind, bytes(key_bytes))

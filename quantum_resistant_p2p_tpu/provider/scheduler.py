@@ -141,6 +141,9 @@ class Shard:
         # given a registry; None otherwise — recording stays optional)
         self._ctr_dispatches = None
         self._hist_latency = None
+        #: cost-ledger feed (obs/cost.py): per-shard placed-program
+        #: seconds, attached via DeviceProgramScheduler.attach_cost
+        self._cost = None
 
     @contextlib.contextmanager
     def placement(self):
@@ -173,6 +176,10 @@ class Shard:
             self._ctr_dispatches.inc()
         if self._hist_latency is not None:
             self._hist_latency.record(dt)
+        if self._cost is not None:
+            # per-shard device-seconds (obs/cost.py): the chip-level half
+            # of the cost ledger's device-time accounting
+            self._cost.shard_device_time(self.index, dt)
         return out
 
     def snapshot(self) -> dict[str, Any]:
@@ -252,6 +259,12 @@ class DeviceProgramScheduler(CoalescingHub):
             s._hist_latency = hist.labels(shard=s.index)
             child = gauge.labels(shard=s.index)
             child.set_fn(lambda s=s: s.inflight)
+
+    def attach_cost(self, ledger) -> None:
+        """Feed per-shard placed-program seconds into a
+        :class:`obs.cost.CostLedger` (the engine attaches its ledger)."""
+        for s in self.shards:
+            s._cost = ledger
 
     @property
     def n_shards(self) -> int:

@@ -845,11 +845,16 @@ def test_traced_warm_handshake_yields_exactly_four_dispatch_spans(
         await b.wait_ready()
         obs_trace.TRACER.reset()
         assert await a.initiate_key_exchange("bob")
-        # the responder's confirm-verify dispatch completes asynchronously
+        # the responder's confirm-verify dispatch completes asynchronously —
+        # and each device span's queue.flush PARENT closes on the loop side
+        # a beat after the worker-side dispatch span does, so wait for the
+        # parents too (snapshotting the gap made this flake on loaded hosts)
         spans = []
         for _ in range(200):
             spans = obs_trace.TRACER.snapshot()
-            if sum(s["name"] == "device.dispatch" for s in spans) >= 4:
+            dev = [s for s in spans if s["name"] == "device.dispatch"]
+            seen = {s["span_id"] for s in spans}
+            if len(dev) >= 4 and all(d["parent_id"] in seen for d in dev):
                 break
             await asyncio.sleep(0.05)
         device = [s for s in spans if s["name"] == "device.dispatch"]

@@ -1,0 +1,527 @@
+"""obs/http.py + obs/cost.py acceptance suite (ISSUE 12).
+
+Live telemetry endpoints: golden responses per path, hostile input
+(unknown path, oversized request line, write verbs, concurrent scrapes),
+readiness flipping on breaker state, scrape-advances-SLO-engine parity
+with the PR 10 ``slo_health`` collector, and the disabled-by-default
+contract (no listener, bit-identical engine surface).
+
+Device-cost ledger: padding-waste math at pow2 bucket boundaries, compile
+attribution (warmup-thread vs in-flush cold compile), opcache hit-rate
+windows, the per-1k-handshakes derived gauge, and autotuner-journal
+determinism under injected clocks.
+
+Stdlib-only; runs on minimal images.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging
+from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode
+from quantum_resistant_p2p_tpu.obs.cost import (JOURNAL_CAP, CostLedger,
+                                                OPCACHE_WINDOW)
+from quantum_resistant_p2p_tpu.obs.http import (MAX_RESPONSE_BYTES,
+                                                TelemetryServer, env_port,
+                                                json_route)
+from quantum_resistant_p2p_tpu.obs.metrics import (PROMETHEUS_CONTENT_TYPE,
+                                                   Registry, prometheus_text)
+from quantum_resistant_p2p_tpu.provider.autotune import QueueTuner, TunerConfig
+from quantum_resistant_p2p_tpu.provider.batched import (LANE_HANDSHAKE,
+                                                        OpQueue)
+from quantum_resistant_p2p_tpu.provider.opcache import DeviceOperandCache
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+def _mk_engine(monkeypatch, **kw):
+    monkeypatch.setattr(SecureMessaging, "_spawn_warmup",
+                        lambda self, **k: None)
+    node = P2PNode(node_id="httppeer", host="127.0.0.1", port=0)
+    return SecureMessaging(node, backend="tpu", use_batching=True,
+                           sig_keypair=(b"p", b"s"),
+                           symmetric=type("A", (), {"name": "X"})(), **kw)
+
+
+@pytest.fixture
+def engine(monkeypatch):
+    m = _mk_engine(monkeypatch, telemetry_port=0)
+    yield m
+    m.stop_telemetry()
+
+
+def _get(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return r.status, r.headers.get("Content-Type"), r.read()
+
+
+# -- endpoint goldens ----------------------------------------------------------
+
+
+def test_endpoint_goldens(engine):
+    port = engine.telemetry_port
+    assert port and port > 0
+
+    status, ctype, body = _get(port, "/healthz")
+    doc = json.loads(body)
+    assert status == 200 and ctype == "application/json"
+    assert doc["ok"] is True and doc["node"] == "httppeer"
+    assert doc["uptime_s"] >= 0
+
+    status, ctype, body = _get(port, "/metrics")
+    text = body.decode()
+    assert status == 200 and ctype == PROMETHEUS_CONTENT_TYPE
+    assert text.startswith("# HELP")
+    assert "qrp2p_padding_waste_fraction" in text
+    assert "qrp2p_cost_compile_events_total" in text
+    assert "qrp2p_device_seconds_per_1k_handshakes" in text
+    assert "qrp2p_handshake_trips" in text
+
+    status, _, body = _get(port, "/metrics.json")
+    snap = json.loads(body)
+    assert snap["registry"].startswith("messaging:")
+    assert "queues" in snap["collected"]
+
+    status, _, body = _get(port, "/slo")
+    slo = json.loads(body)
+    assert {s["name"] for s in slo["specs"]} >= {"handshake_p99"}
+    assert slo["alerting"] == []
+
+    status, _, body = _get(port, "/cost")
+    cost = json.loads(body)
+    assert {"padding_waste_fraction", "occupancy", "compiles",
+            "device_seconds_by_op", "opcaches",
+            "tuner_journal_tail"} <= set(cost)
+
+    status, _, body = _get(port, "/trace")
+    trace = json.loads(body)
+    assert "traceEvents" in trace
+
+
+def test_http_metrics_shares_the_cli_serializer_path(engine):
+    """Satellite: ONE Prometheus exposition path.  The HTTP body and the
+    CLI's prometheus_text() must agree on the full metric schema (HELP/
+    TYPE lines) — they are the same function, so only sample values that
+    move between the two renders may differ."""
+    _, _, body = _get(engine.telemetry_port, "/metrics")
+    schema_http = {l for l in body.decode().splitlines()
+                   if l.startswith("# ")}
+    schema_cli = {l for l in prometheus_text(engine.registry).splitlines()
+                  if l.startswith("# ")}
+    assert schema_http == schema_cli
+
+
+# -- hostile input -------------------------------------------------------------
+
+
+def test_unknown_path_is_404(engine):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(engine.telemetry_port, "/secrets")
+    assert e.value.code == 404
+    assert json.loads(e.value.read())["error"] == "unknown path"
+
+
+def test_write_verbs_rejected(engine):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{engine.telemetry_port}/metrics",
+        data=b"x=1", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 405
+
+
+def test_oversized_request_line_bounded(engine):
+    """A request line past the stdlib's 64 KiB cap answers 414 — parsing
+    is bounded, the listener survives, and the next scrape works."""
+    with socket.create_connection(("127.0.0.1", engine.telemetry_port),
+                                  timeout=5) as s:
+        s.sendall(b"GET /" + b"a" * 70_000 + b" HTTP/1.0\r\n\r\n")
+        status_line = s.recv(4096).split(b"\r\n", 1)[0]
+    assert b"414" in status_line
+    status, _, _ = _get(engine.telemetry_port, "/healthz")
+    assert status == 200
+
+
+def test_concurrent_scrapes(engine):
+    port = engine.telemetry_port
+
+    def scrape(_):
+        status, _, body = _get(port, "/metrics")
+        return status, b"qrp2p_padding_waste_fraction" in body
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        results = list(pool.map(scrape, range(16)))
+    assert all(status == 200 and found for status, found in results)
+
+
+def test_oversized_response_bounded():
+    srv = TelemetryServer({
+        "/big": lambda: (200, "application/json",
+                         b"x" * (MAX_RESPONSE_BYTES + 1)),
+        "/boom": json_route(lambda: 1 / 0),
+    }).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.port, "/big")
+        assert e.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.port, "/boom")
+        assert e.value.code == 500
+        assert json.loads(e.value.read())["error"] == "handler failed"
+    finally:
+        srv.stop()
+
+
+# -- readiness -----------------------------------------------------------------
+
+
+def test_readiness_flips_on_breaker_open(engine):
+    port = engine.telemetry_port
+    status, _, body = _get(port, "/readyz")
+    assert status == 200 and json.loads(body)["ready"] is True
+
+    engine._queue_breaker.trip()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(port, "/readyz")
+    assert e.value.code == 503
+    doc = json.loads(e.value.read())
+    assert doc["ready"] is False and doc["degraded"] == ["shard0"]
+    # liveness is unaffected: the process is alive, just not ready
+    status, _, _ = _get(port, "/healthz")
+    assert status == 200
+
+
+def test_readiness_waits_for_warmup(monkeypatch):
+    """A gateway mid-warmup answers 503: its first handshakes would be
+    served from the cpu fallback at cpu latency."""
+    release = threading.Event()
+
+    def slow_warm(self, **kw):
+        t = threading.Thread(target=release.wait, daemon=True)
+        t.start()
+        self._warmup_thread = t
+
+    monkeypatch.setattr(SecureMessaging, "_spawn_warmup", slow_warm)
+    node = P2PNode(node_id="warmpeer", host="127.0.0.1", port=0)
+    m = SecureMessaging(node, backend="tpu", use_batching=True,
+                        sig_keypair=(b"p", b"s"),
+                        symmetric=type("A", (), {"name": "X"})(),
+                        telemetry_port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(m.telemetry_port, "/readyz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["warm"] is False
+        release.set()
+        m._warmup_thread.join(timeout=5)
+        status, _, body = _get(m.telemetry_port, "/readyz")
+        assert status == 200 and json.loads(body)["ready"] is True
+    finally:
+        release.set()
+        m.stop_telemetry()
+
+
+# -- scrape-advances-the-SLO-engine parity ------------------------------------
+
+
+def test_http_scrape_advances_slo_engine(engine):
+    """Parity with the PR 10 ``slo_health`` collector contract
+    (tests/test_slo.py::test_prometheus_scrape_advances_slo_engine): a
+    gateway watched ONLY through HTTP scrapes still evaluates its SLOs —
+    the endpoint renders through the registry, whose collector ticks the
+    engine."""
+    before = engine.slo._states["handshake_p99"].samples
+    n0 = len(before)
+    _, _, body = _get(engine.telemetry_port, "/metrics")
+    text = body.decode()
+    assert "qrp2p_slo_health_alerts_total" in text
+    assert 'slo="handshake_p99"' in text  # evaluation set the gauges
+    assert len(engine.slo._states["handshake_p99"].samples) > n0
+    snap = json.loads(_get(engine.telemetry_port, "/metrics.json")[2])
+    assert snap["collected"]["slo_health"]["alerting_count"] == 0
+
+
+# -- disabled by default -------------------------------------------------------
+
+
+def test_disabled_by_default_no_listener(monkeypatch):
+    monkeypatch.delenv("QRP2P_HTTP_PORT", raising=False)
+    m = _mk_engine(monkeypatch)
+    assert m.telemetry is None and m.telemetry_port is None
+    assert not [t for t in threading.enumerate()
+                if t.name == "qrp2p-telemetry"]
+    # the engine surface is identical with telemetry off: same metrics
+    # document shape, same SLO specs, same cost ledger
+    m2 = _mk_engine(monkeypatch, telemetry_port=0)
+    try:
+        assert set(m.metrics()) == set(m2.metrics())
+        assert m.slo.names() == m2.slo.names()
+        assert set(m.cost.snapshot()) == set(m2.cost.snapshot())
+    finally:
+        m2.stop_telemetry()
+    m.stop_telemetry()  # idempotent no-op when disabled
+
+
+def test_unbindable_port_degrades_instead_of_crashing(monkeypatch, caplog):
+    """A fixed telemetry port that cannot bind (in use / privileged) must
+    degrade to disabled with a WARNING — an optional observability
+    listener never kills the serving engine."""
+    taken = socket.socket()
+    taken.bind(("127.0.0.1", 0))
+    taken.listen(1)
+    port = taken.getsockname()[1]
+    try:
+        with caplog.at_level("WARNING"):
+            m = _mk_engine(monkeypatch, telemetry_port=port)
+        assert m.telemetry is None and m.telemetry_port is None
+        assert any("telemetry endpoints disabled" in r.message
+                   for r in caplog.records)
+    finally:
+        taken.close()
+
+
+def test_env_port_parsing(monkeypatch):
+    monkeypatch.delenv("QRP2P_HTTP_PORT", raising=False)
+    assert env_port() is None
+    monkeypatch.setenv("QRP2P_HTTP_PORT", "")
+    assert env_port() is None
+    monkeypatch.setenv("QRP2P_HTTP_PORT", "0")
+    assert env_port() == 0
+    monkeypatch.setenv("QRP2P_HTTP_PORT", "9100")
+    assert env_port() == 9100
+    monkeypatch.setenv("QRP2P_HTTP_PORT", "nope")
+    assert env_port() is None  # malformed -> disabled, never a crash
+
+
+def test_stop_telemetry_closes_the_listener(monkeypatch):
+    m = _mk_engine(monkeypatch, telemetry_port=0)
+    port = m.telemetry_port
+    m.stop_telemetry()
+    assert m.telemetry is None
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+
+
+# -- cost ledger: padding-waste math -------------------------------------------
+
+
+def test_padding_waste_math_at_bucket_boundaries():
+    reg = Registry(name="costtest")
+    ledger = CostLedger(registry=reg)
+    assert ledger.padding_waste_fraction() is None  # no flushes yet
+    # exactly full bucket: zero waste
+    ledger.flush_occupancy("K.encaps", "handshake", 8, 8)
+    assert ledger.padding_waste_fraction() == 0.0
+    # one past the boundary: 5 real rows pad to 8 -> 3 wasted of 16 total
+    ledger.flush_occupancy("K.encaps", "handshake", 5, 8)
+    assert ledger.padding_waste_fraction() == pytest.approx(3 / 16)
+    # per-queue split and labels in the scrape
+    ledger.flush_occupancy("S.sign", "bulk", 1, 4)
+    assert ledger.padding_waste_fraction("K.encaps") == pytest.approx(3 / 16)
+    assert ledger.padding_waste_fraction("S.sign") == pytest.approx(3 / 4)
+    prom = reg.to_prometheus()
+    assert ('qrp2p_cost_flush_items_padded_total{registry="costtest",'
+            'lane="bulk",queue="S.sign"} 3') in prom
+    snap = ledger.snapshot()
+    assert snap["occupancy"]["S.sign[bulk]"]["waste_fraction"] == 0.75
+
+
+def test_queue_records_occupancy_on_device_flush(run):
+    """Integration: a warm OpQueue flush records real-vs-pow2-bucket
+    occupancy, and a fallback flush records none (the cpu pads nothing)."""
+    ledger = CostLedger()
+    q = OpQueue(lambda items: [x * 2 for x in items], max_batch=64,
+                max_wait_ms=1.0, bucket_floor=4, label="T.op")
+    q.cost = ledger
+
+    async def drive():
+        return await asyncio.gather(*(q.submit(i) for i in range(5)))
+
+    assert run(drive()) == [0, 2, 4, 6, 8]
+    occ = ledger.snapshot()["occupancy"]["T.op[handshake]"]
+    # 5 items pad to the pow2 bucket 8 (floor 4): 3 padded slots
+    assert occ["items_real"] == 5 and occ["items_padded"] == 3
+    assert occ["flushes"] == 1
+
+
+def test_fallback_flush_records_no_occupancy(run):
+    ledger = CostLedger()
+    q = OpQueue(lambda items: items, max_batch=64, max_wait_ms=1.0,
+                fallback_fn=lambda items: items, bucket_floor=4,
+                label="T.cold")
+    q.cost = ledger
+    q.breaker.quarantine("test")  # pin the fallback: no device flush
+    run(q.submit(7))
+    assert ledger.padding_waste_fraction() is None
+    assert "T.cold[handshake]" not in ledger.snapshot()["occupancy"]
+
+
+# -- cost ledger: compile attribution ------------------------------------------
+
+
+def test_compile_attribution_warmup_vs_in_flush(run):
+    """The two compile paths label themselves: a facade warm sweep is
+    ``warmup``; a live flush hitting a cold bucket is ``in_flush``."""
+    ledger = CostLedger()
+    ledger.compile_event("K", 4, 1.5, where="warmup", shard=1)
+
+    q = OpQueue(lambda items: items, max_batch=64, max_wait_ms=1.0,
+                fallback_fn=lambda items: items, label="T.cold2")
+    q.cost = ledger
+
+    async def drive():
+        # cold bucket: ops served from the fallback, background compile
+        out = await q.submit(9)
+        for _ in range(100):
+            if ledger.compile_totals()[0] >= 2:
+                break
+            await asyncio.sleep(0.02)
+        return out
+
+    assert run(drive()) == 9
+    snap = ledger.snapshot()
+    assert snap["compiles"]["K[shard=1,warmup]"]["events"] == 1
+    assert snap["compiles"]["K[shard=1,warmup]"]["seconds"] == 1.5
+    assert snap["compiles"]["T.cold2[shard=all,in_flush]"]["events"] == 1
+    wheres = {e["where"] for e in snap["recent_compiles"]}
+    assert wheres == {"warmup", "in_flush"}
+
+
+# -- cost ledger: opcache windows + derived gauges -----------------------------
+
+
+def test_opcache_hit_rate_sliding_window():
+    reg = Registry(name="opctest")
+    ledger = CostLedger(registry=reg)
+    cache = DeviceOperandCache(capacity=4)
+    cache.attach_cost(ledger, "kem")
+    assert cache.lookup("ek", b"k1") is None  # miss
+    cache.put("ek", b"k1", "state")
+    assert cache.lookup("ek", b"k1") == "state"  # hit
+    assert cache.lookup("ek", b"k1") == "state"  # hit
+    assert ledger.opcache_hit_rate("kem") == pytest.approx(2 / 3)
+    snap = ledger.snapshot()["opcaches"]["kem"]
+    assert snap["hits"] == 2 and snap["misses"] == 1
+    assert 'qrp2p_opcache_hit_rate{registry="opctest",cache="kem"}' in \
+        reg.to_prometheus()
+    # the window slides: an old miss ages out of the rate
+    for _ in range(OPCACHE_WINDOW):
+        cache.lookup("ek", b"k1")
+    assert ledger.opcache_hit_rate("kem") == 1.0
+
+
+def test_device_seconds_per_1k_handshakes():
+    ledger = CostLedger()
+    ledger.device_time("K.encaps", 0.25)
+    ledger.device_time("S.sign", 0.75)
+    assert ledger.device_seconds_per_1k_handshakes() is None  # no feed
+    hs = {"n": 0}
+    ledger.set_handshakes_fn(lambda: hs["n"])
+    assert ledger.device_seconds_per_1k_handshakes() is None  # 0 handshakes
+    hs["n"] = 500
+    assert ledger.device_seconds_per_1k_handshakes() == pytest.approx(2.0)
+    assert ledger.snapshot()["device_seconds_by_op"] == {
+        "encaps": 0.25, "sign": 0.75}
+
+
+def test_totals_feed_for_fleet_heartbeats():
+    ledger = CostLedger()
+    ledger.flush_occupancy("K.encaps", "handshake", 6, 8)
+    ledger.compile_event("K", 8, 2.0, where="in_flush")
+    ledger.device_time("K.encaps", 0.5)
+    t = ledger.totals()
+    assert t["items_real"] == 6 and t["items_padded"] == 2
+    assert t["padding_waste_fraction"] == 0.25
+    assert t["compile_events"] == 1 and t["compile_seconds"] == 2.0
+    assert t["device_seconds"] == 0.5
+
+
+# -- cost ledger: autotuner journal --------------------------------------------
+
+
+class _FakeHist:
+    def __init__(self, p50):
+        self._p50 = p50
+
+    def percentile(self, p):
+        return self._p50
+
+
+class _FakeStats:
+    def __init__(self):
+        self.ops = 0
+        self.flushes = 0
+        self.fallback_flushes = 0
+        self.device_hist = _FakeHist(0.002)
+        self.dispatch_hist = _FakeHist(0.002)
+
+
+class _FakeBreaker:
+    state = "closed"
+
+
+class _FakeQueue:
+    def __init__(self):
+        self.label = "J.q"
+        self.bucket_floor = 2
+        self.stats = _FakeStats()
+        self.breaker = _FakeBreaker()
+        self.tuner = None
+
+
+def _drive_tuner(ledger) -> list:
+    clock = {"t": 0.0}
+    q = _FakeQueue()
+    tuner = QueueTuner(q, TunerConfig(), clock=lambda: clock["t"],
+                       cost=ledger)
+    # a deterministic offered-load trace: same deltas -> same decisions
+    for step, (ops, flushes) in enumerate(
+            [(64, 8), (256, 12), (1024, 14), (1100, 18)]):
+        clock["t"] += 1.0
+        q.stats.ops, q.stats.flushes = ops, flushes
+        tuner.step()
+    return ledger.journal()
+
+
+def test_autotuner_journal_reconstructs_trajectory_deterministically():
+    """Two tuners driven by the same injected clock over the same counter
+    trace journal byte-identical trajectories — the property that makes a
+    seeded storm's tuning history reconstructible from the ledger."""
+    j1 = _drive_tuner(CostLedger())
+    j2 = _drive_tuner(CostLedger())
+    assert j1 == j2
+    assert len(j1) == 4
+    assert [e["seq"] for e in j1] == [1, 2, 3, 4]
+    assert all(e["queue"] == "J.q" for e in j1)
+    # every step carries its inputs and the chosen knobs
+    assert {"avg_batch", "p50_device_ms", "p50_dispatch_ms",
+            "rate_ops_s"} <= set(j1[0]["inputs"])
+    assert j1[0]["bucket"] >= 2 and j1[0]["window_ms"] > 0
+    # the demand-following bucket moved with the trace
+    assert j1[2]["bucket"] > j1[0]["bucket"]
+
+
+def test_journal_ring_is_bounded():
+    ledger = CostLedger()
+    for i in range(JOURNAL_CAP + 10):
+        ledger.tuner_decision("q", float(i), {}, 4, 0.001, False, False)
+    j = ledger.journal()
+    assert len(j) == JOURNAL_CAP
+    assert j[-1]["seq"] == JOURNAL_CAP + 10  # seq keeps counting
+    assert ledger.snapshot()["tuner_journal_len"] == JOURNAL_CAP + 10

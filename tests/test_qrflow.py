@@ -458,6 +458,46 @@ def test_sink_wire_propagation_trigger_clean_suppressed():
     assert [s.rule for s in suppressed] == ["flow-secret-in-trace"]
 
 
+def test_sink_http_respond_trigger_clean_suppressed():
+    """flow-secret-to-network over the HTTP telemetry surface
+    (obs/http.py): ``_respond`` is the single response-write chokepoint —
+    whatever reaches it is served to whoever scrapes the endpoint, so
+    bodies may be built only from registry snapshots / SLO reports /
+    span dumps, never key material."""
+    assert rule_ids(
+        """
+        def do_get(handler, kem, a, b):
+            ss = kem.decapsulate(a, b)
+            handler._respond(200, "application/json", ss)
+        """
+    ) == ["flow-secret-to-network"]
+    # the shipped shape: a registry snapshot is public by construction
+    assert rule_ids(
+        """
+        def do_get(handler, registry, json):
+            body = json.dumps(registry.snapshot()).encode()
+            handler._respond(200, "application/json", body)
+        """
+    ) == []
+    # metadata about a secret stays clean (len() sanitizes)
+    assert rule_ids(
+        """
+        def do_get(handler, secret_key, json):
+            body = json.dumps({"n": len(secret_key)}).encode()
+            handler._respond(200, "application/json", body)
+        """
+    ) == []
+    findings, suppressed = lint(
+        """
+        def do_get(handler, kem, a, b):
+            ss = kem.decapsulate(a, b)
+            handler._respond(200, "application/json", ss)  # qrlint: disable=flow-secret-to-network — fixture: pinned KAT digest served to a loopback test scraper
+        """
+    )
+    assert not findings
+    assert [s.rule for s in suppressed] == ["flow-secret-to-network"]
+
+
 def test_sink_branch_trigger_and_clean():
     ids = rule_ids(
         """

@@ -13,6 +13,8 @@ flow-secret-in-log      tainted value reaches a logging / audit-log call
 flow-secret-in-exception tainted value embedded in an exception message
 flow-secret-format      repr()/str()/f-string renders a tainted value
 flow-secret-to-network  tainted value reaches a network send before AEAD
+                        (peer frames, or the HTTP telemetry ``_respond``
+                        response surface)
 flow-secret-in-trace    tainted value reaches an observability sink (span
                         attribute, metric label, flight-recorder payload)
 flow-secret-compare     ==/!= on key material (use hmac.compare_digest)
@@ -175,7 +177,11 @@ class SecretFormatFlowRule(_FlowRule):
 
 class SecretToNetworkFlowRule(_FlowRule):
     id = "flow-secret-to-network"
-    description = "key material reaches a network send before AEAD encryption"
+    description = ("key material reaches a network send before AEAD "
+                   "encryption — peer frames (send_message/sendall/sendto) "
+                   "or the HTTP telemetry response surface (obs/http.py "
+                   "_respond: scraped bodies must be built only from "
+                   "registry snapshots / SLO reports / span dumps)")
 
 
 class SecretInTraceFlowRule(_FlowRule):

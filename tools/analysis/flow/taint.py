@@ -196,7 +196,13 @@ SANITIZERS = {
 #: call names that wipe their argument / receiver in place
 WIPERS = {"wipe", "_wipe", "zeroize", "_zeroize", "_wipe_secret", "wipe_secret"}
 
-NETWORK_SINKS = {"send_message", "sendall", "sendto"}
+#: values that leave the process on a socket.  ``_respond`` is the HTTP
+#: telemetry surface's single response-write chokepoint (obs/http.py):
+#: whatever reaches it is served to whoever scrapes the endpoint, so the
+#: same pre-AEAD rule applies — response bodies may be built only from
+#: registry snapshots / SLO reports / span dumps (public by
+#: construction), never key material.
+NETWORK_SINKS = {"send_message", "sendall", "sendto", "_respond"}
 
 #: observability sinks (obs/): span attributes, metric labels, and
 #: flight-recorder payloads are exported in cleartext diagnostics (trace

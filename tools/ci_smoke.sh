@@ -40,6 +40,70 @@ echo "storm smoke ok (48 sessions, 0 failures)"
 python bench.py --storm --fleet 3 --sessions 60 >/dev/null
 echo "fleet chaos smoke ok (3 gateways, 60 sessions, seeded gw1 kill survived)"
 
+# Telemetry scrape smoke (docs/observability.md "Live endpoints"): an
+# engine with telemetry_port=0 (ephemeral) must serve /healthz and a
+# Prometheus /metrics exposing the cost ledger's padding-waste gauge and
+# a compile counter; the fleet variant asserts every gateway heartbeat
+# carries its own telemetry port so qrtop can find the scrapes.
+python - <<'EOF'
+import asyncio, urllib.request
+
+from quantum_resistant_p2p_tpu.fleet.stormlib import (StormAEAD,
+                                                      register_storm_providers)
+
+register_storm_providers()
+from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging
+from quantum_resistant_p2p_tpu.net.p2p_node import P2PNode
+from quantum_resistant_p2p_tpu.provider import get_kem, get_signature
+
+
+async def main():
+    node = P2PNode(node_id="scrape-smoke", host="127.0.0.1", port=0)
+    await node.start()
+    eng = SecureMessaging(node, kem=get_kem("STORM-KEM", "tpu"),
+                          symmetric=StormAEAD(),
+                          signature=get_signature("STORM-SIG", "tpu"),
+                          use_batching=True, telemetry_port=0)
+    await eng.wait_ready()
+    await eng._kem_keygen()  # one real flush so the ledger has occupancy
+    port = eng.telemetry_port
+    assert port, "telemetry_port=0 must bind an ephemeral port"
+
+    def get(path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=5) as r:
+            return r.status, r.read().decode()
+
+    status, _ = get("/healthz")
+    assert status == 200, status
+    status, prom = get("/metrics")
+    assert status == 200, status
+    assert "qrp2p_padding_waste_fraction" in prom, "cost gauge missing"
+    assert "qrp2p_cost_compile_events_total" in prom, "compile counter missing"
+    eng.stop_telemetry()
+    await node.stop()
+
+    # fleet variant: every registered gateway announces its telemetry port
+    from quantum_resistant_p2p_tpu.fleet.manager import GatewayFleet
+    fleet = GatewayFleet(2, spawn="task", hb_interval=0.1, telemetry_port=0)
+    try:
+        await fleet.start()
+        await asyncio.sleep(0.5)  # a heartbeat round
+        for m in fleet.members.values():
+            assert m.telemetry_port, f"{m.gateway_id}: no telemetry port"
+            assert m.stats.get("telemetry_port") == m.telemetry_port, \
+                f"{m.gateway_id}: heartbeat does not carry the port"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fleet.telemetry.port}/fleet",
+                timeout=5) as r:
+            assert r.status == 200, r.status
+    finally:
+        await fleet.stop()
+
+asyncio.run(main())
+print("telemetry scrape smoke ok (cost gauges live; fleet heartbeats carry ports)")
+EOF
+
 # Fleet-observability smoke (docs/observability.md): two processes' span
 # dumps — the child's recv chain parented on the parent's propagated wire
 # context — must merge into ONE chrome trace with two process lanes, one

@@ -74,6 +74,8 @@ SECTIONS: dict[str, list[str]] = {
         "quantum_resistant_p2p_tpu.obs.trace",
         "quantum_resistant_p2p_tpu.obs.metrics",
         "quantum_resistant_p2p_tpu.obs.slo",
+        "quantum_resistant_p2p_tpu.obs.cost",
+        "quantum_resistant_p2p_tpu.obs.http",
         "quantum_resistant_p2p_tpu.obs.flight",
     ],
     "analysis": [

@@ -1,0 +1,276 @@
+"""qrtop — a terminal dashboard over the fleet's live telemetry endpoints.
+
+Polls N per-gateway telemetry surfaces (obs/http.py: ``/healthz``,
+``/readyz``, ``/cost``, ``/slo``, ``/metrics.json``) and renders one row
+per gateway: handshakes/s, shed rate, SLO burn, breaker/shard states,
+padding-waste fraction, and live compile activity — the serving-cost
+economics (docs/observability.md "Reading the cost ledger") as a
+top(1)-style loop instead of a post-hoc artifact.
+
+Endpoints come from the command line (``host:port`` or
+``name=host:port``) or are discovered from a fleet router's aggregated
+``/fleet`` view (``--fleet host:port`` — fleet/manager.py announces each
+gateway's telemetry port from its hello/heartbeats).
+
+``--snapshot`` takes ONE poll and emits the JSON document instead of
+rendering — the CI artifact mode (``bench.py --storm --fleet N`` runs
+this exact function against the live mid-storm gateways to produce the
+committed ``bench_results/fleet_storm_cost_snapshot.json``).
+
+Stdlib-only (urllib + json): runs wherever the gateways do.
+
+Usage::
+
+    python tools/qrtop.py 127.0.0.1:9100 gw1=127.0.0.1:9101
+    python tools/qrtop.py --fleet 127.0.0.1:9000 --interval 2
+    python tools/qrtop.py --fleet 127.0.0.1:9000 --snapshot --out snap.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+#: per-request scrape timeout: a dead gateway must cost one bounded wait,
+#: never hang the dashboard loop
+SCRAPE_TIMEOUT_S = 3.0
+
+
+def fetch_json(base: str, path: str,
+               timeout: float = SCRAPE_TIMEOUT_S) -> dict[str, Any] | None:
+    """GET ``http://{base}{path}`` as JSON; None when unreachable or
+    malformed (a dead gateway is a row that says so, not a crash).
+    Non-200 readiness replies still carry a JSON body — parse them."""
+    try:
+        with urllib.request.urlopen(f"http://{base}{path}",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read())
+        except (ValueError, OSError):
+            return None
+    except (OSError, ValueError):
+        return None
+
+
+def _sum_compiles(cost: dict[str, Any]) -> tuple[int, float]:
+    events = seconds = 0
+    for row in (cost.get("compiles") or {}).values():
+        events += row.get("events") or 0
+        seconds += row.get("seconds") or 0.0
+    return events, round(seconds, 3)
+
+
+def _opcache_rates(cost: dict[str, Any]) -> dict[str, Any]:
+    return {kind: c.get("window_hit_rate")
+            for kind, c in (cost.get("opcaches") or {}).items()}
+
+
+def scrape_gateway(name: str, base: str) -> dict[str, Any]:
+    """One gateway's dashboard row, from its live endpoints."""
+    health = fetch_json(base, "/healthz")
+    if health is None:
+        return {"gateway": name, "endpoint": base, "reachable": False}
+    ready = fetch_json(base, "/readyz") or {}
+    cost = fetch_json(base, "/cost") or {}
+    slo = fetch_json(base, "/slo") or {}
+    snap = fetch_json(base, "/metrics.json") or {}
+    counters = snap.get("counters") or {}
+    queues = (snap.get("collected") or {}).get("queues") or {}
+    uptime = float(health.get("uptime_s") or 0.0)
+    # both halves of the handshake work: a pure gateway only RESPONDS,
+    # so its rate lives in the admitted count, not the initiator one
+    handshakes = (int(health.get("handshake_attempts") or 0)
+                  + int(health.get("handshakes_admitted") or 0))
+    compile_events, compile_seconds = _sum_compiles(cost)
+    burns = {s.get("name"): s.get("burn_fast")
+             for s in (slo.get("specs") or [])}
+    return {
+        "gateway": name,
+        "endpoint": base,
+        "reachable": True,
+        "node": health.get("node"),
+        "uptime_s": round(uptime, 3),
+        "ready": bool(ready.get("ready")),
+        "breakers": ready.get("breakers") or {},
+        "handshakes": handshakes,
+        "handshake_attempts": int(health.get("handshake_attempts") or 0),
+        "hs_per_s": round(handshakes / uptime, 3) if uptime > 0 else None,
+        "handshake_sheds": counters.get("handshake_sheds"),
+        "handshakes_admitted": counters.get("handshakes_admitted"),
+        "bulk_sheds": counters.get("bulk_sheds"),
+        "device_served_fraction": queues.get("device_served_fraction"),
+        "breaker_state": queues.get("breaker_state"),
+        "padding_waste_fraction": cost.get("padding_waste_fraction"),
+        "device_seconds_total": cost.get("device_seconds_total"),
+        "device_seconds_per_1k_handshakes":
+            cost.get("device_seconds_per_1k_handshakes"),
+        "compile_events": compile_events,
+        "compile_seconds": compile_seconds,
+        "recent_compiles": (cost.get("recent_compiles") or [])[-3:],
+        "opcache_window_hit_rate": _opcache_rates(cost),
+        "tuner_journal_len": cost.get("tuner_journal_len"),
+        "slo_alerting": slo.get("alerting") or [],
+        "burn_fast": burns,
+    }
+
+
+def snapshot_endpoints(endpoints: dict[str, str]) -> dict[str, Any]:
+    """One-shot scrape of every endpoint — the ``--snapshot`` document
+    (also called in-harness by ``fleet/storm.py`` while the gateways are
+    live, which is how the committed CI artifact is produced)."""
+    return {
+        "tool": "qrtop --snapshot",
+        "endpoints": dict(endpoints),
+        "gateways": {name: scrape_gateway(name, base)
+                     for name, base in sorted(endpoints.items())},
+    }
+
+
+def discover_fleet(router: str) -> dict[str, str]:
+    """Gateway telemetry endpoints from a router's ``/fleet`` view."""
+    doc = fetch_json(router, "/fleet")
+    if doc is None:
+        raise SystemExit(f"qrtop: no /fleet view at http://{router}")
+    host = router.rsplit(":", 1)[0]
+    out: dict[str, str] = {}
+    for member in ((doc.get("router") or {}).get("members") or []):
+        port = member.get("telemetry_port")
+        if port:
+            out[str(member.get("gateway"))] = f"{host}:{port}"
+    return out
+
+
+# -- live rendering ------------------------------------------------------------
+
+
+def _fmt(v: Any, pct: bool = False) -> str:
+    if v is None:
+        return "-"
+    if pct:
+        return f"{v * 100:.1f}%"
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+def render(rows: list[dict[str, Any]], prev: dict[str, dict[str, Any]],
+           elapsed: float) -> str:
+    """One dashboard frame.  hs/s comes from the poll-to-poll delta over
+    the REAL elapsed seconds when a previous sample exists (the live
+    rate), else the uptime average."""
+    cols = ("GATEWAY", "UP(s)", "RDY", "HS", "HS/S", "SHED", "WASTE",
+            "COMP(n/s)", "OPCACHE", "BURN", "BREAKERS")
+    lines = ["  ".join(f"{c:<10}" for c in cols)]
+    for row in rows:
+        name = row["gateway"]
+        if not row.get("reachable"):
+            lines.append(f"{name:<10}  [unreachable: {row['endpoint']}]")
+            continue
+        last = prev.get(name)
+        if last and elapsed > 0:
+            hs_rate = (row["handshakes"]
+                       - last.get("handshakes", 0)) / elapsed
+        else:
+            hs_rate = row.get("hs_per_s")
+        sheds = sum(row.get(k) or 0 for k in
+                    ("handshake_sheds", "bulk_sheds"))
+        comp = f"{row['compile_events']}/{row['compile_seconds']:.1f}"
+        opc = ",".join(f"{k}:{_fmt(v, pct=True)}" for k, v in
+                       sorted(row["opcache_window_hit_rate"].items())) or "-"
+        burn = max((b for b in row["burn_fast"].values()
+                    if isinstance(b, (int, float))), default=None)
+        breakers = ",".join(f"{k}:{v}" for k, v in
+                            sorted(row["breakers"].items())) or "-"
+        alert = "!" if row["slo_alerting"] else ""
+        vals = (name, _fmt(row["uptime_s"]), "y" if row["ready"] else "N",
+                str(row["handshakes"]), _fmt(hs_rate), str(sheds),
+                _fmt(row["padding_waste_fraction"], pct=True), comp, opc,
+                _fmt(burn) + alert, breakers)
+        lines.append("  ".join(f"{v:<10}" for v in vals))
+    return "\n".join(lines)
+
+
+def live_loop(endpoints: dict[str, str], interval: float,
+              iterations: int | None = None, out=sys.stdout) -> None:
+    prev: dict[str, dict[str, Any]] = {}
+    prev_t: float | None = None
+    n = 0
+    while iterations is None or n < iterations:
+        rows = [scrape_gateway(name, base)
+                for name, base in sorted(endpoints.items())]
+        # rates divide by the REAL elapsed time since the last frame, not
+        # the nominal interval — the serial scrape itself takes time (up
+        # to timeout x endpoints when a gateway is black-holed), and
+        # dividing by the nominal interval would inflate HS/S by exactly
+        # that slippage
+        now = time.monotonic()
+        elapsed = (now - prev_t) if prev_t is not None else 0.0
+        prev_t = now
+        frame = render(rows, prev, elapsed)
+        # ANSI home+clear keeps it a flicker-free top(1)-style refresh
+        out.write("\x1b[H\x1b[2J" if out.isatty() else "")
+        out.write(time.strftime("qrtop  %H:%M:%S") + f"  ({len(rows)} "
+                  "gateway(s))\n" + frame + "\n")
+        out.flush()
+        prev = {r["gateway"]: r for r in rows if r.get("reachable")}
+        n += 1
+        if iterations is not None and n >= iterations:
+            break
+        time.sleep(interval)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("endpoints", nargs="*",
+                    help="gateway telemetry endpoints: host:port or "
+                         "name=host:port")
+    ap.add_argument("--fleet", default=None,
+                    help="router telemetry host:port — discover gateway "
+                         "endpoints from its /fleet view")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval (seconds) in live mode")
+    ap.add_argument("--iterations", type=int, default=None,
+                    help="stop after N frames (default: run until ^C)")
+    ap.add_argument("--snapshot", action="store_true",
+                    help="one poll, JSON document to stdout (CI artifact "
+                         "mode)")
+    ap.add_argument("--out", default=None,
+                    help="with --snapshot: also write the JSON here")
+    args = ap.parse_args(argv)
+
+    endpoints: dict[str, str] = {}
+    if args.fleet:
+        endpoints.update(discover_fleet(args.fleet))
+    for i, spec in enumerate(args.endpoints):
+        name, _, base = spec.rpartition("=")
+        endpoints[name or f"gw{i}"] = base
+    if not endpoints:
+        ap.error("no endpoints (pass host:port args or --fleet)")
+
+    if args.snapshot:
+        doc = snapshot_endpoints(endpoints)
+        line = json.dumps(doc, indent=2, sort_keys=True)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        unreachable = [g for g, row in doc["gateways"].items()
+                       if not row.get("reachable")]
+        return 1 if len(unreachable) == len(doc["gateways"]) else 0
+
+    try:
+        live_loop(endpoints, args.interval, args.iterations)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

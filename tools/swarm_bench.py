@@ -624,6 +624,11 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
         # the consumer-grade signal the raw shed/served counters feed
         "slo": {"hub": hub_metrics["slo"],
                 "client_plane": proto_metrics["slo"]},
+        # the device-cost ledgers at storm end (obs/cost.py): padding
+        # waste, compile attribution, device seconds, opcache windows —
+        # bench.py writes this as {mode}_cost_snapshot.json
+        "cost": {"hub": hub_metrics["cost"],
+                 "client_plane": proto_metrics["cost"]},
     }
     if plan is not None:
         out["chaos"] = {
