@@ -267,6 +267,116 @@ def storm_main(out_path: str | None = None, sessions: int = STORM_SESSIONS,
     return rc
 
 
+#: bulk-mix storm ratchet configuration (docs/gateway.md "Bulk-heavy
+#: storms"): a bulk-heavy seeded trace (15 messages/session, 8 KiB
+#: payloads) replayed twice — once on the scalar ChaCha20-Poly1305 path,
+#: once through the batched device AEAD — and gated on the speedup.
+#: 8 KiB payloads make the AEAD the dominant per-message cost (the shape
+#: the data plane exists for — small-payload storms measure the Python
+#: protocol loop, which both paths share); concurrency is a power of two
+#: so every coalesced flush lands on a prewarmed pow2 batch bucket.
+BULK_SESSIONS = 48
+BULK_MSGS_PER_SESSION = 15
+BULK_PAYLOAD_BYTES = 8192
+BULK_CONCURRENCY = 64
+BULK_ARRIVAL_RATE = 30.0
+#: the tentpole's ratchet: batched bulk messages/s must beat the scalar
+#: path by at least this factor, with zero failures and a p99 bound
+MIN_BULK_SPEEDUP = 5.0
+MAX_BULK_P99_MSG_S = 1.0
+
+
+def bulk_storm_main(out_path: str | None = None,
+                    sessions: int = BULK_SESSIONS,
+                    msgs_per_session: int = BULK_MSGS_PER_SESSION) -> int:
+    """Bulk-heavy storm ratchet (the data-plane gate): replay one seeded
+    bulk-mix trace on the SCALAR ChaCha20-Poly1305 path and through the
+    BATCHED device AEAD + binary wire, write
+    ``bench_results/bulk_storm_r0N.json``, and gate on:
+
+    * zero failed handshakes/sends in both runs;
+    * batched bulk messages/s >= ``MIN_BULK_SPEEDUP`` x the scalar path;
+    * batched p99 per-message latency <= ``MAX_BULK_P99_MSG_S``;
+    * the batched run >= ``SLO_MIN_DEVICE_SERVED`` device-served (a
+      quietly-degraded data plane must not pass on fallback numbers).
+
+    Small session counts (tools/ci_smoke.sh) run in smoke mode: gates on
+    failures only — sub-noise-floor ratio comparisons and the committed
+    artifact are full-size-run territory.
+    """
+    import asyncio
+    import sys
+    from pathlib import Path
+
+    from tools.swarm_bench import run_storm
+
+    smoke = sessions < BULK_SESSIONS
+    params = dict(
+        sessions=sessions, arrival_rate=BULK_ARRIVAL_RATE,
+        concurrency=BULK_CONCURRENCY, msgs_per_session=msgs_per_session,
+        payload_bytes=BULK_PAYLOAD_BYTES, seed=STORM_SEED,
+    )
+    # untimed warm pass: compiles the batched AEAD's live (batch, length)
+    # buckets so the measured window starts device-served (the in-process
+    # jit cache persists across run_storm calls)
+    asyncio.run(run_storm(aead_mode="chacha",
+                          **{**params, "sessions": min(24, sessions)}))
+    batched = asyncio.run(run_storm(aead_mode="chacha", **params))
+    scalar = asyncio.run(run_storm(aead_mode="chacha-scalar", **params))
+
+    speedup = (round(batched["msgs_per_s"] / scalar["msgs_per_s"], 2)
+               if scalar["msgs_per_s"] else None)
+    out = {
+        "metric": (f"bulk_storm_{sessions}x{msgs_per_session}"
+                   f"x{BULK_PAYLOAD_BYTES}B_msgs_per_s"),
+        "value": batched["msgs_per_s"],
+        "unit": "msgs/s",
+        "vs_baseline": speedup,  # the scalar path IS the baseline
+        "min_speedup": MIN_BULK_SPEEDUP,
+        "max_p99_msg_s": MAX_BULK_P99_MSG_S,
+        "speedup": speedup,
+        "batched": batched,
+        "scalar": scalar,
+        "ok": True,
+    }
+    rc = 0
+    failures = batched["failures"] + scalar["failures"]
+    if failures:
+        print(f"BULK STORM FAIL: {failures} failure(s)", file=sys.stderr)
+        rc = 1
+    if not smoke:
+        if speedup is None or speedup < MIN_BULK_SPEEDUP:
+            print(f"BULK STORM FAIL: batched path only {speedup}x the "
+                  f"scalar baseline (< {MIN_BULK_SPEEDUP}x): "
+                  f"{batched['msgs_per_s']} vs {scalar['msgs_per_s']} msgs/s",
+                  file=sys.stderr)
+            rc = 1
+        if (batched["p99_msg_s"] or 0) > MAX_BULK_P99_MSG_S:
+            print(f"BULK STORM FAIL: batched p99 message latency "
+                  f"{batched['p99_msg_s']}s over the {MAX_BULK_P99_MSG_S}s "
+                  "bound", file=sys.stderr)
+            rc = 1
+        served = batched["device_served_fraction"] or 0.0
+        if served < SLO_MIN_DEVICE_SERVED:
+            print(f"BULK STORM FAIL: batched run only {served:.1%} "
+                  f"device-served (< {SLO_MIN_DEVICE_SERVED:.0%}) — the "
+                  "'batched' numbers measure the scalar fallback",
+                  file=sys.stderr)
+            rc = 1
+    out["ok"] = rc == 0
+    line = json.dumps(out)
+    print(line)
+    if not smoke:
+        Path("bench_results").mkdir(exist_ok=True)
+        n = 1
+        while Path(f"bench_results/bulk_storm_r{n:02d}.json").exists():
+            n += 1
+        Path(f"bench_results/bulk_storm_r{n:02d}.json").write_text(line + "\n")
+    if out_path:
+        Path(out_path).write_text(line + "\n")
+    return rc
+
+
 #: fleet chaos ratchet configuration (docs/fleet.md): the seeded
 #: gateway-death storm the CI gate replays.  gw1 is SIGKILLed on its 8th
 #: fleet health tick (~2 s in, mid-ramp at the paced arrival rate), so a
@@ -523,6 +633,12 @@ if __name__ == "__main__":
                     choices=("process", "task"),
                     help="fleet gateway isolation (--storm --fleet): real "
                          "subprocesses or in-process asyncio tasks")
+    ap.add_argument("--bulk-mix", action="store_true",
+                    help="with --storm: run the BULK-heavy data-plane "
+                         "ratchet instead — one seeded bulk-mix trace on "
+                         "the scalar ChaCha20-Poly1305 path vs the batched "
+                         "device AEAD, gated on >=5x messages/s and a p99 "
+                         "message-latency bound (docs/gateway.md)")
     ap.add_argument("--sessions", type=int, default=STORM_SESSIONS,
                     help="concurrent sessions in the storm ratchet")
     ap.add_argument("--reps", type=int, default=STORM_REPS,
@@ -549,6 +665,10 @@ if __name__ == "__main__":
     if args.storm and args.fleet:
         raise SystemExit(fleet_storm_main(args.out, args.sessions,
                                           args.fleet, args.spawn))
+    if args.storm and args.bulk_mix:
+        sessions = (args.sessions if args.sessions != STORM_SESSIONS
+                    else BULK_SESSIONS)
+        raise SystemExit(bulk_storm_main(args.out, sessions))
     if args.storm:
         raise SystemExit(storm_main(args.out, args.sessions, args.reps))
     if args.multichip:

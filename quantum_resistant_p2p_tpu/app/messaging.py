@@ -204,6 +204,7 @@ class SecureMessaging:
         max_inflight_handshakes: int = 0,
         bulk_lane_capacity: int = 0,
         telemetry_port: int | None = None,
+        batch_aead: bool | None = None,
     ):
         self.node = node
         self.key_storage = key_storage
@@ -230,7 +231,11 @@ class SecureMessaging:
         # covers every size a live swarm can hit (keyword so the positional
         # _batch_cfg unpacking at hot-swap stays untouched)
         self._batch_floor = batch_floor
-        self._bkem = self._bsig = self._bfused = None
+        self._bkem = self._bsig = self._bfused = self._baead = None
+        # batched device AEAD (the data plane): None reads the registry /
+        # QRP2P_BATCH_AEAD default; False pins the scalar path (the
+        # bulk-storm baseline configuration)
+        self._batch_aead = batch_aead
         self._warmup_thread = None
         self._queue_breaker = None
         # The engine's metrics registry (obs/metrics.py) — the single source
@@ -347,6 +352,10 @@ class SecureMessaging:
                                           bucket_floor=batch_floor,
                                           lane_capacity=self._lane_capacity)
             self._bfused = self._make_fused()
+            # the DATA plane: bulk AEAD seal/open batches through the same
+            # scheduler/lanes/breaker machinery (provider/batched.py
+            # BatchedAEAD); None when the AEAD has no device capability
+            self._baead = self._make_batched_aead()
             self._attach_tuners()
             self._attach_cost()
             self._spawn_warmup()
@@ -500,7 +509,7 @@ class SecureMessaging:
         queues are fresh objects; attach is idempotent per queue)."""
         if self._autotuner is not None:
             self._autotuner.attach_facades(self._bkem, self._bsig,
-                                           self._bfused)
+                                           self._bfused, self._baead)
 
     def _attach_cost(self) -> None:
         """(Re-)attach the cost ledger to every live facade queue and the
@@ -510,7 +519,7 @@ class SecureMessaging:
         idempotent)."""
         from ..provider.batched import facade_queues
 
-        for facade in (self._bkem, self._bsig, self._bfused):
+        for facade in (self._bkem, self._bsig, self._bfused, self._baead):
             if facade is None:
                 continue
             facade.cost = self.cost
@@ -1017,6 +1026,45 @@ class SecureMessaging:
             lane_capacity=self._lane_capacity,
         )
 
+    def _make_batched_aead(self):
+        """Batched-AEAD facade (provider.batched.BatchedAEAD) when the
+        active AEAD advertises the device capability — None (no capability,
+        ``QRP2P_BATCH_AEAD=0``, or ``batch_aead=False``) keeps every seal/
+        open on the scalar path.  Shares the scheduler/lanes/breakers with
+        the handshake facades, so a bulk AEAD flood sheds at the bulk lane
+        and a sick device degrades the whole plane to cpu together."""
+        if not self.use_batching or self._batch_aead is False:
+            return None
+        from ..provider.registry import get_batched_aead
+
+        device = get_batched_aead(self.symmetric)
+        if device is None:
+            return None
+        from ..provider.batched import BatchedAEAD
+
+        max_batch, max_wait_ms = self._batch_cfg
+        return BatchedAEAD(
+            device, self.symmetric, max_batch, max_wait_ms,
+            scheduler=self._scheduler, bucket_floor=self._batch_floor,
+            lane_capacity=self._lane_capacity,
+        )
+
+    async def _aead_encrypt(self, key: bytes, plaintext: bytes, ad: bytes,
+                            lane: int = LANE_BULK) -> bytes:
+        """Seal through the batched facade when armed, else scalar — the
+        wire bytes are format-identical either way (KAT-pinned)."""
+        if self._baead is not None:
+            return await self._baead.encrypt(key, plaintext, ad, lane)
+        return self.symmetric.encrypt(key, plaintext, ad)
+
+    async def _aead_decrypt(self, key: bytes, data, ad: bytes,
+                            lane: int = LANE_BULK) -> bytes:
+        """Open through the batched facade when armed (``data`` may be a
+        zero-copy memoryview off the binary wire), else scalar."""
+        if self._baead is not None:
+            return await self._baead.decrypt(key, data, ad, lane)
+        return self.symmetric.decrypt(key, bytes(data), ad)
+
     def _trips_now(self) -> int:
         """Serial dispatch steps (device + fallback) so far on the breaker
         (or placement axis) the live queues actually share — swarm clients
@@ -1042,6 +1090,10 @@ class SecureMessaging:
         out["sig_queue"] = self._bsig.stats()
         if self._bfused is not None:
             out["fused_queue"] = self._bfused.stats()
+        if self._baead is not None:
+            # the data plane's seal/open queues (additive key, same
+            # compatibility contract as fused_queue)
+            out["aead_queue"] = self._baead.stats()
         b = self._bkem.breaker
         sched = getattr(self._bkem, "scheduler", None)
         if sched is not None:
@@ -1076,7 +1128,8 @@ class SecureMessaging:
         # the degradation gauge across every queue of this engine
         # (VERDICT r3: a silently cpu-served "TPU" fleet must be visible)
         total = fb = 0
-        for fam_key in ("kem_queue", "sig_queue", "fused_queue"):
+        for fam_key in ("kem_queue", "sig_queue", "fused_queue",
+                        "aead_queue"):
             for q in out.get(fam_key, {}).values():
                 total += q["ops"]
                 fb += q["fallback_ops"]
@@ -1324,9 +1377,11 @@ class SecureMessaging:
             self._bsig if sig and getattr(self.signature, "backend", "") == "tpu" else None
         )
         # the fused facade is rebuilt on every swap (it bakes in the pair AND
-        # the transcript offsets), so whenever it exists it needs a warm
+        # the transcript offsets), so whenever it exists it needs a warm;
+        # likewise the batched-AEAD facade (rebuilt on every AEAD swap)
         bfused = self._bfused
-        if bkem is None and bsig is None and bfused is None:
+        baead = self._baead
+        if bkem is None and bsig is None and bfused is None and baead is None:
             return
 
         def _warm():
@@ -1337,8 +1392,8 @@ class SecureMessaging:
                 # breaker onto the cpu fallback, and HQC re-routes its FFT.
                 from ..provider import health
 
-                health.gate_facades(bkem, bsig, bfused)
-                first = bkem or bsig or bfused
+                health.gate_facades(bkem, bsig, bfused, baead)
+                first = bkem or bsig or bfused or baead
                 if first is not None and first.breaker.state == "quarantined":
                     # the facades share one breaker: a quarantine pins the
                     # cpu fallback for the process, so compiling the device
@@ -1355,6 +1410,8 @@ class SecureMessaging:
                     bsig.warmup(WARMUP_SIZES)
                 if bfused is not None:
                     bfused.warmup(WARMUP_SIZES)
+                if baead is not None:
+                    baead.warmup(WARMUP_SIZES)
             except Exception:
                 logger.exception("batched-provider warmup failed")
 
@@ -1845,7 +1902,18 @@ class SecureMessaging:
         if key is None:
             logger.warning("no shared key with %s; message not sent", peer_id[:8])
             return False
-        ct = self.symmetric.encrypt(key, _canonical(package), ad)
+        try:
+            # batched seal on the bulk lane (the DATA plane): coalesces
+            # with every live session's seals into one device dispatch;
+            # sheds exactly like the sign above under a bulk-lane bound
+            ct = await self._aead_encrypt(key, _canonical(package), ad)
+        except LaneShed:
+            self._ctr_bulk_sheds.inc()
+            logger.warning(
+                "bulk seal to %s shed at the bulk-lane bound (%d total)",
+                peer_id[:8], self._ctr_bulk_sheds.value,
+            )
+            return False
         sent = await self.node.send_message(peer_id, "secure_message", ct=ct, ad=ad)
         if not sent:
             return False
@@ -1868,9 +1936,20 @@ class SecureMessaging:
         if key is None:
             logger.warning("secure message from %s without shared key", peer_id[:8])
             return
-        ad: bytes = msg.get("ad", b"")
+        ad: bytes = bytes(msg.get("ad", b""))
         try:
-            pt = self.symmetric.decrypt(key, msg.get("ct", b""), ad)
+            # batched open on the bulk lane; over the binary wire ``ct`` is
+            # a memoryview into the socket buffer — zero-copy into the
+            # device batch (net/p2p_node.py binary framing)
+            pt = await self._aead_decrypt(key, msg.get("ct", b""), ad)
+        except LaneShed:
+            # inbound bulk shed at its lane bound: loud and counted; the
+            # message is dropped WITHOUT touching the AEAD-failure/rekey
+            # machinery (a shed is load, not tampering)
+            self._ctr_bulk_sheds.inc()
+            logger.warning("inbound bulk-lane open shed (%d total)",
+                           self._ctr_bulk_sheds.value)
+            return
         except ValueError:
             # Corrupted/tampered ciphertext, or a desynchronised key.  Never
             # plaintext; after REKEY_AFTER_AEAD_FAILURES consecutive
@@ -2019,10 +2098,14 @@ class SecureMessaging:
     async def set_symmetric_algorithm(self, name: str) -> None:
         """Re-derive per-peer keys from stored raw secrets (reference: :1783-1810)."""
         self.symmetric = get_symmetric(name)
-        if self.use_batching and self._bfused is not None:
-            # the AEAD name sits BEFORE public_key in the canonical init
-            # JSON, so the fused facade's baked-in pk offset just moved
-            self._bfused = self._make_fused()
+        if self.use_batching:
+            # the data plane follows the AEAD: rebuild the batched facade
+            # for the new algorithm (None when it has no device capability)
+            self._baead = self._make_batched_aead()
+            if self._bfused is not None:
+                # the AEAD name sits BEFORE public_key in the canonical init
+                # JSON, so the fused facade's baked-in pk offset just moved
+                self._bfused = self._make_fused()
             self._attach_tuners()
             self._attach_cost()
             self._spawn_warmup(kem=False, sig=False)

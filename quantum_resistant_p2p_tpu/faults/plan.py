@@ -267,9 +267,11 @@ def _corrupt_payload(payload: dict[str, Any], rng: random.Random,
                      field_name: str | None) -> dict[str, Any]:
     """Deterministically flip one byte of one corruptible field.
 
-    Corruptible = a bytes value, or a hex string of >= 16 chars (the wire
-    encoding for keys/ciphertexts/signatures); nested one level into dict
-    values (``ke_data``).  Returns a mutated COPY — the caller's dict is
+    Corruptible = a bytes-like value (bytes/bytearray/memoryview — the
+    binary wire hands zero-copy views around), or a hex string of >= 16
+    chars (the JSON wire encoding for keys/ciphertexts/signatures);
+    nested one level into dict values (``ke_data``).  Returns a mutated
+    COPY — the caller's dict (and any shared buffer behind a view) is
     never aliased.
     """
     paths: list[tuple[str, ...]] = []
@@ -277,7 +279,7 @@ def _corrupt_payload(payload: dict[str, Any], rng: random.Random,
     def scan(prefix: tuple[str, ...], obj: dict[str, Any]) -> None:
         for key in sorted(obj):
             val = obj[key]
-            if isinstance(val, (bytes, bytearray)) and len(val) > 0:
+            if isinstance(val, (bytes, bytearray, memoryview)) and len(val) > 0:
                 paths.append(prefix + (key,))
             elif isinstance(val, str) and len(val) >= 16 and _is_hex(val):
                 paths.append(prefix + (key,))
@@ -296,7 +298,7 @@ def _corrupt_payload(payload: dict[str, Any], rng: random.Random,
         target[key] = dict(target[key])
         target = target[key]
     val = target[path[-1]]
-    if isinstance(val, (bytes, bytearray)):
+    if isinstance(val, (bytes, bytearray, memoryview)):
         pos = rng.randrange(len(val))
         buf = bytearray(val)
         buf[pos] ^= 0xFF

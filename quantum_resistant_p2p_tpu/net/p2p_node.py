@@ -6,8 +6,30 @@ per-type handler dispatch, disconnect fan-out) with a fresh wire design:
 
 Frame:   magic b"QP" | version u8 | flags u8 | length u32be | payload
          flags bit0 = CHUNK (payload carries a chunk header)
+         flags bit1 = BIN   (payload is the negotiated binary encoding)
 Chunk:   stream_id 16B | index u32be | count u32be | data
-Payload: UTF-8 JSON object with a mandatory "type" key.
+Payload: UTF-8 JSON object with a mandatory "type" key (the compat
+         default), or — on connections that negotiated ``bin1`` in the
+         hello exchange — the compact binary encoding below.
+
+Binary payload (docs/protocol.md "Wire-format negotiation"):
+
+    token b"B1" | type_len u8 | type | n_fields u8 | fields...
+    field := key_len u8 | key | kind u8 | value_len u32be | value
+    kind 0 = raw bytes (decoded as a zero-copy memoryview into the frame
+             buffer — ciphertexts go from socket buffer to the batched
+             AEAD open with no copy and no base64/hex round-trip)
+    kind 1 = UTF-8 canonical JSON (everything else, incl. ``_trace``)
+
+Negotiation: a node with ``QRP2P_BINARY_WIRE`` unset/``1`` offers
+``"wire": ["bin1"]`` in its hello; both sides offering upgrades every
+subsequent frame on that connection.  ``QRP2P_BINARY_WIRE=0`` and
+un-negotiated peers stay byte-identical to the historical JSON frames
+(pinned by tests/test_binary_wire.py).  Hostile binary input — oversized
+lengths, truncated headers, a wrong token, trailing garbage — fails as a
+typed :class:`WireError`: loud log + flight event + ``wire_errors``
+counter, the offending connection dropped, the serving loop and every
+other peer untouched.
 
 Messages above ``chunk_size`` (default 64 KiB) are split into chunk frames and
 reassembled on the far side; anything smaller travels in a single frame.
@@ -21,6 +43,7 @@ import asyncio
 import base64
 import json
 import logging
+import os
 import struct
 import uuid
 from dataclasses import dataclass, field
@@ -39,13 +62,116 @@ HELLO_TIMEOUT = 15.0
 _MAGIC = b"QP"
 _VERSION = 1
 _FLAG_CHUNK = 0x01
+_FLAG_BIN = 0x02
 _HEADER = struct.Struct(">2sBBI")
 _CHUNK_HEADER = struct.Struct(">16sII")
+
+#: binary-payload negotiation token: the first two payload bytes of every
+#: bin1 frame.  A frame flagged BIN without it is hostile/corrupt input
+#: and fails typed (WireError), never as a stray json/struct exception.
+_BIN_TOKEN = b"B1"
+_BIN_WIRE_NAME = "bin1"
+_BIN_KIND_RAW = 0
+_BIN_KIND_JSON = 1
 
 MessageHandler = Callable[[str, dict], Awaitable[None]]
 ConnectionHandler = Callable[[str, str], None]  # (event, peer_id)
 
 MAX_FRAME = 16 * 1024 * 1024
+
+#: largest raw value the binary decoder accepts per field — the sender
+#: routes messages with a bigger bytes value (huge file transfers) over
+#: the JSON wire instead, which chunks and reassembles without a
+#: per-field cap; the receive-side bound stays tight against hostile
+#: length claims
+_BIN_MAX_FIELD = MAX_FRAME
+
+
+class WireError(ValueError):
+    """Typed wire-protocol violation (bad magic/version, oversized length,
+    truncated or malformed binary payload, un-negotiated binary frame).
+    The read loop maps it to one loud, counted connection drop — hostile
+    input on one socket can never kill the node's serving loop."""
+
+
+def binary_wire_default() -> bool:
+    """``QRP2P_BINARY_WIRE`` policy: offer the binary wire unless ``0``."""
+    return os.environ.get("QRP2P_BINARY_WIRE", "1") != "0"
+
+
+def _encode_bin(message: dict) -> list:
+    """Encode a message dict as binary-payload segments (zero-copy: raw
+    bytes/memoryview values ride as their own segments, uncopied)."""
+    msg_type = str(message.get("type", ""))
+    fields = [(k, v) for k, v in message.items() if k != "type"]
+    tb = msg_type.encode()
+    if len(tb) > 255 or len(fields) > 255:
+        raise ValueError("binary frame: type/field count out of range")
+    head = bytearray(_BIN_TOKEN)
+    head.append(len(tb))
+    head += tb
+    head.append(len(fields))
+    segs: list = [bytes(head)]
+    for k, v in fields:
+        kb = k.encode()
+        if len(kb) > 255:
+            raise ValueError(f"binary frame: key {k!r} too long")
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            kind, vb = _BIN_KIND_RAW, v
+        else:
+            kind, vb = _BIN_KIND_JSON, json.dumps(
+                v, separators=(",", ":")).encode()
+        segs.append(bytes([len(kb)]) + kb + bytes([kind])
+                    + len(vb).to_bytes(4, "big"))
+        segs.append(vb)
+    return segs
+
+
+def _decode_bin(buf) -> dict:
+    """Decode a binary payload into a message dict.
+
+    ``memoryview``-parsed: raw-kind values are returned as views into the
+    received frame buffer — the ciphertext of a ``secure_message`` flows
+    from the socket buffer into the batched AEAD open without a copy.
+    Every length is bounds-checked BEFORE use; any violation is a typed
+    :class:`WireError` naming what was malformed.
+    """
+    view = memoryview(buf)
+    pos = 0
+
+    def take(n: int, what: str) -> memoryview:
+        nonlocal pos
+        if n < 0 or pos + n > len(view):
+            raise WireError(f"truncated binary frame ({what})")
+        out = view[pos:pos + n]
+        pos += n
+        return out
+
+    if bytes(take(2, "wire token")) != _BIN_TOKEN:
+        raise WireError("bad binary wire token")
+    try:
+        msg_type = bytes(take(take(1, "type length")[0], "type")).decode()
+        message: dict = {"type": msg_type}
+        for _ in range(take(1, "field count")[0]):
+            fname = bytes(take(take(1, "name length")[0], "field name")).decode()
+            kind = take(1, "field kind")[0]
+            vlen = int.from_bytes(take(4, "value length"), "big")
+            if vlen > _BIN_MAX_FIELD:
+                raise WireError(f"oversized binary field {fname!r} ({vlen} bytes)")
+            val = take(vlen, f"field {fname!r}")
+            if kind == _BIN_KIND_RAW:
+                message[fname] = val  # zero-copy view into the frame buffer
+            elif kind == _BIN_KIND_JSON:
+                message[fname] = json.loads(bytes(val))
+            else:
+                raise WireError(f"unknown binary field kind {kind}")
+    except WireError:
+        raise
+    except (UnicodeDecodeError, ValueError) as e:
+        raise WireError(f"malformed binary frame: {e}") from e
+    if pos != len(view):
+        raise WireError(f"trailing bytes in binary frame ({len(view) - pos})")
+    return message
 
 
 @dataclass
@@ -57,6 +183,9 @@ class _Peer:
     port: int  # the peer's listening port (from hello), not the socket port
     write_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     reassembly: dict[bytes, dict] = field(default_factory=dict)
+    #: negotiated wire format: "json" (compat default) or "bin1" (both
+    #: sides offered it in the hello exchange)
+    wire: str = "json"
 
 
 class P2PNode:
@@ -71,6 +200,7 @@ class P2PNode:
         chunk_size: int = 64 * 1024,
         max_peers: int = 0,
         accept_backlog: int = 256,
+        binary_wire: bool | None = None,
     ):
         if node_id is None:
             from .identity import load_or_generate_node_id
@@ -105,6 +235,14 @@ class P2PNode:
         self._admitting: set[str] = set()
         #: dials WE made that a remote shed with ``__busy__``
         self.busy_rejects = 0
+        #: offer the length-prefixed binary wire format in hellos; actual
+        #: use is per-connection, negotiated (both sides must offer).
+        #: None reads QRP2P_BINARY_WIRE (default: offer).
+        self.binary_wire = (binary_wire_default() if binary_wire is None
+                            else binary_wire)
+        #: typed wire-protocol violations (WireError) observed on read
+        #: loops — each one dropped exactly one connection, loudly
+        self.wire_errors = 0
         self._server: asyncio.Server | None = None
         self._peers: dict[str, _Peer] = {}
         self._read_tasks: dict[str, asyncio.Task] = {}
@@ -153,6 +291,31 @@ class P2PNode:
     def get_peer_address(self, peer_id: str) -> tuple[str, int] | None:
         p = self._peers.get(peer_id)
         return (p.host, p.port) if p else None
+
+    def peer_wire_format(self, peer_id: str) -> str | None:
+        """The negotiated wire format for a live peer ("json" | "bin1"),
+        None when unknown."""
+        p = self._peers.get(peer_id)
+        return p.wire if p else None
+
+    def _hello(self) -> dict:
+        """Hello payload: node identity + (when enabled) the wire-format
+        offer.  With the offer disabled the payload — and therefore the
+        hello frame bytes — is identical to the historical one (pinned)."""
+        hello = {"type": "__hello__", "node_id": self.node_id,
+                 "listen_port": self.port}
+        if self.binary_wire:
+            hello["wire"] = [_BIN_WIRE_NAME]
+        return hello
+
+    def _negotiated_wire(self, hello: dict) -> str:
+        """Per-connection wire format from the peer's hello: ``bin1`` iff
+        BOTH sides offered it, else the JSON compat default."""
+        offered = hello.get("wire")
+        if (self.binary_wire and isinstance(offered, (list, tuple))
+                and _BIN_WIRE_NAME in offered):
+            return _BIN_WIRE_NAME
+        return "json"
 
     def register_message_handler(self, msg_type: str, handler: MessageHandler) -> None:
         handlers = self._msg_handlers.setdefault(msg_type, [])
@@ -250,11 +413,7 @@ class P2PNode:
             logger.warning("connect to %s:%s failed: %s", host, port, e)
             return None, True
         try:
-            await self._send_frame(
-                writer,
-                asyncio.Lock(),
-                {"type": "__hello__", "node_id": self.node_id, "listen_port": self.port},
-            )
+            await self._send_frame(writer, asyncio.Lock(), self._hello())
             hello = await asyncio.wait_for(self._read_plain_frame(reader), HELLO_TIMEOUT)
             if hello.get("type") == "__busy__":
                 # the remote gateway shed this dial (connection budget):
@@ -273,7 +432,9 @@ class P2PNode:
             # a peer that SPOKE but spoke wrong is not transient
             return None, not isinstance(e, ValueError)
         peer_id = hello["node_id"]
-        self._register_peer(peer_id, reader, writer, host, int(hello.get("listen_port", port)))
+        self._register_peer(peer_id, reader, writer, host,
+                            int(hello.get("listen_port", port)),
+                            wire=self._negotiated_wire(hello))
         return peer_id, False
 
     async def _on_inbound(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -303,11 +464,7 @@ class P2PNode:
                 return
             self._admitting.add(peer_id)
             try:
-                await self._send_frame(
-                    writer,
-                    asyncio.Lock(),
-                    {"type": "__hello__", "node_id": self.node_id, "listen_port": self.port},
-                )
+                await self._send_frame(writer, asyncio.Lock(), self._hello())
             finally:
                 self._admitting.discard(peer_id)
         except Exception as e:
@@ -315,7 +472,9 @@ class P2PNode:
             writer.close()
             return
         self._register_peer(
-            peer_id, reader, writer, addr[0], int(hello.get("listen_port", addr[1]))
+            peer_id, reader, writer, addr[0],
+            int(hello.get("listen_port", addr[1])),
+            wire=self._negotiated_wire(hello),
         )
         self.admitted += 1
 
@@ -340,19 +499,21 @@ class P2PNode:
             pass  # the dialer is gone; the shed stands either way
         writer.close()
 
-    def _register_peer(self, peer_id, reader, writer, host, port) -> None:
+    def _register_peer(self, peer_id, reader, writer, host, port,
+                       wire: str = "json") -> None:
         old = self._peers.pop(peer_id, None)
         if old is not None:
             old.writer.close()
             task = self._read_tasks.pop(peer_id, None)
             if task:
                 task.cancel()
-        peer = _Peer(peer_id, reader, writer, host, port)
+        peer = _Peer(peer_id, reader, writer, host, port, wire=wire)
         self._peers[peer_id] = peer
         self._addr[peer_id] = (host, port)
         self._intentional.discard(peer_id)
         self._read_tasks[peer_id] = asyncio.create_task(self._read_loop(peer))
-        logger.info("peer %s connected (%s:%s)", peer_id[:8], host, port)
+        logger.info("peer %s connected (%s:%s, wire=%s)", peer_id[:8], host,
+                    port, wire)
         self._fire_connection_event("connect", peer_id)
 
     async def disconnect_from_peer(self, peer_id: str,
@@ -394,7 +555,23 @@ class P2PNode:
                 await asyncio.sleep(payload2)
             else:
                 payload = payload2
-            message = {"type": msg_type, **{k: _encode_value(v) for k, v in payload.items()}}
+            binary = peer.wire == _BIN_WIRE_NAME and not any(
+                isinstance(v, (bytes, bytearray, memoryview))
+                and len(v) > _BIN_MAX_FIELD
+                for v in payload.values()
+            )
+            # ^ messages carrying a bytes value past the decoder's
+            # per-field cap (huge file sends) fall back to the JSON wire
+            # for THIS message — a bin1 peer accepts JSON frames at any
+            # time, so the oversized transfer chunks through exactly as
+            # before negotiation instead of being dropped as hostile
+            if binary:
+                # negotiated binary path: bytes values ride raw (no b64/hex
+                # round-trip, no copy), everything else as per-field JSON
+                message = {"type": msg_type, **payload}
+            else:
+                message = {"type": msg_type,
+                           **{k: _encode_value(v) for k, v in payload.items()}}
             # cross-peer trace propagation: a bounded, ids-only ``_trace``
             # field (the net.send span's own context, so the receiver's
             # chain parents onto this exact send).  Correlation ids only —
@@ -403,7 +580,11 @@ class P2PNode:
             if wire_ctx is not None:
                 message["_trace"] = wire_ctx
             try:
-                await self._send_frame(peer.writer, peer.write_lock, message)
+                if binary:
+                    await self._send_frame_bin(peer.writer, peer.write_lock,
+                                               message)
+                else:
+                    await self._send_frame(peer.writer, peer.write_lock, message)
                 return True
             except (ConnectionError, OSError) as e:
                 logger.warning("send to %s failed: %s; evicting", peer_id[:8], e)
@@ -428,12 +609,45 @@ class P2PNode:
                     )
             await writer.drain()
 
+    async def _send_frame_bin(self, writer, lock: asyncio.Lock,
+                              message: dict) -> None:
+        """Binary-wire twin of _send_frame: length-prefixed compact frames
+        with raw-bytes pass-through.  Small frames write the header and
+        each encoded segment straight to the transport buffer — the
+        ciphertext bytes the AEAD produced are never concatenated, encoded,
+        or copied on the way out (the qrflow raw-bytes network sink)."""
+        segs = _encode_bin(message)
+        total = sum(len(s) for s in segs)
+        async with lock:
+            if total <= self.chunk_size:
+                writer.write(_HEADER.pack(_MAGIC, _VERSION, _FLAG_BIN, total))
+                for seg in segs:
+                    writer.write(seg)
+            else:
+                body = b"".join(segs)  # chunked path: slicing needs one buffer
+                stream_id = uuid.uuid4().bytes
+                chunks = [
+                    body[i: i + self.chunk_size]
+                    for i in range(0, len(body), self.chunk_size)
+                ]
+                for idx, chunk in enumerate(chunks):
+                    payload = _CHUNK_HEADER.pack(stream_id, idx, len(chunks)) + chunk
+                    writer.write(
+                        _HEADER.pack(_MAGIC, _VERSION,
+                                     _FLAG_CHUNK | _FLAG_BIN, len(payload))
+                        + payload
+                    )
+            await writer.drain()
+
     # -- receive -------------------------------------------------------------
 
     async def _read_plain_frame(self, reader: asyncio.StreamReader) -> dict:
         flags, payload = await self._read_raw(reader)
         if flags & _FLAG_CHUNK:
-            raise ValueError("unexpected chunked hello")
+            raise WireError("unexpected chunked hello")
+        if flags & _FLAG_BIN:
+            # the hello IS the negotiation; it always travels as JSON
+            raise WireError("unexpected binary hello")
         return json.loads(payload)
 
     @staticmethod
@@ -441,26 +655,54 @@ class P2PNode:
         header = await reader.readexactly(_HEADER.size)
         magic, version, flags, length = _HEADER.unpack(header)
         if magic != _MAGIC or version != _VERSION:
-            raise ValueError(f"bad frame header {header!r}")
+            raise WireError(f"bad frame header {header!r}")
         if length > MAX_FRAME:
-            raise ValueError(f"oversized frame ({length} bytes)")
+            raise WireError(f"oversized frame ({length} bytes)")
         return flags, await reader.readexactly(length)
+
+    def _decode_body(self, peer: _Peer, body, binary: bool) -> dict:
+        """One logical frame body -> message dict; malformed input of
+        either format is a typed WireError (the read loop's loud drop)."""
+        if binary:
+            if peer.wire != _BIN_WIRE_NAME:
+                raise WireError("binary frame from un-negotiated peer")
+            return _decode_bin(body)
+        try:
+            message = json.loads(body)
+        except ValueError as e:
+            raise WireError(f"malformed JSON frame: {e}") from e
+        if not isinstance(message, dict):
+            raise WireError("JSON frame is not an object")
+        return message
 
     async def _read_loop(self, peer: _Peer) -> None:
         try:
             while True:
                 flags, payload = await self._read_raw(peer.reader)
                 chunks = 0
+                binary = bool(flags & _FLAG_BIN)
                 if flags & _FLAG_CHUNK:
-                    reassembled = self._reassemble(peer, payload)
+                    reassembled = self._reassemble(peer, payload, binary)
                     if reassembled is None:
                         continue
                     message, chunks = reassembled
                 else:
-                    message = json.loads(payload)
+                    message = self._decode_body(peer, payload, binary)
                 await self._dispatch(peer.peer_id, message, chunks)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
+        except WireError as e:
+            # hostile or corrupt wire input: TYPED and loud — one warning,
+            # one flight event, one counted connection drop.  The serving
+            # loop and every other peer keep running (the finally below
+            # evicts exactly this peer); the dialing side's session-heal
+            # machinery may redial.
+            self.wire_errors += 1
+            logger.warning("wire error from %s: %s; dropping connection "
+                           "(%d total)", peer.peer_id[:8], e, self.wire_errors)
+            obs_flight.record("wire_error", node=self.node_id[:8],
+                              peer=peer.peer_id[:8], error=str(e),
+                              wire=peer.wire, total=self.wire_errors)
         except Exception:
             logger.exception("read loop error for %s", peer.peer_id[:8])
         finally:
@@ -470,20 +712,27 @@ class P2PNode:
                 peer.writer.close()
                 self._fire_connection_event("disconnect", peer.peer_id)
 
-    def _reassemble(self, peer: _Peer, payload: bytes) -> tuple[dict, int] | None:
+    def _reassemble(self, peer: _Peer, payload: bytes,
+                    binary: bool = False) -> tuple[dict, int] | None:
         """-> (message, chunk_count) once complete, None while partial.
         The chunk count rides into the dispatch's single ``net.recv`` span
         (``chunks=`` attr): the LOGICAL message gets one span linked to its
         handlers, not per-chunk spans with no edge to the dispatch."""
+        if len(payload) < _CHUNK_HEADER.size:
+            raise WireError("truncated chunk header")
         stream_id, index, count = _CHUNK_HEADER.unpack_from(payload)
+        if count == 0 or index >= count:
+            raise WireError(f"chunk index {index} out of range (count {count})")
         data = payload[_CHUNK_HEADER.size :]
         entry = peer.reassembly.setdefault(stream_id, {"count": count, "chunks": {}})
+        if count != entry["count"]:
+            raise WireError("chunk count changed mid-stream")
         entry["chunks"][index] = data
         if len(entry["chunks"]) < entry["count"]:
             return None
         del peer.reassembly[stream_id]
         body = b"".join(entry["chunks"][i] for i in range(count))
-        return json.loads(body), count
+        return self._decode_body(peer, body, binary), count
 
     async def _dispatch(self, peer_id: str, message: dict,
                         chunks: int = 0) -> None:

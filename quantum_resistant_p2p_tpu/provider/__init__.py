@@ -13,6 +13,7 @@ crypto/symmetric.py:19-63) and adds what the reference could not have:
 """
 
 from .base import (
+    BatchedAEADOps,
     CryptoAlgorithm,
     FusedHandshakeOps,
     KeyExchangeAlgorithm,
@@ -20,10 +21,12 @@ from .base import (
     SymmetricAlgorithm,
 )
 from .registry import (
+    get_batched_aead,
     get_fused,
     get_kem,
     get_signature,
     get_symmetric,
+    list_batched_aeads,
     list_fused,
     list_kems,
     list_signatures,
@@ -31,15 +34,18 @@ from .registry import (
 )
 
 __all__ = [
+    "BatchedAEADOps",
     "CryptoAlgorithm",
     "FusedHandshakeOps",
     "KeyExchangeAlgorithm",
     "SignatureAlgorithm",
     "SymmetricAlgorithm",
+    "get_batched_aead",
     "get_fused",
     "get_kem",
     "get_signature",
     "get_symmetric",
+    "list_batched_aeads",
     "list_fused",
     "list_kems",
     "list_signatures",

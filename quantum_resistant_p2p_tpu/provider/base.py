@@ -357,7 +357,14 @@ class FusedHandshakeOps(abc.ABC):
 
 
 class SymmetricAlgorithm(CryptoAlgorithm):
-    """AEAD interface (host-side; transport encryption stays on CPU)."""
+    """AEAD interface (scalar; the per-message CPU path).
+
+    The batched device path is a SEPARATE optional capability
+    (:class:`BatchedAEADOps`, discovered via
+    ``provider.registry.get_batched_aead``) — the scalar ops here stay the
+    universal fallback and the wire-format authority: 12-byte nonce
+    prepended to ``ciphertext || tag``.
+    """
 
     key_size: int = 32
     nonce_size: int = 12
@@ -369,6 +376,64 @@ class SymmetricAlgorithm(CryptoAlgorithm):
     @abc.abstractmethod
     def decrypt(self, key: bytes, data: bytes, associated_data: bytes | None = None) -> bytes:
         """-> plaintext; raises ValueError on authentication failure"""
+
+    def seal(self, key: bytes, nonce: bytes, plaintext: bytes,
+             associated_data: bytes | None = None) -> bytes:
+        """Deterministic-nonce seal: -> ``ciphertext || tag`` (no nonce
+        prefix).  The primitive both the batched facade's cpu fallback and
+        the device cross-check tests need; ``encrypt`` is ``urandom nonce +
+        seal``.  Default raises — concrete AEADs override."""
+        raise NotImplementedError(f"{self.name} has no deterministic seal")
+
+    def open_(self, key: bytes, nonce: bytes, data: bytes,
+              associated_data: bytes | None = None) -> bytes:
+        """Deterministic-nonce open of ``ciphertext || tag``; ValueError on
+        authentication failure.  Default raises — concrete AEADs override."""
+        raise NotImplementedError(f"{self.name} has no deterministic open")
+
+
+class BatchedAEADOps(abc.ABC):
+    """Optional capability: batched device seal/open for one AEAD.
+
+    Discovered through ``provider.registry.get_batched_aead(symmetric)`` —
+    ``None`` (capability absent: unregistered AEAD, jax unavailable, or
+    ``QRP2P_BATCH_AEAD=0``) keeps every caller on the scalar
+    :class:`SymmetricAlgorithm` path; the wire format is identical either
+    way (the facade prepends the same random 12-byte nonce the scalar
+    ``encrypt`` does).
+
+    Array conventions: keys/nonces are ``(n, key_size)`` / ``(n,
+    nonce_size)`` uint8 rows; messages and AADs are ragged lists of
+    bytes-like objects (``memoryview`` welcome — the binary wire path hands
+    socket-buffer views straight through).  Implementations pad to pow2
+    length buckets with masked tails, so one flush costs one device
+    program per (batch, length, aad) bucket triple.  Per-item
+    authentication failures are reported as ``ValueError`` INSTANCES in
+    the result list (the provider/batched.py per-item failure convention),
+    never raised — one tampered ciphertext must not poison its batch
+    mates.
+    """
+
+    name: str = ""
+    backend: str = "tpu"
+    key_size: int = 32
+    nonce_size: int = 12
+    tag_size: int = 16
+    #: longest message / AAD the device bucket space serves; callers route
+    #: longer items to the scalar path (bounded compile count + memory)
+    max_len: int = 1 << 20
+    max_aad_len: int = 1 << 16
+
+    @abc.abstractmethod
+    def seal_batch(self, keys: np.ndarray, nonces: np.ndarray,
+                   plaintexts: list, aads: list) -> list[bytes]:
+        """-> per-item ``ciphertext || tag``."""
+
+    @abc.abstractmethod
+    def open_batch(self, keys: np.ndarray, nonces: np.ndarray,
+                   data: list, aads: list) -> list:
+        """``data`` items are ``ciphertext || tag``; -> per-item plaintext
+        bytes, or a ``ValueError`` instance where authentication failed."""
 
 
 def _stack_bytes(items) -> np.ndarray:

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -477,6 +478,7 @@ class OpQueue:
         label: str = "",
         scheduler=None,
         lane_capacity: dict[int, int] | None = None,
+        warm_check: Callable[[list[Any], int], bool] | None = None,
     ):
         #: queue name at the fault-injection boundary (faults/) and in logs
         self.label = label
@@ -527,6 +529,16 @@ class OpQueue:
         self._warm_lock = threading.Lock()
         self._warm_buckets: set[int] = set()
         self._warming: set[int] = set()
+        #: optional SECOND warm axis: ``warm_check(items, bucket) -> bool``
+        #: refines the pow2-batch-bucket tracking for ops whose compiled
+        #: program also keys on per-item shape (the AEAD queues' message/
+        #: aad length buckets).  A flush whose batch bucket is warm but
+        #: whose shapes are novel is served from the fallback while the
+        #: background warm compiles EXACTLY the live shapes (_warm_call
+        #: runs the real batch fn on the real items) — a novel length
+        #: bucket must degrade gracefully, never compile inside a live
+        #: device dispatch and trip the breaker as "slow".
+        self.warm_check = warm_check
         self.stats = QueueStats()
         #: per-lane pending-depth bounds (lane tag -> max pending); an op
         #: submitted to a full lane is SHED (LaneShed, loud) instead of
@@ -866,6 +878,8 @@ class OpQueue:
         scale = max(1.0, bucket / self.degrade_ref_batch)
         with self._warm_lock:
             is_warm = bucket in self._warm_buckets
+            if is_warm and self.warm_check is not None:
+                is_warm = self.warm_check(items, bucket)
             start_warm = not is_warm and bucket not in self._warming
             if start_warm:
                 self._warming.add(bucket)
@@ -1097,7 +1111,7 @@ def facade_queues(facade):
     attach loops iterate (the autotuner's ``attach_facades`` and the cost
     ledger's ``_attach_cost``): a queue added to a facade joins every
     observer by appearing here, instead of in N copied attribute lists."""
-    for attr in ("_kg", "_enc", "_dec", "_sign", "_verify"):
+    for attr in ("_kg", "_enc", "_dec", "_sign", "_verify", "_seal", "_open"):
         q = getattr(facade, attr, None)
         if q is not None:
             yield q
@@ -1114,6 +1128,241 @@ def _timed_warm(facade, n: int, shard_idx: int | None) -> None:
         facade.cost.compile_event(
             facade.name, max(facade.bucket_floor, _next_pow2(n)),
             time.perf_counter() - t0, where="warmup", shard=shard_idx)
+
+
+class BatchedAEAD:
+    """Async facade over a ``BatchedAEADOps`` capability: the DATA plane.
+
+    Bulk AEAD seal/open ops from every live session coalesce on the SAME
+    OpQueue → scheduler → autotuner → breaker machinery as the KEM/
+    signature facades — by default on :data:`LANE_BULK`, so a bulk flood
+    defers bulk, never the rekey/handshake lanes sharing the queue window.
+
+    Wire-format parity with the scalar path is structural: ``encrypt``
+    prepends the same random 12-byte nonce ``SymmetricAlgorithm.encrypt``
+    does, and the device seal/open is KAT-pinned bit-exact against the
+    scalar twin at every length bucket (tests/test_chacha_pallas.py) — a
+    peer cannot tell which path sealed a frame.
+
+    ``scalar`` (the same-name scalar provider — OpenSSL wheel, or the
+    pyref twin on wheel-less images) arms the degrade-don't-fail fallback:
+    a slow/hung/raising device trips the shared breaker and messages are
+    sealed on the cpu instead of failing.  Items longer than the device's
+    bucket caps never enqueue at all — they run on the scalar path in an
+    executor (one oversized file send must not compile a giant one-off
+    device program or stall the loop).
+
+    Zero-copy: plaintext/ciphertext operands may be ``memoryview``s (the
+    binary wire path hands socket-buffer views straight through);
+    ``np.frombuffer`` packs them into the device batch without an
+    intermediate copy.
+    """
+
+    def __init__(self, device, scalar, max_batch: int = 4096,
+                 max_wait_ms: float = 2.0,
+                 breaker: Breaker | None = None,
+                 cooloff_s: float | None = None,
+                 bucket_floor: int = 1,
+                 scheduler=None,
+                 lane_capacity: dict[int, int] | None = None,
+                 warm_shapes: tuple = ((256, 256), (1024, 256)),
+                 **degrade_opts):
+        self.device = device
+        self.scalar = scalar
+        #: the cpu-fallback handle the health gate checks (health.py)
+        self.fallback = scalar
+        self.name = device.name
+        self.key_size = device.key_size
+        self.nonce_size = device.nonce_size
+        self.tag_size = device.tag_size
+        self.bucket_floor = min(_next_pow2(max(1, bucket_floor)), max_batch)
+        self.scheduler = scheduler
+        #: cost ledger (obs/cost.py): warmup compile attribution
+        self.cost = None
+        #: (msg_len, aad_len) bucket pairs the background warmup compiles;
+        #: storm/bench callers override to match their live payload shape
+        self.warm_shapes = tuple(warm_shapes)
+        self.breaker = _facade_breaker(breaker, cooloff_s, scheduler)
+        self._seal, self._open = (
+            OpQueue(batch_fn, max_batch, max_wait_ms, fallback_fn=fb,
+                    breaker=None if scheduler is not None else self.breaker,
+                    bucket_floor=self.bucket_floor, scheduler=scheduler,
+                    lane_capacity=lane_capacity, warm_check=warm,
+                    label=f"{device.name}.{op}", **degrade_opts)
+            for batch_fn, fb, op, warm in (
+                (self._seal_batch, self._seal_fallback, "seal",
+                 self._seal_covered),
+                (self._open_batch, self._open_fallback, "open",
+                 self._open_covered),
+            )
+        )
+
+    # -- validity (attacker-malformed operands fail alone, never the batch) --
+
+    def _seal_valid(self, it) -> bool:
+        key, nonce, pt, aad = it
+        return (len(key) == self.key_size
+                and len(nonce) == self.nonce_size
+                and len(pt) <= self.device.max_len
+                and len(aad) <= self.device.max_aad_len)
+
+    def _open_valid(self, it) -> bool:
+        key, nonce, data, aad = it
+        return (len(key) == self.key_size
+                and len(nonce) == self.nonce_size
+                and self.tag_size <= len(data)
+                and len(data) - self.tag_size <= self.device.max_len
+                and len(aad) <= self.device.max_aad_len)
+
+    # -- shape-aware warm checks (the OpQueue's second warm axis) ------------
+
+    def _seal_covered(self, items, bucket: int) -> bool:
+        valid = [it for it in items if self._seal_valid(it)]
+        if not valid:
+            return True
+        return self.device.covers(True, bucket,
+                                  max(len(it[2]) for it in valid),
+                                  max(len(it[3]) for it in valid))
+
+    def _open_covered(self, items, bucket: int) -> bool:
+        valid = [it for it in items if self._open_valid(it)]
+        if not valid:
+            return True
+        return self.device.covers(False, bucket,
+                                  max(len(it[2]) - self.tag_size
+                                      for it in valid),
+                                  max(len(it[3]) for it in valid))
+
+    # -- batch fns -----------------------------------------------------------
+
+    @staticmethod
+    def _rows(valid, idx, tgt):
+        return _pad_rows(
+            np.stack([np.frombuffer(it[idx], np.uint8) for it in valid]), tgt)
+
+    def _seal_batch(self, items):
+        def dispatch(valid, tgt):
+            pad = tgt - len(valid)
+            out = self.device.seal_batch(
+                self._rows(valid, 0, tgt), self._rows(valid, 1, tgt),
+                [it[2] for it in valid] + [valid[-1][2]] * pad,
+                [it[3] for it in valid] + [valid[-1][3]] * pad,
+            )
+            return out
+
+        return _run_valid(items, self._seal_valid, dispatch,
+                          lambda: ValueError("bad AEAD seal operand"),
+                          self.bucket_floor)
+
+    def _open_batch(self, items):
+        def dispatch(valid, tgt):
+            pad = tgt - len(valid)
+            return self.device.open_batch(
+                self._rows(valid, 0, tgt), self._rows(valid, 1, tgt),
+                [it[2] for it in valid] + [valid[-1][2]] * pad,
+                [it[3] for it in valid] + [valid[-1][3]] * pad,
+            )
+
+        # the open contract maps EVERY malformed input to the same typed
+        # failure the scalar decrypt raises — never a distinguishable crash
+        return _run_valid(items, self._open_valid, dispatch,
+                          lambda: ValueError("authentication failed"),
+                          self.bucket_floor)
+
+    # -- cpu scalar fallbacks (wire-identical) -------------------------------
+
+    def _seal_fallback(self, items):
+        def dispatch(valid, _tgt):
+            return [self.scalar.seal(k, n, bytes(p), bytes(a) or None)
+                    for k, n, p, a in valid]
+
+        return _run_valid(items, self._seal_valid, dispatch,
+                          lambda: ValueError("bad AEAD seal operand"), 1)
+
+    def _open_fallback(self, items):
+        def dispatch(valid, _tgt):
+            out = []
+            for k, n, d, a in valid:
+                try:
+                    out.append(self.scalar.open_(k, n, bytes(d),
+                                                 bytes(a) or None))
+                except ValueError as e:
+                    out.append(ValueError(str(e)))
+            return out
+
+        return _run_valid(items, self._open_valid, dispatch,
+                          lambda: ValueError("authentication failed"), 1)
+
+    # -- async surface (scalar-compatible byte layouts) ----------------------
+
+    async def encrypt(self, key: bytes, plaintext, associated_data=None,
+                      lane: int = LANE_BULK) -> bytes:
+        """-> ``nonce || ciphertext || tag`` — byte-compatible with the
+        scalar ``SymmetricAlgorithm.encrypt``."""
+        ad = bytes(associated_data) if associated_data else b""
+        if (len(plaintext) > self.device.max_len
+                or len(ad) > self.device.max_aad_len):
+            # oversized for the device bucket space: scalar path, off-loop
+            # (a wheel-less pure-Python seal of a big file must not stall
+            # every peer this loop serves)
+            return await asyncio.get_running_loop().run_in_executor(
+                None, functools.partial(
+                    self.scalar.encrypt, bytes(key), bytes(plaintext),
+                    ad or None))
+        nonce = os.urandom(self.nonce_size)
+        ct_tag = await self._seal.submit((bytes(key), nonce, plaintext, ad),
+                                         lane)
+        return nonce + ct_tag
+
+    async def decrypt(self, key: bytes, data, associated_data=None,
+                      lane: int = LANE_BULK) -> bytes:
+        """Open ``nonce || ciphertext || tag``; ValueError on failure —
+        the scalar decrypt contract.  ``data`` may be a memoryview (the
+        binary wire's zero-copy socket-buffer slice)."""
+        ad = bytes(associated_data) if associated_data else b""
+        if len(data) < self.nonce_size + self.tag_size:
+            raise ValueError("ciphertext too short")
+        if (len(data) - self.nonce_size - self.tag_size > self.device.max_len
+                or len(ad) > self.device.max_aad_len):
+            return await asyncio.get_running_loop().run_in_executor(
+                None, functools.partial(
+                    self.scalar.decrypt, bytes(key), bytes(data), ad or None))
+        view = memoryview(data)
+        return await self._open.submit(
+            (bytes(key), bytes(view[: self.nonce_size]),
+             view[self.nonce_size:], ad), lane)
+
+    # -- warmup --------------------------------------------------------------
+
+    def warmup(self, sizes: tuple[int, ...] = (1,)) -> None:
+        """Compile seal/open for the pow2 batch buckets at every
+        ``warm_shapes`` (msg, aad) bucket pair, then mark the buckets warm
+        (blocking; run on the warmup thread).  Under a scheduler every
+        size compiles on every shard first (see BatchedKEM.warmup)."""
+        for shard_idx, placement in _shard_placements(self.scheduler):
+            with placement:
+                for n in sizes:
+                    _timed_warm(self, n, shard_idx)
+        for n in sizes:
+            n2 = max(self.bucket_floor, _next_pow2(n))
+            for q in (self._seal, self._open):
+                q.mark_warm(n2)  # runs on the warmup thread: locked handoff
+
+    def _warm_one(self, n: int) -> None:
+        n2 = max(self.bucket_floor, _next_pow2(n))
+        keys = np.zeros((n2, self.key_size), np.uint8)
+        nonces = np.zeros((n2, self.nonce_size), np.uint8)
+        for msg_len, aad_len in self.warm_shapes:
+            pts = [bytes(msg_len)] * n2
+            aads = [bytes(aad_len)] * n2
+            sealed = self.device.seal_batch(keys, nonces, pts, aads)
+            self.device.open_batch(keys, nonces, sealed, aads)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "seal": self._seal.stats.as_dict(),
+            "open": self._open.stats.as_dict(),
+        }
 
 
 class BatchedKEM:

@@ -262,6 +262,52 @@ def _check_fused(facade) -> HealthVerdict:
                          "fused keygen_sign render/sign/keypair ok vs cpu")
 
 
+#: pinned RFC 8439 §2.8.2 AEAD vector: the device seal must reproduce the
+#: spec ciphertext+tag byte-for-byte before the batched data plane is
+#: trusted with live traffic
+_CHACHA_KAT = {
+    "key": bytes(range(0x80, 0xA0)),
+    "nonce": bytes([0x07, 0, 0, 0]) + bytes(range(0x40, 0x48)),
+    "aad": bytes.fromhex("50515253c0c1c2c3c4c5c6c7"),
+    "pt": (b"Ladies and Gentlemen of the class of '99: If I could offer "
+           b"you only one tip for the future, sunscreen would be it."),
+    "ct_tag_sha256":
+        "4e54427e462f3beb69677d39865c5da8d57f603a85f7bf71368dce8ec9b9933c",
+}
+
+
+def _check_aead(facade) -> HealthVerdict:
+    """Validate a batched AEAD facade's device path: the pinned RFC 8439
+    §2.8.2 vector through the device seal, tamper rejection on open, and
+    cross-implementation agreement with the scalar twin (device-sealed
+    frames must open on the independent scalar path and vice versa)."""
+    import numpy as np
+
+    name = f"aead:{facade.name}"
+    kat = _CHACHA_KAT
+    dev, scalar = facade.device, facade.scalar
+    keys = np.frombuffer(kat["key"], np.uint8)[None]
+    nonces = np.frombuffer(kat["nonce"], np.uint8)[None]
+    sealed = dev.seal_batch(keys, nonces, [kat["pt"]], [kat["aad"]])[0]
+    if hashlib.sha256(sealed).hexdigest() != kat["ct_tag_sha256"]:
+        return HealthVerdict(name, False, "RFC 8439 §2.8.2 KAT mismatch")
+    got = dev.open_batch(keys, nonces, [sealed], [kat["aad"]])[0]
+    if not isinstance(got, bytes) or got != kat["pt"]:
+        return HealthVerdict(name, False, "device open rejects device seal")
+    bad = bytes([sealed[0] ^ 0xFF]) + sealed[1:]
+    if not isinstance(dev.open_batch(keys, nonces, [bad],
+                                     [kat["aad"]])[0], ValueError):
+        return HealthVerdict(name, False,
+                             "device open accepts tampered ciphertext")
+    if scalar is not None:
+        if scalar.open_(kat["key"], kat["nonce"], sealed,
+                        kat["aad"]) != kat["pt"]:
+            return HealthVerdict(
+                name, False, "scalar twin rejects device seal")
+    agree = " + scalar agreement" if scalar is not None else ""
+    return HealthVerdict(name, True, f"RFC 8439 KAT + tamper-reject ok{agree}")
+
+
 def _probe(algo, cpu_twin) -> HealthVerdict:
     name = getattr(algo, "name", type(algo).__name__)
     if name.startswith("HQC"):
@@ -327,6 +373,8 @@ def gate_facades(*facades) -> list[HealthVerdict]:
             continue
         if hasattr(facade, "fused"):
             verdict = _ensure_fused_validated(facade)
+        elif hasattr(facade, "device"):  # BatchedAEAD (data plane)
+            verdict = _ensure_aead_validated(facade)
         else:
             verdict = ensure_validated(facade.algo,
                                        getattr(facade, "fallback", None))
@@ -361,6 +409,25 @@ def gate_facades(*facades) -> list[HealthVerdict]:
             else:
                 facade.breaker.quarantine(why)
     return out
+
+
+def _ensure_aead_validated(facade) -> HealthVerdict:
+    """Cached wrapper around :func:`_check_aead` (same verdict policy as
+    ensure_validated: positives cached per environment, failures
+    re-probed)."""
+    family = f"aead:{facade.name}"
+    fingerprint = env_fingerprint()
+    cached = _read_cached(family, fingerprint)
+    if cached is not None:
+        return cached
+    try:
+        verdict = _check_aead(facade)
+    except Exception as e:
+        logger.exception("device-health probe for %s crashed", family)
+        verdict = HealthVerdict(family, False, f"probe crashed: {e!r}")
+    verdict.family = family
+    _write_cached(family, fingerprint, verdict)
+    return verdict
 
 
 def _ensure_fused_validated(facade) -> HealthVerdict:

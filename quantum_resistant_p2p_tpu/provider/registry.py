@@ -22,8 +22,8 @@ from __future__ import annotations
 
 from typing import Callable
 
-from .base import (FusedHandshakeOps, KeyExchangeAlgorithm, SignatureAlgorithm,
-                   SymmetricAlgorithm)
+from .base import (BatchedAEADOps, FusedHandshakeOps, KeyExchangeAlgorithm,
+                   SignatureAlgorithm, SymmetricAlgorithm)
 from .symmetric import AES256GCM, ChaCha20Poly1305
 
 # name -> (factory(backend, devices) -> algorithm, supported_backends)
@@ -35,6 +35,8 @@ _AEADS: dict[str, Callable[[], SymmetricAlgorithm]] = {
 }
 # (kem name, sig name) -> factory(kem, sig) -> FusedHandshakeOps
 _FUSED: dict[tuple[str, str], Callable] = {}
+# AEAD name -> factory() -> BatchedAEADOps (the batched device capability)
+_BATCHED_AEADS: dict[str, Callable[[], BatchedAEADOps]] = {}
 
 
 def register_kem(name: str, factory, backends: tuple[str, ...]) -> None:
@@ -96,6 +98,40 @@ def get_symmetric(name: str) -> SymmetricAlgorithm:
     if name not in _AEADS:
         raise KeyError(f"unknown AEAD {name!r}; known: {sorted(_AEADS)}")
     return _AEADS[name]()
+
+
+def register_batched_aead(name: str, factory: Callable[[], BatchedAEADOps]) -> None:
+    """Register the batched device capability for one AEAD name.  The
+    factory runs lazily inside :func:`get_batched_aead` so registering
+    never imports jax (cpu-only and wheel-less callers pay nothing)."""
+    _BATCHED_AEADS[name] = factory
+
+
+def get_batched_aead(symmetric) -> BatchedAEADOps | None:
+    """Batched device AEAD capability for a symmetric algorithm (instance
+    or name), or ``None`` when absent — unregistered AEAD, jax
+    unavailable, or ``QRP2P_BATCH_AEAD=0`` (the kill switch that pins
+    every caller to the scalar path).  Never raises on lookup."""
+    import logging
+    import os
+
+    if os.environ.get("QRP2P_BATCH_AEAD", "1") == "0":
+        return None
+    name = getattr(symmetric, "name", symmetric)
+    factory = _BATCHED_AEADS.get(name)
+    if factory is None:
+        return None
+    try:
+        return factory()
+    except Exception:  # qrlint: disable=broad-except  — capability probe: any import/device failure means "no batched AEAD here", the scalar path serves
+        logging.getLogger(__name__).warning(
+            "batched AEAD capability for %s unavailable; scalar path serves",
+            name, exc_info=True)
+        return None
+
+
+def list_batched_aeads() -> list[str]:
+    return sorted(_BATCHED_AEADS)
 
 
 def list_kems() -> list[str]:
@@ -163,6 +199,17 @@ def _register_defaults() -> None:
                 ),
                 ("cpu", "tpu"),
             )
+    # Batched device AEAD capability (the data plane): ChaCha20-Poly1305
+    # maps onto the Pallas/jnp ARX core; AES-GCM stays scalar (no device
+    # kernel).  Deferred import: the factory touches jax only when a
+    # batching caller actually asks for the capability.
+    def _chacha_device():
+        from .aead_device import ChaChaPolyDevice
+
+        return ChaChaPolyDevice()
+
+    register_batched_aead("ChaCha20-Poly1305", _chacha_device)
+
     # Composite handshake capability: every ML-KEM x ML-DSA pair shares the
     # same fused program shapes (fused/mlkem_mldsa.py), parameterized by the
     # pair's parameter sets.

@@ -1,8 +1,16 @@
 """AEAD algorithms: AES-256-GCM and ChaCha20-Poly1305.
 
-Host-side (OpenSSL via the ``cryptography`` package), as in the reference
-(crypto/symmetric.py:66-258): transport encryption is latency-bound per
-message, so it stays on CPU; the TPU earns its keep on the batched PQC math.
+Scalar host-side path (OpenSSL via the ``cryptography`` package), as in the
+reference (crypto/symmetric.py:66-258).  Two additions over the reference:
+
+* deterministic-nonce ``seal``/``open_`` primitives (``encrypt`` is
+  ``urandom nonce + seal``) — the batched device AEAD's cpu fallback and
+  its cross-check tests need the nonce as an explicit operand;
+* a wheel-less pure-Python fallback for ChaCha20-Poly1305
+  (pyref/chacha_ref.py): minimal accelerator images without OpenSSL can
+  still run the full bulk path — slowly, which is exactly what the batched
+  device path (core/chacha_pallas.py, ``BatchedAEADOps``) exists to fix.
+  AES-256-GCM has no pure-Python twin and still requires the wheel.
 
 Wire format parity: 12-byte random nonce prepended to the ciphertext
 (crypto/symmetric.py:110-146); authentication failure raises ValueError
@@ -20,7 +28,8 @@ except ImportError:  # pragma: no cover - exercised only on minimal images
     # Gate, don't crash: the provider package (registry, batch queues, KEM/
     # signature providers) is fully usable without host AEAD — only actual
     # encrypt/decrypt needs OpenSSL.  Minimal accelerator images without
-    # the wheel can still run the PQC layers and their tests.
+    # the wheel can still run the PQC layers and their tests (and, via the
+    # pyref fallback below, the ChaCha20-Poly1305 bulk path).
     class InvalidTag(Exception):  # placeholder: never raised without OpenSSL
         pass
 
@@ -34,6 +43,7 @@ class _AEADBase(SymmetricAlgorithm):
 
     key_size = 32
     nonce_size = 12
+    tag_size = 16
 
     def generate_key(self) -> bytes:
         return os.urandom(self.key_size)
@@ -46,22 +56,40 @@ class _AEADBase(SymmetricAlgorithm):
             )
         return getattr(_aead, self._impl)
 
-    def encrypt(self, key: bytes, plaintext: bytes, associated_data: bytes | None = None) -> bytes:
+    def _check_key(self, key: bytes) -> None:
         if len(key) != self.key_size:
             raise ValueError(f"{self.name} requires a {self.key_size}-byte key")
-        nonce = os.urandom(self.nonce_size)
-        return nonce + self._cipher(key).encrypt(nonce, plaintext, associated_data)
 
-    def decrypt(self, key: bytes, data: bytes, associated_data: bytes | None = None) -> bytes:
-        if len(key) != self.key_size:
-            raise ValueError(f"{self.name} requires a {self.key_size}-byte key")
-        if len(data) < self.nonce_size + 16:
+    def seal(self, key: bytes, nonce: bytes, plaintext: bytes,
+             associated_data: bytes | None = None) -> bytes:
+        self._check_key(key)
+        if len(nonce) != self.nonce_size:
+            raise ValueError(f"{self.name} requires a {self.nonce_size}-byte nonce")
+        return self._cipher(key).encrypt(bytes(nonce), bytes(plaintext),
+                                         associated_data)
+
+    def open_(self, key: bytes, nonce: bytes, data: bytes,
+              associated_data: bytes | None = None) -> bytes:
+        self._check_key(key)
+        if len(data) < self.tag_size:
             raise ValueError("ciphertext too short")
-        nonce, ct = data[: self.nonce_size], data[self.nonce_size :]
         try:
-            return self._cipher(key).decrypt(nonce, ct, associated_data)
+            return self._cipher(key).decrypt(bytes(nonce), bytes(data),
+                                             associated_data)
         except InvalidTag as e:
             raise ValueError("authentication failed") from e
+
+    def encrypt(self, key: bytes, plaintext: bytes, associated_data: bytes | None = None) -> bytes:
+        nonce = os.urandom(self.nonce_size)
+        return nonce + self.seal(key, nonce, plaintext, associated_data)
+
+    def decrypt(self, key: bytes, data: bytes, associated_data: bytes | None = None) -> bytes:
+        self._check_key(key)
+        if len(data) < self.nonce_size + self.tag_size:
+            raise ValueError("ciphertext too short")
+        data = memoryview(data)  # zero-copy split (binary wire hands views)
+        return self.open_(key, bytes(data[: self.nonce_size]),
+                          data[self.nonce_size:], associated_data)
 
 
 class AES256GCM(_AEADBase):
@@ -80,3 +108,25 @@ class ChaCha20Poly1305(_AEADBase):
     description = "RFC 8439 ChaCha20-Poly1305 AEAD"
     security_level = 5
     backend = "cpu"
+
+    def seal(self, key: bytes, nonce: bytes, plaintext: bytes,
+             associated_data: bytes | None = None) -> bytes:
+        if _aead is not None:
+            return super().seal(key, nonce, plaintext, associated_data)
+        # wheel-less scalar twin (pyref/chacha_ref.py): bit-identical to
+        # OpenSSL, pure stdlib — the KAT oracle doubles as the fallback
+        from ..pyref import chacha_ref
+
+        self._check_key(key)
+        return chacha_ref.seal(bytes(key), bytes(nonce), bytes(plaintext),
+                               bytes(associated_data or b""))
+
+    def open_(self, key: bytes, nonce: bytes, data: bytes,
+              associated_data: bytes | None = None) -> bytes:
+        if _aead is not None:
+            return super().open_(key, nonce, data, associated_data)
+        from ..pyref import chacha_ref
+
+        self._check_key(key)
+        return chacha_ref.open_(bytes(key), bytes(nonce), bytes(data),
+                                bytes(associated_data or b""))

@@ -1,0 +1,211 @@
+"""BatchedAEAD facade: queue coalescing, degrade paths, lanes, e2e wiring.
+
+The data plane's facade (provider/batched.py ``BatchedAEAD``) must behave
+exactly like the scalar AEAD at the byte level while riding the OpQueue →
+scheduler → breaker machinery — and must degrade (never fail) when the
+device path is cold, slow, or raising.  Wheel-less friendly: the scalar
+twin is the pyref fallback.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.provider import get_batched_aead, get_symmetric
+from quantum_resistant_p2p_tpu.provider.batched import (LANE_BULK, BatchedAEAD,
+                                                        LaneShed)
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+def _facade(**kw):
+    device = get_batched_aead("ChaCha20-Poly1305")
+    assert device is not None
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("warm_shapes", ((64, 16),))
+    return BatchedAEAD(device, get_symmetric("ChaCha20-Poly1305"), **kw)
+
+
+def test_capability_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("QRP2P_BATCH_AEAD", "0")
+    assert get_batched_aead("ChaCha20-Poly1305") is None
+    monkeypatch.delenv("QRP2P_BATCH_AEAD")
+    assert get_batched_aead("ChaCha20-Poly1305") is not None
+    # AES-GCM has no device kernel: capability absent, scalar path serves
+    assert get_batched_aead("AES-256-GCM") is None
+
+
+def test_facade_roundtrip_and_scalar_parity(run):
+    f = _facade()
+    scalar = f.scalar
+    key = os.urandom(32)
+
+    async def main():
+        pts = [os.urandom(n) for n in (0, 1, 17, 48)]
+        ads = [b"", b"ad", b"", b"x" * 12]
+        outs = await asyncio.gather(
+            *(f.encrypt(key, p, a) for p, a in zip(pts, ads)))
+        for p, a, o in zip(pts, ads, outs):
+            # the scalar twin opens facade output, and vice versa
+            assert scalar.decrypt(key, o, a or None) == p
+            assert await f.decrypt(key, o, a) == p
+        assert await f.decrypt(key, scalar.encrypt(key, b"x", b"a"),
+                               b"a") == b"x"
+        # memoryview input (the binary wire's zero-copy slice)
+        assert await f.decrypt(key, memoryview(outs[2]), ads[2]) == pts[2]
+
+    run(main())
+
+
+def test_warm_buckets_serve_from_device(run):
+    f = _facade()
+    key = os.urandom(32)
+
+    async def main():
+        # warmup compiles batch bucket 1 at the (64, 16) shape and marks
+        # it; warm-shape traffic then rides the device path (sequential
+        # sends -> size-1 flushes)
+        await asyncio.get_running_loop().run_in_executor(None, f.warmup, (1,))
+        for _ in range(4):
+            out = await f.encrypt(key, b"warm-shape msg", b"ad")
+            assert await f.decrypt(key, out, b"ad") == b"warm-shape msg"
+        stats = f.stats()
+        assert stats["seal"]["ops"] >= 4
+        assert stats["seal"]["fallback_ops"] < stats["seal"]["ops"], (
+            "warm traffic still served from the cpu fallback")
+
+    run(main())
+
+
+def test_cold_length_bucket_degrades_not_trips(run):
+    """A novel (msg, aad) length bucket on a warm batch bucket must serve
+    from the fallback while the background warm compiles the live shape —
+    never jit inside a live dispatch and trip the breaker as 'slow'."""
+    f = _facade()
+    key = os.urandom(32)
+
+    async def main():
+        await asyncio.get_running_loop().run_in_executor(None, f.warmup, (1,))
+        big = os.urandom(3000)  # novel L bucket (4096)
+        out = await f.encrypt(key, big, b"ad")
+        assert await f.decrypt(key, out, b"ad") == big
+        assert f.breaker.state == "closed"
+        # the shape warms in the background; poll until the device covers
+        # it, then traffic moves off the fallback
+        for _ in range(200):
+            if f.device.covers(True, 1, len(big), 2):
+                break
+            await asyncio.sleep(0.1)
+        assert f.device.covers(True, 1, len(big), 2)
+        fb0 = f.stats()["seal"]["fallback_ops"]
+        out2 = await f.encrypt(key, os.urandom(3000), b"ad")
+        assert len(out2) == 12 + 3000 + 16
+        assert f.stats()["seal"]["fallback_ops"] == fb0
+
+    run(main())
+
+
+def test_tampered_item_fails_alone(run):
+    f = _facade()
+    key = os.urandom(32)
+
+    async def main():
+        good = await f.encrypt(key, b"good", b"")
+        bad = bytearray(await f.encrypt(key, b"evil", b""))
+        bad[20] ^= 0xFF
+        results = await asyncio.gather(
+            f.decrypt(key, bytes(bad)), f.decrypt(key, good),
+            return_exceptions=True)
+        assert isinstance(results[0], ValueError)
+        assert results[1] == b"good"
+
+    run(main())
+
+
+def test_oversized_items_take_scalar_path(run):
+    f = _facade()
+    key = os.urandom(32)
+
+    async def main():
+        big = os.urandom(f.device.max_len + 1)
+        out = await f.encrypt(key, big, b"ad")
+        assert f.scalar.decrypt(key, out, b"ad") == big
+        assert await f.decrypt(key, out, b"ad") == big
+        # never touched the queues
+        assert f.stats()["seal"]["ops"] == 0
+
+    run(main())
+
+
+def test_bulk_lane_capacity_sheds_loudly(run):
+    f = _facade(lane_capacity={LANE_BULK: 2}, max_wait_ms=50.0)
+    key = os.urandom(32)
+
+    async def main():
+        sends = [asyncio.create_task(f.encrypt(key, b"m%d" % i))
+                 for i in range(6)]
+        results = await asyncio.gather(*sends, return_exceptions=True)
+        sheds = [r for r in results if isinstance(r, LaneShed)]
+        ok = [r for r in results if isinstance(r, bytes)]
+        assert sheds and ok
+        assert f.stats()["seal"]["lane_sheds"].get("bulk", 0) == len(sheds)
+
+    run(main())
+
+
+def test_breaker_open_serves_fallback(run):
+    f = _facade()
+    key = os.urandom(32)
+
+    async def main():
+        f.breaker.trip()
+        assert f.breaker.is_open()
+        out = await f.encrypt(key, b"degraded", b"")
+        assert await f.decrypt(key, out) == b"degraded"
+        stats = f.stats()
+        assert stats["seal"]["fallback_ops"] >= 1
+        assert stats["open"]["fallback_ops"] >= 1
+
+    run(main())
+
+
+def test_facade_queues_include_aead(run):
+    from quantum_resistant_p2p_tpu.provider.batched import facade_queues
+
+    f = _facade()
+    labels = {q.label for q in facade_queues(f)}
+    assert labels == {"ChaCha20-Poly1305.seal", "ChaCha20-Poly1305.open"}
+
+
+def test_aead_dispatch_is_a_fault_boundary(run):
+    """A chaos plan can target the AEAD device dispatch by op label — the
+    fault raises at the boundary and the breaker/fallback machinery serves
+    the op anyway (degrade, not fail)."""
+    from quantum_resistant_p2p_tpu.faults import FaultPlan, FaultRule
+
+    f = _facade()
+    key = os.urandom(32)
+
+    async def main():
+        await asyncio.get_running_loop().run_in_executor(None, f.warmup, (1,))
+        plan = FaultPlan(seed=3, rules=[
+            FaultRule("device.dispatch", "raise",
+                      match={"op": "ChaCha20-Poly1305.seal"}, nth=1),
+        ])
+        with plan.activate():
+            out = await f.encrypt(key, b"chaos msg", b"ad")
+        assert await f.decrypt(key, out, b"ad") == b"chaos msg"
+        assert any(e["op"] == "ChaCha20-Poly1305.seal" for e in plan.injected)
+        assert f.stats()["seal"]["fallback_ops"] >= 1
+
+    run(main())

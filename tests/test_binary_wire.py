@@ -1,0 +1,380 @@
+"""Binary wire format: negotiation, compat pinning, hostile-input hardening.
+
+The tentpole's transport half (net/p2p_node.py): a per-connection
+negotiated length-prefixed binary framing with zero-copy ciphertext
+pass-through.  Pins the two compatibility contracts:
+
+* ``QRP2P_BINARY_WIRE=0`` and un-negotiated peers produce BYTE-IDENTICAL
+  JSON frames (golden-bytes test);
+* hostile binary input — oversized lengths, truncated headers, a wrong
+  negotiation token, corrupt ciphertext mid-chunk — fails typed-and-loud
+  (``WireError`` + counter + flight event) without killing the serving
+  loop, mirroring PR 10's wire-context hardening.
+
+Wheel-less friendly: the messaging-level tests ride the storm toy
+providers + the pyref-backed ChaCha20-Poly1305.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from quantum_resistant_p2p_tpu.net.p2p_node import (_BIN_TOKEN, _CHUNK_HEADER,
+                                                    _FLAG_BIN, _FLAG_CHUNK,
+                                                    _HEADER, _MAGIC, _VERSION,
+                                                    P2PNode, WireError,
+                                                    _decode_bin, _encode_bin)
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+async def _pair(a_bin=True, b_bin=True):
+    a = P2PNode(node_id="node-a", host="127.0.0.1", port=0, binary_wire=a_bin)
+    b = P2PNode(node_id="node-b", host="127.0.0.1", port=0, binary_wire=b_bin)
+    await a.start()
+    await b.start()
+    assert await a.connect_to_peer("127.0.0.1", b.port) == "node-b"
+    for _ in range(100):
+        if b.is_connected("node-a"):
+            break
+        await asyncio.sleep(0.01)
+    return a, b
+
+
+# -- negotiation --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("a_bin,b_bin,expect", [
+    (True, True, "bin1"),
+    (True, False, "json"),
+    (False, True, "json"),
+    (False, False, "json"),
+], ids=["both", "only-dialer", "only-listener", "neither"])
+def test_wire_negotiation_requires_both_sides(run, a_bin, b_bin, expect):
+    async def main():
+        a, b = await _pair(a_bin, b_bin)
+        assert a.peer_wire_format("node-b") == expect
+        assert b.peer_wire_format("node-a") == expect
+        # traffic flows in the negotiated format either way
+        got = asyncio.Event()
+        seen = {}
+
+        async def on_ping(peer_id, msg):
+            seen.update(msg)
+            got.set()
+
+        b.register_message_handler("ping", on_ping)
+        assert await a.send_message("node-b", "ping", blob=b"\x01\x02", n=7)
+        await asyncio.wait_for(got.wait(), 5)
+        assert bytes(seen["blob"]) == b"\x01\x02" and seen["n"] == 7
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_env_flag_and_hello_compat(run, monkeypatch):
+    """QRP2P_BINARY_WIRE=0 keeps the hello payload EXACTLY the historical
+    dict (no ``wire`` key) — un-upgraded peers see nothing new."""
+    monkeypatch.setenv("QRP2P_BINARY_WIRE", "0")
+    node = P2PNode(node_id="n", host="127.0.0.1", port=4242)
+    assert node.binary_wire is False
+    assert node._hello() == {"type": "__hello__", "node_id": "n",
+                             "listen_port": 4242}
+    monkeypatch.delenv("QRP2P_BINARY_WIRE")
+    node2 = P2PNode(node_id="n", host="127.0.0.1", port=4242)
+    assert node2.binary_wire is True
+    assert node2._hello()["wire"] == ["bin1"]
+
+
+def test_json_frames_byte_identical_when_disabled(run, monkeypatch):
+    """Golden-bytes pin: with the binary wire disabled, a sent message hits
+    the socket as EXACTLY the historical JSON frame."""
+    monkeypatch.setenv("QRP2P_TRACE_PROPAGATE", "0")  # ids vary run-to-run
+
+    async def main():
+        a, b = await _pair(a_bin=False, b_bin=False)
+        peer = a._peers["node-b"]
+        captured = bytearray()
+        orig_write = peer.writer.write
+
+        def spy(data):
+            captured.extend(bytes(data))
+            return orig_write(data)
+
+        peer.writer.write = spy
+        assert await a.send_message("node-b", "ping", n=1, blob=b"\x00\xff")
+        body = json.dumps({"type": "ping", "n": 1,
+                           "blob": {"__b64__": "AP8="}},
+                          separators=(",", ":")).encode()
+        golden = _HEADER.pack(_MAGIC, _VERSION, 0, len(body)) + body
+        assert bytes(captured) == golden
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+# -- encoding unit coverage ---------------------------------------------------
+
+
+def test_bin_codec_roundtrip_zero_copy():
+    msg = {"type": "secure_message", "ct": b"\x00" * 40, "ad": b"{}",
+           "_trace": {"trace_id": "t", "span_id": "s"}, "n": 3}
+    body = b"".join(_encode_bin(msg))
+    out = _decode_bin(body)
+    assert out["type"] == "secure_message"
+    # raw fields come back as zero-copy memoryviews into the frame buffer
+    assert isinstance(out["ct"], memoryview)
+    assert bytes(out["ct"]) == msg["ct"]
+    assert out["_trace"] == msg["_trace"] and out["n"] == 3
+
+
+@pytest.mark.parametrize("mutate,why", [
+    (lambda b: b"XX" + b[2:], "bad token"),
+    (lambda b: b[:5], "truncated mid-type"),
+    (lambda b: b + b"garbage", "trailing bytes"),
+    (lambda b: b[:-10], "truncated value"),
+], ids=["token", "truncated", "trailing", "short-value"])
+def test_bin_codec_hostile_inputs_are_typed(mutate, why):
+    body = b"".join(_encode_bin({"type": "ping", "ct": b"x" * 32}))
+    with pytest.raises(WireError):
+        _decode_bin(mutate(bytes(body)))
+
+
+def test_bin_codec_oversized_declared_length():
+    # header declares a 1 GiB field the frame does not carry
+    evil = (_BIN_TOKEN + bytes([4]) + b"ping" + bytes([1])
+            + bytes([2]) + b"ct" + bytes([0])
+            + (1 << 30).to_bytes(4, "big") + b"tiny")
+    with pytest.raises(WireError):
+        _decode_bin(evil)
+
+
+# -- hostile frames against a live node --------------------------------------
+
+
+async def _raw_hello(port: int, offer_bin: bool):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    hello = {"type": "__hello__", "node_id": "evil", "listen_port": 1}
+    if offer_bin:
+        hello["wire"] = ["bin1"]
+    body = json.dumps(hello).encode()
+    writer.write(_HEADER.pack(_MAGIC, _VERSION, 0, len(body)) + body)
+    await writer.drain()
+    # consume the hello reply
+    hdr = await reader.readexactly(_HEADER.size)
+    _, _, _, length = _HEADER.unpack(hdr)
+    await reader.readexactly(length)
+    return reader, writer
+
+
+@pytest.mark.parametrize("frame", [
+    # oversized length in the frame header
+    _HEADER.pack(_MAGIC, _VERSION, _FLAG_BIN, 17 * 1024 * 1024),
+    # wrong negotiation token
+    _HEADER.pack(_MAGIC, _VERSION, _FLAG_BIN, 6) + b"XY" + b"ping",
+    # truncated binary header (field count missing)
+    _HEADER.pack(_MAGIC, _VERSION, _FLAG_BIN, 3) + _BIN_TOKEN + bytes([9]),
+    # chunk index out of range
+    _HEADER.pack(_MAGIC, _VERSION, _FLAG_CHUNK | _FLAG_BIN,
+                 _CHUNK_HEADER.size) + _CHUNK_HEADER.pack(b"s" * 16, 5, 2),
+    # bad magic
+    struct.pack(">2sBBI", b"ZZ", 1, 0, 0),
+], ids=["oversized", "bad-token", "truncated", "chunk-range", "bad-magic"])
+def test_hostile_frame_drops_connection_not_node(run, frame):
+    async def main():
+        victim = P2PNode(node_id="victim", host="127.0.0.1", port=0)
+        await victim.start()
+        reader, writer = await _raw_hello(victim.port, offer_bin=True)
+        for _ in range(100):
+            if victim.is_connected("evil"):
+                break
+            await asyncio.sleep(0.01)
+        errors0 = victim.wire_errors
+        writer.write(frame)
+        await writer.drain()
+        # the node drops exactly this connection, typed and counted
+        assert await reader.read() == b""  # server closed our socket
+        for _ in range(100):
+            if victim.wire_errors > errors0:
+                break
+            await asyncio.sleep(0.01)
+        assert victim.wire_errors == errors0 + 1
+        assert not victim.is_connected("evil")
+        # the serving loop survives: a well-behaved peer connects and talks
+        friend = P2PNode(node_id="friend", host="127.0.0.1", port=0)
+        await friend.start()
+        got = asyncio.Event()
+        victim.register_message_handler(
+            "hi", lambda p, m: (got.set(), None)[1])
+        assert await friend.connect_to_peer("127.0.0.1", victim.port) == "victim"
+        assert await friend.send_message("victim", "hi")
+        await asyncio.wait_for(got.wait(), 5)
+        await friend.stop()
+        await victim.stop()
+
+    run(main())
+
+
+def test_binary_frame_from_unnegotiated_peer_is_rejected(run):
+    async def main():
+        victim = P2PNode(node_id="victim", host="127.0.0.1", port=0)
+        await victim.start()
+        # hello WITHOUT the wire offer: the connection is JSON-only
+        reader, writer = await _raw_hello(victim.port, offer_bin=False)
+        for _ in range(100):
+            if victim.is_connected("evil"):
+                break
+            await asyncio.sleep(0.01)
+        body = b"".join(_encode_bin({"type": "ping"}))
+        writer.write(_HEADER.pack(_MAGIC, _VERSION, _FLAG_BIN, len(body)) + body)
+        await writer.drain()
+        assert await reader.read() == b""
+        assert victim.wire_errors == 1
+        await victim.stop()
+
+    run(main())
+
+
+def test_oversized_field_falls_back_to_json_wire(run, monkeypatch):
+    """A message carrying a bytes value past the binary decoder's
+    per-field cap (a huge file send) must ride the JSON wire for that
+    one message instead of being dropped as hostile by the receiver —
+    a bin1 peer accepts JSON frames at any time."""
+    from quantum_resistant_p2p_tpu.net import p2p_node as p2p_mod
+
+    async def main():
+        a, b = await _pair()
+        assert a.peer_wire_format("node-b") == "bin1"
+        # shrink only the SEND-side routing threshold; the receiver's
+        # frame bounds are untouched
+        monkeypatch.setattr(p2p_mod, "_BIN_MAX_FIELD", 1024)
+        got = asyncio.Event()
+        seen = {}
+
+        async def on_file(peer_id, msg):
+            seen.update(msg)
+            got.set()
+
+        b.register_message_handler("file", on_file)
+        big = bytes(range(256)) * 16  # 4 KiB > the shrunken cap
+        assert await a.send_message("node-b", "file", data=big, small=b"s")
+        await asyncio.wait_for(got.wait(), 5)
+        # delivered via JSON (b64-decoded bytes, not a frame memoryview)
+        assert isinstance(seen["data"], bytes)
+        assert seen["data"] == big
+        assert b.wire_errors == 0
+        # small messages keep riding the binary wire afterwards
+        got.clear()
+        seen.clear()
+        assert await a.send_message("node-b", "file", data=b"tiny")
+        await asyncio.wait_for(got.wait(), 5)
+        assert isinstance(seen["data"], memoryview)
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+def test_chunked_binary_roundtrip_and_zero_copy(run):
+    async def main():
+        a, b = await _pair()
+        a.chunk_size = 4096
+        assert a.peer_wire_format("node-b") == "bin1"
+        got = asyncio.Event()
+        seen = {}
+
+        async def on_big(peer_id, msg):
+            seen.update(msg)
+            got.set()
+
+        b.register_message_handler("big", on_big)
+        payload = bytes(range(256)) * 256  # 64 KiB -> chunked binary frames
+        assert await a.send_message("node-b", "big", data=payload, small=b"s")
+        await asyncio.wait_for(got.wait(), 10)
+        # raw fields arrive as memoryviews into the (reassembled) buffer —
+        # the zero-copy contract the AEAD open batch relies on
+        assert isinstance(seen["small"], memoryview)
+        assert bytes(seen["data"]) == payload
+        await a.stop()
+        await b.stop()
+
+    run(main())
+
+
+# -- messaging-level: corrupt ciphertext mid-session --------------------------
+
+
+def test_corrupt_ciphertext_over_binary_wire_triggers_rekey_not_crash(run):
+    """A fault-plan-corrupted ciphertext on the binary wire must fail the
+    AEAD open (typed), trigger the rekey machinery, and leave the
+    connection + serving loop alive — the subsequent message delivers."""
+    from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging
+    from quantum_resistant_p2p_tpu.faults import FaultPlan, FaultRule
+    from quantum_resistant_p2p_tpu.fleet.stormlib import (
+        register_storm_providers)
+    from quantum_resistant_p2p_tpu.provider import (get_kem, get_signature,
+                                                    get_symmetric)
+
+    register_storm_providers()
+
+    async def main():
+        a_node = P2PNode(node_id="alice", host="127.0.0.1", port=0)
+        b_node = P2PNode(node_id="bob", host="127.0.0.1", port=0)
+        await a_node.start()
+        await b_node.start()
+        kw = dict(kem=get_kem("STORM-KEM"), signature=get_signature("STORM-SIG"),
+                  symmetric=get_symmetric("ChaCha20-Poly1305"))
+        a, b = SecureMessaging(a_node, **kw), SecureMessaging(b_node, **kw)
+        inbox = []
+        b.register_message_listener(
+            lambda p, m: None if m.is_system else inbox.append(m.content))
+        assert await a_node.connect_to_peer("127.0.0.1", b_node.port) == "bob"
+        assert a_node.peer_wire_format("bob") == "bin1"
+        assert await a.initiate_key_exchange("bob")
+
+        old_key = a.shared_keys["bob"]
+        plan = FaultPlan(seed=5, rules=[
+            FaultRule("net.send", "corrupt", corrupt_field="ct",
+                      match={"msg_type": "secure_message"}, nth=1),
+        ])
+        with plan.activate():
+            await a.send_message("bob", b"corrupted in flight")
+        assert plan.injected, "the corrupt rule never fired"
+        for _ in range(100):
+            if b._ctr_rekeys.value:
+                break
+            await asyncio.sleep(0.05)
+        assert b._ctr_rekeys.value == 1  # AEAD failure -> automatic rekey
+        assert b_node.wire_errors == 0  # transport stayed healthy
+        # loop alive: once the NEW key lands on both sides (a send during
+        # the rekey overlap would ride the dropped key — undecryptable by
+        # design), the next message delivers
+        for _ in range(200):
+            if (a.shared_keys.get("bob") not in (None, old_key)
+                    and b.verify_key_exchange_state("alice")
+                    and a.verify_key_exchange_state("bob")):
+                break
+            await asyncio.sleep(0.05)
+        assert a.shared_keys.get("bob") not in (None, old_key)
+        await a.send_message("bob", b"after the storm")
+        for _ in range(100):
+            if inbox:
+                break
+            await asyncio.sleep(0.05)
+        assert inbox == [b"after the storm"]
+        await a_node.stop()
+        await b_node.stop()
+
+    run(main())

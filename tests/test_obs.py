@@ -665,17 +665,25 @@ def test_propagation_optout_restores_disjoint_traces(run, monkeypatch):
 
 def test_propagation_on_attaches_ids_only_field(run, monkeypatch):
     """With propagation ON (the default), ke_* frames carry exactly the
-    bounded ids-only ``_trace`` dict — and handlers never see it."""
+    bounded ids-only ``_trace`` dict — and handlers never see it.  Both
+    frame encoders are spied: peers that negotiated the binary wire send
+    the same message dicts through ``_send_frame_bin``."""
     monkeypatch.setattr(messaging_mod, "KEY_EXCHANGE_TIMEOUT", 10.0)
     sent_messages = []
     seen_by_handler = []
     orig = P2PNode._send_frame
+    orig_bin = P2PNode._send_frame_bin
 
     async def spy(self, writer, lock, message):
         sent_messages.append(message)
         return await orig(self, writer, lock, message)
 
+    async def spy_bin(self, writer, lock, message):
+        sent_messages.append(message)
+        return await orig_bin(self, writer, lock, message)
+
     monkeypatch.setattr(P2PNode, "_send_frame", spy)
+    monkeypatch.setattr(P2PNode, "_send_frame_bin", spy_bin)
 
     async def main():
         a, b = await _toy_pair()

@@ -316,6 +316,59 @@ def test_sink_exception_trigger_clean_suppressed():
     assert [s.rule for s in suppressed] == ["flow-secret-in-exception"]
 
 
+def test_sink_binary_frame_trigger_clean_suppressed():
+    """flow-secret-to-network over the negotiated binary wire: the
+    ``_send_frame_bin`` encode chokepoint (net/p2p_node.py) is a raw-bytes
+    network sink — key material in a binary field leaves the process
+    verbatim, with no b64/hex step to catch it."""
+    assert rule_ids(
+        """
+        async def leak(node, peer, kem, sk, ct):
+            ss = kem.decapsulate(sk, ct)
+            await node._send_frame_bin(peer.writer, peer.write_lock,
+                                       {"type": "oops", "ct": ss})
+        """
+    ) == ["flow-secret-to-network"]
+    # clean: AEAD output is public by construction — the normal data path
+    assert rule_ids(
+        """
+        async def send(node, peer, aead, key, msg, ad):
+            ct = aead.encrypt(key, msg, ad)
+            await node._send_frame_bin(peer.writer, peer.write_lock,
+                                       {"type": "secure_message", "ct": ct})
+        """
+    ) == []
+    findings, suppressed = lint(
+        """
+        async def probe(node, peer, kem, sk, ct):
+            ss = kem.decapsulate(sk, ct)
+            await node._send_frame_bin(peer.writer, peer.write_lock, {"type": "kat", "ss": ss})  # qrlint: disable=flow-secret-to-network — KAT harness: ss is a pinned test vector sent to a loopback checker
+        """
+    )
+    assert not findings
+    assert [s.rule for s in suppressed] == ["flow-secret-to-network"]
+
+
+def test_deterministic_seal_open_models_stay_public():
+    """seal()/open_() (the deterministic-nonce AEAD primitives) are
+    modeled like encrypt()/decrypt(): outputs public, so the batched
+    facade's fallback path stays violation-free."""
+    assert rule_ids(
+        """
+        def f(node, scalar, key, nonce, msg):
+            ct = scalar.seal(key, nonce, msg, b"ad")
+            node.send_message("peer", "m", ct=ct)
+        """
+    ) == []
+    assert rule_ids(
+        """
+        def f(node, scalar, key, nonce, blob):
+            pt = scalar.open_(key, nonce, blob, b"ad")
+            return f"pt={pt!r}"
+        """
+    ) == []
+
+
 def test_sink_format_trigger_and_clean():
     assert rule_ids(
         """

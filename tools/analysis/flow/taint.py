@@ -178,6 +178,12 @@ MODELS: dict[str, Taint] = {
     "sign": T_PUBLIC, "sign_batch": T_PUBLIC, "_sign": T_PUBLIC,
     "verify": T_PUBLIC, "verify_batch": T_PUBLIC, "_verify": T_PUBLIC,
     "encrypt": T_PUBLIC, "decrypt": T_PUBLIC,
+    # deterministic-nonce AEAD primitives (provider/base.py): ciphertext
+    # out of seal() and plaintext out of open_() are public by the same
+    # construction encrypt()/decrypt() are — the key operand never taints
+    # the result
+    "seal": T_PUBLIC, "open_": T_PUBLIC,
+    "seal_batch": T_PUBLIC, "open_batch": T_PUBLIC,
     "derive_message_key": Taint(DERIVED, why="derive_message_key()"),
     "_hkdf_sha256": Taint(DERIVED, why="_hkdf_sha256()"),
     "hkdf": Taint(DERIVED, why="hkdf()"),
@@ -202,7 +208,13 @@ WIPERS = {"wipe", "_wipe", "zeroize", "_zeroize", "_wipe_secret", "wipe_secret"}
 #: same pre-AEAD rule applies — response bodies may be built only from
 #: registry snapshots / SLO reports / span dumps (public by
 #: construction), never key material.
-NETWORK_SINKS = {"send_message", "sendall", "sendto", "_respond"}
+#: ``_send_frame_bin`` is the negotiated binary wire's single encode
+#: chokepoint (net/p2p_node.py): raw bytes values in the message dict hit
+#: the socket UNENCODED — the pre-AEAD rule applies to it exactly as to
+#: send_message, and a secret smuggled into a binary field would leave the
+#: process verbatim.
+NETWORK_SINKS = {"send_message", "sendall", "sendto", "_respond",
+                 "_send_frame_bin"}
 
 #: observability sinks (obs/): span attributes, metric labels, and
 #: flight-recorder payloads are exported in cleartext diagnostics (trace

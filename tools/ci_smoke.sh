@@ -32,6 +32,14 @@ python -m tools.swarm_bench --storm --peers 48 --concurrency 48 \
     --rekey-every 2 --seed 11 >/dev/null
 echo "storm smoke ok (48 sessions, 0 failures)"
 
+# Data-plane smoke (docs/gateway.md "Bulk-heavy storms"): a small
+# bulk-mix storm through the batched device AEAD + binary wire must
+# complete with zero failures (speedup/latency gates are full-size-run
+# territory — bench.py --storm --bulk-mix; sessions < 48 run in smoke
+# mode, failures-only, no committed artifact).
+python bench.py --storm --bulk-mix --sessions 16 >/dev/null
+echo "bulk-mix smoke ok (batched AEAD + binary wire, 0 failures)"
+
 # Fleet chaos smoke (docs/fleet.md): 3 gateway PROCESSES behind the
 # consistent-hash router, 60 sessions, one seeded mid-storm SIGKILL of
 # gw1 — must converge with 0 lost established sessions, 0 plaintext

@@ -28,6 +28,7 @@ SECTIONS: dict[str, list[str]] = {
         "quantum_resistant_p2p_tpu.provider.kem_providers",
         "quantum_resistant_p2p_tpu.provider.sig_providers",
         "quantum_resistant_p2p_tpu.provider.symmetric",
+        "quantum_resistant_p2p_tpu.provider.aead_device",
         "quantum_resistant_p2p_tpu.provider.batched",
         "quantum_resistant_p2p_tpu.provider.scheduler",
         "quantum_resistant_p2p_tpu.provider.autotune",
@@ -46,11 +47,13 @@ SECTIONS: dict[str, list[str]] = {
     ],
     "core": [
         "quantum_resistant_p2p_tpu.core.keccak",
+        "quantum_resistant_p2p_tpu.core.chacha_pallas",
         "quantum_resistant_p2p_tpu.core.sha256",
         "quantum_resistant_p2p_tpu.core.sha512",
         "quantum_resistant_p2p_tpu.core.aes",
         "quantum_resistant_p2p_tpu.core.aes_bitsliced",
         "quantum_resistant_p2p_tpu.core.sortnet",
+        "quantum_resistant_p2p_tpu.pyref.chacha_ref",
     ],
     "app-net-storage": [
         "quantum_resistant_p2p_tpu.app.messaging",

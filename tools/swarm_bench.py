@@ -384,6 +384,7 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
                     handshake_budget: int = 0, bulk_lane_capacity: int = 0,
                     shard_devices: int = 0, ke_timeout: float = 120.0,
                     prewarm: bool = True, prewarm_cap: int = 256,
+                    aead_mode: str = "storm", payload_bytes: int = 0,
                     fault_rules=None) -> dict:
     """Sustained-traffic storm: ``sessions`` live peers through one hub.
 
@@ -393,6 +394,19 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
     ``churn_fraction``, one churn cycle (drop the TCP session, redial,
     re-handshake).  ``arrival_rate`` > 0 paces session starts (sessions/s,
     uniform); 0 launches everything behind the ``concurrency`` gate.
+
+    ``aead_mode`` picks the bulk-message AEAD (the ``--bulk-mix``
+    comparison axis, docs/gateway.md "Bulk-heavy storms"):
+
+    * ``storm`` — the stdlib toy AEAD (historical default);
+    * ``chacha`` — real ChaCha20-Poly1305 through the BATCHED device
+      facade (core/chacha_pallas.py via provider/batched.BatchedAEAD);
+    * ``chacha-scalar`` — the same algorithm on the scalar per-message
+      path (the baseline the >=5x bulk ratchet compares against).
+
+    ``payload_bytes`` pads every bulk message's content up to that size
+    (0 keeps the historical tiny payloads).  Per-message send latency
+    (sign + seal + write) is measured and reported as p50/p99_msg_s.
 
     Returns one JSON-ready dict: handshakes/s, p50/p99 split by first
     handshake vs rekey lane, shed counters (connection / handshake /
@@ -419,7 +433,16 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
         enable_compile_cache()
 
     rng = random.Random(seed)
-    aead = _StormAEAD()
+    if aead_mode == "storm":
+        aead = _StormAEAD()
+        batch_aead = False
+    elif aead_mode in ("chacha", "chacha-scalar"):
+        from quantum_resistant_p2p_tpu.provider import get_symmetric
+
+        aead = get_symmetric("ChaCha20-Poly1305")
+        batch_aead = aead_mode == "chacha"
+    else:
+        raise ValueError(f"unknown aead_mode {aead_mode!r}")
     # storm_env (fleet/stormlib.py — the same guard every fleet gateway
     # subprocess enters): raised fd limit + module-global protocol-timeout
     # save/restore.  Everything below also runs under one finally: an
@@ -433,6 +456,7 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
             gateway_kw = dict(
                 use_batching=True, max_batch=max_batch, max_wait_ms=max_wait_ms,
                 autotune=autotune, shard_devices=shard_devices,
+                batch_aead=batch_aead,
             )
             hub_node = P2PNode(node_id="hub", host="127.0.0.1", port=0,
                                max_peers=hub_max_peers)
@@ -464,10 +488,21 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
 
             if prewarm:
                 # warm every pow2 flush bucket a live storm can hit (up to the
-                # cap) on BOTH planes
+                # cap) on BOTH planes.  The AEAD facades additionally key
+                # compiled programs on the (msg, aad) LENGTH buckets: point
+                # their warm shapes at the bucket this storm's package size
+                # actually lands in (b64 content + envelope + sig material)
+                # before the sweep compiles them.
+                aead_facades = ()
+                if batch_aead and hub._baead is not None:
+                    est = (4 * max(payload_bytes, 64)) // 3 + 640
+                    shapes = ((hub._baead.device._msg_bucket(est), 256),)
+                    hub._baead.warm_shapes = shapes
+                    proto._baead.warm_shapes = shapes
+                    aead_facades = (proto._baead, hub._baead)
                 await _prewarm_facades(
                     (proto._bkem, proto._bsig, hub._bkem, hub._bsig,
-                     proto._bfused, hub._bfused),
+                     proto._bfused, hub._bfused) + aead_facades,
                     min(max_batch, max(concurrency, 1), prewarm_cap))
 
             n_keys = sessions
@@ -475,6 +510,7 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
 
             first_lat: list[float] = []
             rekey_lat: list[float] = []
+            msg_lat: list[float] = []
             churns = rekeys = 0
             failures = 0
             sem = asyncio.Semaphore(concurrency)
@@ -486,9 +522,15 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
                     signature=proto.signature,
                     sig_keypair=(bytes(kp_pks[i]), bytes(kp_sks[i])))
                 sm._bkem, sm._bsig, sm._bfused = proto._bkem, proto._bsig, proto._bfused
+                sm._baead = proto._baead  # the shared data plane too
                 sm.use_batching = True
                 clients.append(sm)
                 return sm
+
+            def _payload(i: int, k: int) -> bytes:
+                base = b"storm payload %d/%d" % (i, k)
+                return (base.ljust(payload_bytes, b"x")
+                        if payload_bytes else base)
 
             async def handshake(sm, bucket: list[float]) -> bool:
                 nonlocal failures
@@ -514,7 +556,9 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
                     if not await handshake(sm, first_lat):
                         return
                     for k in range(msgs_per_session):
-                        await sm.send_message("hub", b"storm payload %d/%d" % (i, k))
+                        mt0 = time.perf_counter()
+                        await sm.send_message("hub", _payload(i, k))
+                        msg_lat.append(time.perf_counter() - mt0)
                         if rekey_every and (k + 1) % rekey_every == 0:
                             # forced re-key: drop the session key and run the
                             # 5-message handshake again — rides the REKEY lane on
@@ -572,11 +616,12 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
     total_hs = len(first_lat) + len(rekey_lat)
     total_ops = fb_ops = 0
     for m in (hub_metrics, proto_metrics):
-        for fam in ("kem_queue", "sig_queue", "fused_queue"):
+        for fam in ("kem_queue", "sig_queue", "fused_queue", "aead_queue"):
             for q in m.get(fam, {}).values():
                 total_ops += q["ops"]
                 fb_ops += q["fallback_ops"]
     f_sorted, r_sorted = sorted(first_lat), sorted(rekey_lat)
+    m_sorted = sorted(msg_lat)
     client_busy = sum(sm.node.busy_rejects for sm in clients)
     out = {
         "workload": "storm",
@@ -585,6 +630,9 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
                       "benched by --slo/raw-ops)" if providers == "stdlib"
                       else f"{kem_name}+{sig_name}"),
         "aead": aead.name,
+        "aead_mode": aead_mode,
+        "batch_aead": batch_aead,
+        "payload_bytes": payload_bytes,
         "seed": seed,
         "arrival_rate": arrival_rate,
         "concurrency": concurrency,
@@ -599,6 +647,10 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
         "handshakes_per_s": round(total_hs / elapsed, 2) if elapsed else None,
         "msgs_received": received,
         "msgs_per_s": round(received / elapsed, 2) if elapsed else None,
+        # per-message SEND latency (sign + seal + frame write): the bulk
+        # p99 bound the --bulk-mix ratchet gates on
+        "p50_msg_s": _percentile(m_sorted, 50),
+        "p99_msg_s": _percentile(m_sorted, 99),
         "p50_handshake_s": _percentile(f_sorted, 50),
         "p99_handshake_s": _percentile(f_sorted, 99),
         "rekeys": rekeys,
@@ -620,6 +672,9 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
             for k in ("max_peers", "handshake_budget", "handshake_sheds")},
         "autotune_hub": hub_metrics["gateway"]["autotune"],
         "autotune_clients": proto_metrics["gateway"]["autotune"],
+        # the data plane's seal/open queues (None on scalar-AEAD storms)
+        "aead_queue": {"hub": hub_metrics.get("aead_queue"),
+                       "client_plane": proto_metrics.get("aead_queue")},
         # burn-rate health of both planes at storm end (obs/slo.py):
         # the consumer-grade signal the raw shed/served counters feed
         "slo": {"hub": hub_metrics["slo"],
@@ -815,6 +870,19 @@ def main(argv=None) -> int:
                     help="storm session starts per second (0 = all at once "
                          "behind --concurrency)")
     ap.add_argument("--msgs-per-session", type=int, default=2)
+    ap.add_argument("--bulk-mix", type=int, default=0,
+                    help="storm: bulk-heavy profile — this many bulk "
+                         "messages per session (overrides "
+                         "--msgs-per-session) with 2 KiB payloads unless "
+                         "--payload-bytes says otherwise")
+    ap.add_argument("--aead", default="storm",
+                    choices=("storm", "chacha", "chacha-scalar"),
+                    help="storm bulk AEAD: stdlib toy (default), batched "
+                         "device ChaCha20-Poly1305, or its scalar baseline")
+    ap.add_argument("--payload-bytes", type=int, default=0,
+                    help="pad bulk message contents to this size "
+                         "(0 = tiny legacy payloads; --bulk-mix defaults "
+                         "this to 2048)")
     ap.add_argument("--rekey-every", type=int, default=0,
                     help="force a re-key every N bulk messages per session")
     ap.add_argument("--churn", type=float, default=0.0,
@@ -853,10 +921,12 @@ def main(argv=None) -> int:
         # report carries honestly
         return 0 if stats["lost_established_sessions"] == 0 else 1
     if args.storm:
+        msgs = args.bulk_mix or args.msgs_per_session
+        payload = args.payload_bytes or (2048 if args.bulk_mix else 0)
         stats = asyncio.run(run_storm(
             args.peers, providers=args.providers,
             arrival_rate=args.arrival_rate, concurrency=args.concurrency,
-            msgs_per_session=args.msgs_per_session,
+            msgs_per_session=msgs,
             rekey_every=args.rekey_every, churn_fraction=args.churn,
             seed=args.seed, max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, autotune=args.autotune,
@@ -864,6 +934,7 @@ def main(argv=None) -> int:
             handshake_budget=args.handshake_budget,
             bulk_lane_capacity=args.bulk_lane_capacity,
             shard_devices=args.shard_devices, ke_timeout=args.ke_timeout,
+            aead_mode=args.aead, payload_bytes=payload,
         ))
         if args.obs_dir:
             write_obs_artifacts(stats, args.obs_dir, stem="storm")
