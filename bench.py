@@ -503,6 +503,201 @@ def fleet_storm_main(out_path: str | None = None,
     return rc
 
 
+#: resume-mix storm ratchet configuration (docs/protocol.md "Session
+#: resumption"): every session drops its TCP connection mid-workload and
+#: re-establishes — with a held ticket that is a 1-RTT resume.  The gates
+#: pin the three claims the resumption machinery makes: reconnects
+#: actually resume (rate), resumes are CHEAP (p50 under the full
+#: handshake's), and they cost ~0 device-seconds (the sequential probe).
+RESUME_SESSIONS = 400
+RESUME_MSGS_PER_SESSION = 4
+RESUME_CONCURRENCY = 128
+RESUME_ARRIVAL_RATE = 150.0
+MIN_RESUME_RATE = 0.9
+
+
+def resume_storm_main(out_path: str | None = None,
+                      sessions: int = RESUME_SESSIONS) -> int:
+    """Resume-mix storm ratchet: one seeded trace where every session
+    reconnects mid-workload via its resumption ticket.  Writes
+    ``bench_results/resume_storm_r0N.json`` and gates on:
+
+    * zero failures (every reconnect ends established — fallback included);
+    * ticket-resume rate >= ``MIN_RESUME_RATE`` (reconnects actually skip
+      the KEM + 3 signatures);
+    * resume p50 <= full-handshake p50 (the abbreviated exchange is the
+      cheap path it claims to be);
+    * the sequential cost probe's device trips stay ~0 (no device dispatch
+      rides a resume — at most a straggler flush from the storm tail).
+    """
+    import asyncio
+    import sys
+    from pathlib import Path
+
+    from tools.swarm_bench import run_storm
+
+    smoke = sessions < 48
+    out = asyncio.run(run_storm(
+        sessions, seed=STORM_SEED, arrival_rate=RESUME_ARRIVAL_RATE,
+        concurrency=RESUME_CONCURRENCY,
+        msgs_per_session=RESUME_MSGS_PER_SESSION, resume_mix=True,
+    ))
+    rate = out.get("ticket_resume_rate") or 0.0
+    probe = out.get("resume_cost_probe") or {}
+    out.update({
+        "metric": f"resume_storm_{sessions}_sessions_resume_rate",
+        "value": rate,
+        "unit": "fraction",
+        "vs_baseline": None,
+    })
+    rc = 0
+    if out["failures"]:
+        print(f"RESUME STORM FAIL: {out['failures']} failed session(s)",
+              file=sys.stderr)
+        rc = 1
+    if rate < MIN_RESUME_RATE:
+        print(f"RESUME STORM FAIL: ticket-resume rate {rate:.1%} < "
+              f"{MIN_RESUME_RATE:.0%}", file=sys.stderr)
+        rc = 1
+    p50_resume = out.get("p50_resume_s")
+    p50_full = out.get("p50_handshake_s")
+    if (p50_resume is not None and p50_full is not None
+            and p50_resume > p50_full):
+        print(f"RESUME STORM FAIL: resume p50 {p50_resume}s slower than "
+              f"the full handshake's {p50_full}s", file=sys.stderr)
+        rc = 1
+    if probe and probe.get("resumes") and (
+            probe.get("device_trips", 0) > probe["resumes"] // 2):
+        print(f"RESUME STORM FAIL: {probe['device_trips']} device trips "
+              f"across {probe['resumes']} pure resumes — resumes are "
+              "supposed to cost ~0 device dispatches", file=sys.stderr)
+        rc = 1
+    out["ok"] = rc == 0
+    line = json.dumps(out)
+    print(line)
+    if not smoke:
+        Path("bench_results").mkdir(exist_ok=True)
+        n = 1
+        while Path(f"bench_results/resume_storm_r{n:02d}.json").exists():
+            n += 1
+        Path(f"bench_results/resume_storm_r{n:02d}.json").write_text(
+            line + "\n")
+    if out_path:
+        Path(out_path).write_text(line + "\n")
+    return rc
+
+
+#: fleet rolling-restart ratchet configuration (docs/robustness.md
+#: "Rolling restarts"): the full fleet-storm trace with a mid-storm
+#: rolling SIGTERM restart of EVERY gateway plus one SIGKILL — the
+#: planned-maintenance and the crash case in one run.  gw2 is killed
+#: late enough that the roll is already in flight.
+ROLL_DELAY_S = 2.0
+ROLL_KILL_GATEWAY = "gw2"
+ROLL_KILL_TICK = 16
+MIN_POST_ROLL_RESUME_RATE = 0.9
+
+
+def fleet_roll_main(out_path: str | None = None,
+                    sessions: int = STORM_SESSIONS,
+                    gateways: int = FLEET_GATEWAYS,
+                    spawn: str = "process") -> int:
+    """Fleet rolling-restart chaos ratchet: replay the seeded fleet trace
+    while ``GatewayFleet.rolling_restart()`` drains + respawns every
+    gateway mid-storm and the fault plan SIGKILLs one.  Writes
+    ``bench_results/fleet_roll_r0N.json`` and gates on:
+
+    * **zero lost established sessions** and **zero plaintext sends** —
+      the fleet-storm invariants hold through a full rolling restart;
+    * >= ``MIN_POST_ROLL_RESUME_RATE`` of post-restart reconnects resumed
+      VIA TICKET (not full handshake) — the reconnect wave after a
+      restart is the cheap path, which is the whole point of ISSUE 15;
+    * the rolling restart itself completed (every gateway re-registered).
+    """
+    import asyncio
+    import sys
+    from pathlib import Path
+
+    from quantum_resistant_p2p_tpu.fleet.storm import (default_kill_rules,
+                                                       run_fleet_storm)
+    from tools.swarm_bench import write_obs_artifacts
+
+    smoke = sessions < 500
+    hb_interval = 0.1 if smoke else 0.25
+    # smoke runs pace arrivals slowly enough that sessions are genuinely
+    # IN FLIGHT when the roll begins (a burst of tiny sessions finishes
+    # before any gateway drains and proves nothing)
+    roll_delay = 0.8 if smoke else ROLL_DELAY_S
+    arrival = min(STORM_ARRIVAL_RATE, sessions / 3.0) if smoke \
+        else STORM_ARRIVAL_RATE
+    # the SIGKILL rides only the full-size chaos run with >= 3 gateways
+    # (a 2-gateway smoke losing one to a kill AND one to a drain has no
+    # capacity left to hand off to)
+    rules = (default_kill_rules(ROLL_KILL_GATEWAY, ROLL_KILL_TICK)
+             if not smoke and gateways > 2 else None)
+    out = asyncio.run(run_fleet_storm(
+        sessions, gateways=gateways, seed=STORM_SEED,
+        arrival_rate=arrival, concurrency=STORM_CONCURRENCY,
+        msgs_per_session=8, spawn=spawn, fault_rules=rules,
+        hb_interval=hb_interval, roll=True, roll_delay_s=roll_delay,
+        session_attempts=8, msg_interval_s=0.1 if smoke else 0.05,
+    ))
+    out.update({
+        "metric": f"fleet_roll_{sessions}x{gateways}_lost_established",
+        "value": out["lost_established_sessions"],
+        "unit": "sessions",
+        "vs_baseline": None,
+    })
+    rc = 0
+    if out["lost_established_sessions"]:
+        print(f"FLEET ROLL FAIL: {out['lost_established_sessions']} "
+              "established session(s) lost", file=sys.stderr)
+        rc = 1
+    if out["plaintext_sends"]:
+        print(f"FLEET ROLL FAIL: {out['plaintext_sends']} plaintext "
+              "send(s)", file=sys.stderr)
+        rc = 1
+    if not (out.get("roll") or {}).get("ok"):
+        print("FLEET ROLL FAIL: the rolling restart did not complete "
+              "(a gateway never re-registered)", file=sys.stderr)
+        rc = 1
+    post = (out.get("post_roll_resumed") or 0) + (out.get("post_roll_full")
+                                                  or 0)
+    rate = out.get("post_roll_resume_rate")
+    if smoke:
+        # smoke gate: at least ONE displaced session must have resumed
+        # via ticket (tiny smokes produce a handful of reconnects)
+        if not out.get("resumed_reconnects"):
+            print("FLEET ROLL FAIL: no ticket resume observed across the "
+                  "rolling restart", file=sys.stderr)
+            rc = 1
+    elif post and (rate or 0.0) < MIN_POST_ROLL_RESUME_RATE:
+        print(f"FLEET ROLL FAIL: post-restart ticket-resume rate "
+              f"{rate:.1%} < {MIN_POST_ROLL_RESUME_RATE:.0%} "
+              f"({out['post_roll_resumed']}/{post})", file=sys.stderr)
+        rc = 1
+    if rules is not None and not out.get("chaos", {}).get("injected"):
+        print("FLEET ROLL FAIL: the seeded mid-roll gateway kill never "
+              "fired", file=sys.stderr)
+        rc = 1
+    out["ok"] = rc == 0
+    line = json.dumps(out)
+    print(line)
+    if not smoke:
+        # fleet_roll_* obs artifacts only: the shared fleet_slo_report.json
+        # name stays owned by the flagship kill-storm run
+        write_obs_artifacts(out, "bench_results", stem="fleet_roll")
+        Path("bench_results").mkdir(exist_ok=True)
+        n = 1
+        while Path(f"bench_results/fleet_roll_r{n:02d}.json").exists():
+            n += 1
+        Path(f"bench_results/fleet_roll_r{n:02d}.json").write_text(
+            line + "\n")
+    if out_path:
+        Path(out_path).write_text(line + "\n")
+    return rc
+
+
 def multichip_main(out_path: str | None, shards: str, hs_peers: int,
                    emulate: int) -> int:
     """1→N-chip scaling probe (tools/swarm_bench.run_multichip): batch-4096
@@ -633,6 +828,17 @@ if __name__ == "__main__":
                     choices=("process", "task"),
                     help="fleet gateway isolation (--storm --fleet): real "
                          "subprocesses or in-process asyncio tasks")
+    ap.add_argument("--resume-mix", action="store_true",
+                    help="with --storm: run the session-RESUMPTION ratchet "
+                         "instead — every session reconnects mid-workload "
+                         "via its ticket, gated on resume rate / latency / "
+                         "~0 device cost (docs/protocol.md)")
+    ap.add_argument("--roll", action="store_true",
+                    help="with --storm --fleet: run the ROLLING-RESTART "
+                         "chaos ratchet instead — every gateway drained "
+                         "and respawned mid-storm (+ one SIGKILL), gated "
+                         "on 0 lost sessions and a >=90%% post-restart "
+                         "ticket-resume rate (docs/robustness.md)")
     ap.add_argument("--bulk-mix", action="store_true",
                     help="with --storm: run the BULK-heavy data-plane "
                          "ratchet instead — one seeded bulk-mix trace on "
@@ -662,9 +868,16 @@ if __name__ == "__main__":
     args = ap.parse_args()
     if args.slo:
         raise SystemExit(slo_main(args.out, args.peers, args.warmup))
+    if args.storm and args.fleet and args.roll:
+        raise SystemExit(fleet_roll_main(args.out, args.sessions,
+                                         args.fleet, args.spawn))
     if args.storm and args.fleet:
         raise SystemExit(fleet_storm_main(args.out, args.sessions,
                                           args.fleet, args.spawn))
+    if args.storm and args.resume_mix:
+        sessions = (args.sessions if args.sessions != STORM_SESSIONS
+                    else RESUME_SESSIONS)
+        raise SystemExit(resume_storm_main(args.out, sessions))
     if args.storm and args.bulk_mix:
         sessions = (args.sessions if args.sessions != STORM_SESSIONS
                     else BULK_SESSIONS)

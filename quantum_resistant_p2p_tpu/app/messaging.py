@@ -34,6 +34,7 @@ import uuid
 from pathlib import Path
 from typing import Any, Callable
 
+from ..faults import plan as _faults
 from ..net.p2p_node import P2PNode
 from ..obs import flight as obs_flight
 from ..obs import trace as obs_trace
@@ -44,6 +45,12 @@ from ..provider.base import KeyExchangeAlgorithm, SignatureAlgorithm, SymmetricA
 from ..provider.batched import (LANE_BULK, LANE_HANDSHAKE, LANE_REKEY,
                                 LaneShed)
 from .message_store import Message
+from .resumption import (ReplayCache, STEKRing, TicketError,
+                         derive_resumed_key, derive_resumption_secret,
+                         hkdf_sha256 as _hkdf_sha256,
+                         mint_fields, ratchet_resumption_secret,
+                         resume_binder, resume_confirm_tag,
+                         resumption_default)
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +95,11 @@ WARMUP_SIZES = (1, 2, 4)
 #: plane (cold compiles on the hot path, breaker storms, gateway
 #: saturation) burns budget — warm fused handshakes measure ~0.1-0.2 s
 HANDSHAKE_SLO_THRESHOLD_S = 2.0
+#: session-resumption tickets (docs/protocol.md "Session resumption"):
+#: how long a minted ticket may resume, and the bound on tickets a client
+#: holds (oldest evicted, secrets wiped) — both sides of the memory story
+RESUME_TICKET_TTL_S = 2 * 3600.0
+TICKET_CAP = 1024
 
 
 class KeyExchangeState(enum.Enum):
@@ -145,23 +157,10 @@ def _wipe(buf) -> None:
         buf[:] = b"\x00" * len(buf)
 
 
-def _hkdf_sha256(ikm: bytes, salt: bytes, info: bytes, length: int = 32) -> bytes:
-    """RFC 5869 HKDF-SHA256 (extract + expand) on the stdlib.
-
-    Bit-identical to ``cryptography``'s HKDF (tests/test_faults.py pins the
-    RFC 5869 A.1 vector) but with no OpenSSL wheel dependency, so the
-    protocol engine imports and runs on minimal accelerator images — the
-    same gating provider/symmetric.py applies to the AEADs.
-    """
-    prk = hmac.new(salt or bytes(32), ikm, hashlib.sha256).digest()
-    okm = b""
-    t = b""
-    i = 1
-    while len(okm) < length:
-        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
-        okm += t
-        i += 1
-    return okm[:length]
+# RFC 5869 HKDF-SHA256 on the stdlib: ONE copy lives in app/resumption.py
+# (the ticket machinery needs it below the engine), re-exported from the
+# import block above under the historical name — tests/test_faults.py pins
+# the RFC 5869 A.1 vector through ``_hkdf_sha256``.
 
 
 def derive_message_key(shared_secret: bytes, id_a: str, id_b: str, aead_name: str) -> bytes:
@@ -205,6 +204,8 @@ class SecureMessaging:
         bulk_lane_capacity: int = 0,
         telemetry_port: int | None = None,
         batch_aead: bool | None = None,
+        resumption: bool | None = None,
+        stek: STEKRing | None = None,
     ):
         self.node = node
         self.key_storage = key_storage
@@ -276,6 +277,47 @@ class SecureMessaging:
         #: 2 s SLO threshold boundary, so the good/bad split is exact.
         self._handshake_latency = self.registry.histogram(
             "handshake_latency_s", "initiated handshake attempt latency (s)")
+        # session-resumption tickets (docs/protocol.md "Session
+        # resumption"): None reads the QRP2P_RESUMPTION default (on);
+        # engine-level behavior only fires for peers whose hello ALSO
+        # offered resumption (net/p2p_node.py negotiation), so an opted-out
+        # or older peer sees wire-byte-identical frames (pinned).
+        self.resumption = (resumption_default() if resumption is None
+                           else resumption)
+        #: this engine's ticket-sealing keys: a locally random ring by
+        #: default (standalone responder); a fleet gateway's is replaced by
+        #: the router's distributed set (fleet/manager.py __gw_stek__)
+        self.tickets = stek if stek is not None else STEKRing()
+        self._replay = ReplayCache()
+        #: client side: issuer peer -> {ticket, expires_at, secret, ...};
+        #: bounded (TICKET_CAP), secrets wiped on every drop path
+        self._tickets: dict[str, dict] = {}
+        #: in-flight resume exchanges: message_id -> context
+        self._resume_pending: dict[str, dict] = {}
+        #: peers whose CURRENT connection has not yet established a
+        #: session: the one window a ticket may be presented in.  Armed on
+        #: every connect, disarmed on establishment — an in-session rekey
+        #: (AEAD failure, forced rekey) always runs the full KEM handshake
+        #: for fresh entropy; resumption is strictly a reconnect fast path.
+        self._resume_armed: set[str] = set()
+        #: graceful drain (docs/robustness.md "Rolling restarts"): once
+        #: set, /readyz answers 503 draining, new handshakes shed BUSY,
+        #: resumes are rejected typed, and peers have been nudged to
+        #: resume on their ring successor
+        self.draining = False
+        self.drain_reason: str | None = None
+        self._ctr_tickets_minted = self.registry.counter(
+            "tickets_minted", "resumption tickets sealed and sent")
+        self._ctr_resumes_ok = self.registry.counter(
+            "resumes_ok", "inbound ticket resumes accepted (responder)")
+        self._ctr_resume_rejects = self.registry.counter(
+            "resume_rejects", "inbound ticket resumes rejected, typed")
+        self._ctr_resumes_used = self.registry.counter(
+            "resumes_used", "handshakes completed via ticket resume (initiator)")
+        self._ctr_resume_fallbacks = self.registry.counter(
+            "resume_fallbacks", "resume attempts that fell back to a full handshake")
+        self._ctr_rehome_nudges = self.registry.counter(
+            "rehome_nudges", "drain nudges received from draining peers")
         self.registry.register_collector("queues", self._collect_queues)
         self.registry.register_collector("opcaches", self._collect_opcaches)
         #: engine birth (uptime for /healthz and snapshot-mode hs/s rates)
@@ -407,6 +449,10 @@ class SecureMessaging:
             ("ke_confirm", self._handle_ke_confirm),
             ("ke_test", self._handle_ke_test),
             ("ke_reject", self._handle_ke_reject),
+            ("ke_resume", self._handle_ke_resume),
+            ("ke_resume_ok", self._handle_ke_resume_ok),
+            ("ke_resume_reject", self._handle_ke_resume_reject),
+            ("ke_rehome", self._handle_ke_rehome),
             ("secure_message", self._handle_secure_message),
             ("settings_update", self._handle_settings_update),
             ("settings_request", self._handle_settings_request),
@@ -631,9 +677,20 @@ class SecureMessaging:
             self.shared_keys.pop(peer_id, None)
             _wipe(self.raw_secrets.pop(peer_id, None))
             self.ke_state[peer_id] = KeyExchangeState.NONE
+            # a fresh connection is the one window a held resumption
+            # ticket may be presented in (disarmed on establishment)
+            self._resume_armed.add(peer_id)
             self._spawn(self.request_peer_settings(peer_id), "settings gossip")
         elif event == "disconnect":
             self.ke_state[peer_id] = KeyExchangeState.NONE
+            self._resume_armed.discard(peer_id)
+            # fail any in-flight ticket resume with this peer, typed, and
+            # wipe its parked secret — same promptness contract as the
+            # ephemeral-KEM cleanup below
+            for mid, ctx in list(self._resume_pending.items()):
+                if ctx["peer"] == peer_id:
+                    _wipe(self._resume_pending.pop(mid)["secret"])
+                    self._fail_pending(mid, "peer_disconnected")
             # Fail any IN-FLIGHT handshake with the dropped peer now, with
             # a typed reason: no ke_response can ever resolve its future,
             # and burning the full protocol timeout on it would stall the
@@ -839,7 +896,25 @@ class SecureMessaging:
         exchange — e.g. one dropped datagram — or an invalid-signature
         rejection from one corrupted-in-flight message).  Structural
         failures (algorithm mismatch, keygen error, peer gone) fail fast.
+
+        When a resumption ticket for this peer is held and the connection
+        is fresh (docs/protocol.md "Session resumption"), the abbreviated
+        1-RTT ticket resume runs FIRST — no KEM, no signatures, no device
+        dispatch.  Any resume failure (hostile/expired/replayed ticket, a
+        peer that never saw the STEK) falls back LOUDLY to the full
+        handshake below — never a stall, never plaintext.
         """
+        if self._resume_allowed(peer_id):
+            status = await self._resume_once(peer_id)
+            if status == "ok":
+                return True
+            self._ctr_resume_fallbacks.inc()
+            logger.warning(
+                "ticket resume with %s failed (%s); falling back to a "
+                "full handshake", peer_id[:8], status,
+            )
+            obs_flight.record("ticket_fallback", peer=peer_id[:8],
+                              reason=status)
         delay = KE_RETRY_BACKOFF_S
         for attempt in range(retries + 1):
             status = await self._initiate_once(peer_id)
@@ -1191,6 +1266,23 @@ class SecureMessaging:
                         "+ handshake boundaries)",
             fast_burn=10.0, slow_burn=1.0,
         ))
+        # ticket resumes (docs/protocol.md "Session resumption"): good =
+        # resumes completed on either side, bad = typed rejects + client
+        # fallbacks.  A reconnect wave that stops resuming (rotated-away
+        # STEK, clock skew expiring tickets, a replay storm) burns here
+        # long before it shows as handshake-latency or admission pain —
+        # under the 1/(1-0.9) = 10x ceiling so it can actually fire.
+        eng.add(obs_slo.SLOSpec(
+            "resume_success", objective=0.9,
+            probe=obs_slo.counter_pair_probe(
+                lambda: (self._ctr_resumes_ok.value
+                         + self._ctr_resumes_used.value),
+                lambda: (self._ctr_resume_rejects.value
+                         + self._ctr_resume_fallbacks.value)),
+            description="ticket resumes completed vs rejected/fallen back "
+                        "(both roles)",
+            fast_burn=5.0, slow_burn=2.0,
+        ))
         if self._scheduler is not None:
             for sh in self._scheduler.shards:
                 eng.add(obs_slo.SLOSpec(
@@ -1276,10 +1368,15 @@ class SecureMessaging:
             breakers = {"breaker": self._bkem.breaker.state}
         degraded = sorted(k for k, st in breakers.items() if st != "closed")
         return {
-            "ready": warm and not degraded,
+            # a draining gateway answers 503 with the reason: the load
+            # balancer routes around it and qrtop renders the DRAIN state
+            # while the rolling restart is in flight
+            "ready": warm and not degraded and not self.draining,
             "warm": warm,
             "breakers": breakers,
             "degraded": degraded,
+            "draining": self.draining,
+            "drain_reason": self.drain_reason,
         }
 
     def slo_report(self) -> dict[str, Any]:
@@ -1302,6 +1399,9 @@ class SecureMessaging:
                 "connections_admitted": self.node.admitted,
                 "connection_sheds": self.node.sheds,
                 "handshake_giveups": self._ctr_handshake_giveups.value,
+                "tickets_minted": self._ctr_tickets_minted.value,
+                "resumes_ok": self._ctr_resumes_ok.value,
+                "resume_rejects": self._ctr_resume_rejects.value,
             },
         }
 
@@ -1350,6 +1450,19 @@ class SecureMessaging:
             "autotune": (self._autotuner.snapshot()
                          if self._autotuner is not None
                          else {"enabled": False}),
+        }
+        # the resumption/drain section (docs/protocol.md "Session
+        # resumption") — additive key, same compatibility contract
+        out["resumption"] = {
+            "enabled": self.resumption,
+            "tickets_minted": self._ctr_tickets_minted.value,
+            "tickets_held": len(self._tickets),
+            "resumes_ok": self._ctr_resumes_ok.value,
+            "resume_rejects": self._ctr_resume_rejects.value,
+            "resumes_used": self._ctr_resumes_used.value,
+            "resume_fallbacks": self._ctr_resume_fallbacks.value,
+            "replay_cache": len(self._replay),
+            "draining": self.draining,
         }
         # the SLO section (docs/observability.md): burn rates and budget
         # remaining per objective — additive key, same compatibility
@@ -1487,6 +1600,13 @@ class SecureMessaging:
         priority lane; shedding them would cost a live session)."""
         data = msg.get("ke_data") or {}
         message_id = data.get("message_id", "?")
+        if self.draining:
+            # draining: EVERYTHING new is shed (rekeys included — the
+            # peers are being nudged to the ring successor); the typed
+            # BUSY keeps the initiator's retry machinery in charge
+            self._shed_handshake(peer_id)
+            await self._reject(peer_id, message_id, RejectReason.BUSY)
+            return
         if (
             self._hs_budget
             and self._responding >= self._hs_budget
@@ -1560,6 +1680,22 @@ class SecureMessaging:
             secret, self.node_id, peer_id, self.symmetric.name
         )
         self.ke_state[peer_id] = KeyExchangeState.RESPONDED
+        # the resumption ticket rides INSIDE the ke_response frame (extra
+        # unsigned sibling fields, negotiated-only — un-negotiated peers'
+        # frames are byte-identical): the initiator holds the ticket in
+        # the same instant it considers the session live, so a gateway
+        # death/drain at ANY later point finds it already delivered.  (A
+        # separate ticket frame left one loop-scheduling window where an
+        # interrupted session reconnected ticketless — measured in the
+        # roll storm.)  The initiator is already signature-authenticated
+        # by its ke_init, and a tampered ticket field can only produce a
+        # typed resume reject + full-handshake fallback later.  A DRAINING
+        # responder still mints: a session established at drain onset is
+        # exactly the one about to be nudged to the ring successor.
+        extra: dict[str, Any] = {}
+        if self._resumption_negotiated(peer_id):
+            blob, expires_at = self._mint_ticket(peer_id)
+            extra = {"ticket": blob, "ticket_expires": expires_at}
         await self.node.send_message(
             peer_id,
             "ke_response",
@@ -1567,6 +1703,7 @@ class SecureMessaging:
             sig=sig,
             sig_algo=self.signature.name,
             sig_pk=self._sig_keypair[0],
+            **extra,
         )
 
     async def _fused_handle_ke_init(self, peer_id: str, msg: dict, data: dict,
@@ -1674,6 +1811,10 @@ class SecureMessaging:
         self.shared_keys[peer_id] = key
         self.ke_state[peer_id] = KeyExchangeState.CONFIRMED
         self._save_peer_key(peer_id, secret)
+        # the responder's resumption ticket rides this same frame: store
+        # it in the same instant the session becomes live (no window in
+        # which an interrupted session is established-but-ticketless)
+        self._accept_ticket(peer_id, msg, secret)
 
         confirm = {
             "message_id": message_id,
@@ -1783,8 +1924,11 @@ class SecureMessaging:
         if key is None:
             return
         try:
+            # bytes(): over the binary wire the ct is a zero-copy
+            # memoryview, which stdlib scalar AEADs cannot concatenate
             pt = self.symmetric.decrypt(
-                key, msg.get("ct", b""), str(msg.get("message_id", "")).encode()
+                key, bytes(msg.get("ct", b"")),
+                str(msg.get("message_id", "")).encode()
             )
         except ValueError:
             logger.warning("ke_test decrypt failed from %s", peer_id[:8])
@@ -1820,6 +1964,10 @@ class SecureMessaging:
         # this peer now has a completed session: its NEXT handshake (for
         # HAD_SESSION_TTL_S) is a re-key on the top-priority lane
         self._had_session[peer_id] = time.monotonic()
+        # the connection's resume window closes with establishment: any
+        # later handshake on this connection is an in-session rekey and
+        # runs the full KEM exchange for fresh entropy
+        self._resume_armed.discard(peer_id)
 
     def _save_peer_key(self, peer_id: str, secret: bytes) -> None:
         if self.key_storage is not None and getattr(self.key_storage, "is_unlocked", False):
@@ -1827,6 +1975,373 @@ class SecureMessaging:
                 self.key_storage.save_peer_shared_key(peer_id, secret, self.kem.name)
             except Exception:
                 logger.exception("failed to persist shared key")
+
+    # ------------------------------------------------- session resumption
+    #
+    # docs/protocol.md "Session resumption": after a confirmed full
+    # handshake the RESPONDER mints a STEK-sealed, self-contained ticket;
+    # a reconnect presents it for a 1-RTT abbreviated exchange (HKDF over
+    # the resumption secret + fresh nonces — no KEM, no signatures, no
+    # device dispatch).  Hostile/expired/replayed tickets fall back loudly
+    # to the full handshake, never to a stall; accepted resumes are
+    # admission-EXEMPT, which is what keeps admission control survivable
+    # during a reconnect storm (the gateway sheds full handshakes but
+    # admits cheap resumes).
+
+    def _resumption_negotiated(self, peer_id: str) -> bool:
+        """True when BOTH sides offered resumption in their hellos (the
+        same negotiation shape as the binary wire): an opted-out or older
+        peer never sees a ticket/resume frame — its wire stays
+        byte-identical to the pre-resumption protocol (pinned)."""
+        return self.resumption and self.node.peer_resumption(peer_id)
+
+    def _resume_allowed(self, peer_id: str) -> bool:
+        """A resume may be attempted only on a FRESH connection (armed by
+        the connect event, disarmed at establishment) with a live,
+        unexpired ticket from this peer."""
+        if not (self._resumption_negotiated(peer_id)
+                and peer_id in self._resume_armed):
+            return False
+        return self.ticket_for(peer_id) is not None
+
+    def ticket_for(self, peer_id: str) -> dict | None:
+        """The held (unexpired) resumption ticket entry for ``peer_id``,
+        or None.  Expired entries are dropped (secret wiped) here."""
+        entry = self._tickets.get(peer_id)
+        if entry is None:
+            return None
+        if entry["expires_at"] <= time.time():
+            self._drop_ticket(peer_id)
+            return None
+        return entry
+
+    def take_ticket(self, peer_id: str) -> dict | None:
+        """Remove and return the held ticket entry for ``peer_id`` (the
+        fleet-handoff transfer API: a ticket minted by a dead gateway is
+        presented to its ring successor, which shares the STEK)."""
+        return self._tickets.pop(peer_id, None)
+
+    def adopt_ticket(self, peer_id: str, entry: dict | None) -> None:
+        """Re-key a transferred ticket entry to a new peer (the successor
+        half of :meth:`take_ticket`)."""
+        if entry is not None:
+            self._drop_ticket(peer_id)
+            self._tickets[peer_id] = entry
+
+    def _drop_ticket(self, peer_id: str) -> None:
+        entry = self._tickets.pop(peer_id, None)
+        if entry is not None:
+            _wipe(entry["secret"])
+
+    def _store_ticket(self, peer_id: str, blob: bytes, expires_at: float,
+                      secret: bytes) -> None:
+        """Install a received ticket (bounded; oldest-expiry eviction with
+        secrets wiped — the client-side memory half of the ticket story)."""
+        self._drop_ticket(peer_id)
+        self._tickets[peer_id] = {
+            "ticket": blob,
+            "expires_at": expires_at,
+            "secret": bytearray(secret),
+        }
+        if len(self._tickets) > TICKET_CAP:
+            for pid, _e in sorted(self._tickets.items(),
+                                  key=lambda kv: kv[1]["expires_at"])[
+                    : TICKET_CAP // 2]:
+                self._drop_ticket(pid)
+
+    def _mint_ticket(self, peer_id: str) -> tuple[bytes, float]:
+        """Responder: seal a fresh ticket for ``peer_id``'s live session
+        (single-use nonce, current STEK, suite-bound) — attached to the
+        ke_response frame by :meth:`_respond_established`."""
+        secret = self.raw_secrets[peer_id]
+        rsec = derive_resumption_secret(bytes(secret), self.node_id, peer_id)
+        expires_at = time.time() + RESUME_TICKET_TTL_S
+        blob = self.tickets.seal_ticket(mint_fields(
+            peer_id, self.node_id, rsec, self.kem.name, self.symmetric.name,
+            self.signature.name, expires_at))
+        self._ctr_tickets_minted.inc()
+        obs_flight.record("ticket_minted", peer=peer_id[:8],
+                          epoch=self.tickets.current_epoch,
+                          expires_at=round(expires_at, 3))
+        return blob, expires_at
+
+    def _accept_ticket(self, peer_id: str, msg: dict, secret: bytes) -> None:
+        """Initiator: store the ticket riding a ke_response (with the
+        locally re-derived resumption secret) for the next reconnect."""
+        if not self._resumption_negotiated(peer_id):
+            return
+        blob = bytes(msg.get("ticket") or b"")
+        if not blob or len(blob) > 4096:
+            return
+        rsec = derive_resumption_secret(bytes(secret), peer_id, self.node_id)
+        self._store_ticket(peer_id, blob,
+                           float(msg.get("ticket_expires") or 0.0), rsec)
+        obs_flight.record("ticket_received", peer=peer_id[:8])
+
+    async def _resume_once(self, peer_id: str) -> str:
+        """One abbreviated 1-RTT resume attempt -> "ok" | a typed failure.
+        The held ticket is consumed either way (single-use): success
+        returns a fresh one, failure falls back to a full handshake whose
+        confirm mints a fresh one."""
+        with obs_trace.node_scope(self.node_id), \
+                obs_trace.span("handshake.resume", peer=peer_id[:8]) as sp, \
+                self._handshake_latency.time():
+            status = await self._resume_attempt(peer_id)
+            sp.set_attr("status", status)
+            return status
+
+    async def _resume_attempt(self, peer_id: str) -> str:
+        entry = self._tickets.pop(peer_id, None)
+        if entry is None:
+            return "no_ticket"
+        message_id = str(uuid.uuid4())
+        client_nonce = os.urandom(16).hex()
+        data = {
+            "message_id": message_id,
+            "sender": self.node_id,
+            "recipient": peer_id,
+            "timestamp": time.time(),
+            "client_nonce": client_nonce,
+            "aead": self.symmetric.name,
+        }
+        binder = resume_binder(bytes(entry["secret"]), _canonical(data),
+                               entry["ticket"])
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[message_id] = fut
+        self._resume_pending[message_id] = {
+            "peer": peer_id,
+            "secret": entry["secret"],
+            "client_nonce": client_nonce,
+        }
+        self.ke_state[peer_id] = KeyExchangeState.INITIATED
+        sent = await self.node.send_message(
+            peer_id, "ke_resume", resume_data=data, ticket=entry["ticket"],
+            binder=binder,
+        )
+        if not sent:
+            self._cleanup_resume(message_id, peer_id)
+            return "send_failed"
+        try:
+            await asyncio.wait_for(fut, KEY_EXCHANGE_TIMEOUT)
+            return "ok"
+        except asyncio.TimeoutError:
+            self._cleanup_resume(message_id, peer_id)
+            return "timeout"
+        except RuntimeError as e:
+            self._cleanup_resume(message_id, peer_id)
+            return getattr(e, "reason", "error")
+
+    def _cleanup_resume(self, message_id: str, peer_id: str) -> None:
+        ctx = self._resume_pending.pop(message_id, None)
+        if ctx is not None:
+            _wipe(ctx["secret"])
+        self._pending.pop(message_id, None)
+        if self.ke_state.get(peer_id) == KeyExchangeState.INITIATED:
+            self.ke_state[peer_id] = KeyExchangeState.NONE
+
+    async def _handle_ke_resume(self, peer_id: str, msg: dict) -> None:
+        """Responder: validate a presented ticket and run the abbreviated
+        exchange.  EVERY failure is a typed ``ke_resume_reject`` the
+        initiator maps to a full-handshake fallback — no plaintext, no
+        stall; accepted resumes bypass the handshake admission budget
+        (they are what admission control exists to protect)."""
+        data = msg.get("resume_data") or {}
+        message_id = str(data.get("message_id", "?"))
+        with obs_trace.span("handshake.resume_respond", peer=peer_id[:8]):
+            reason = await self._resume_respond(peer_id, msg, data,
+                                                message_id)
+        if reason is not None:
+            self._ctr_resume_rejects.inc()
+            logger.warning(
+                "ticket resume from %s rejected (%s); peer falls back to a "
+                "full handshake (%d rejected so far)",
+                peer_id[:8], reason, self._ctr_resume_rejects.value,
+            )
+            obs_flight.record("ticket_reject", peer=peer_id[:8],
+                              reason=reason)
+            await self.node.send_message(peer_id, "ke_resume_reject",
+                                         message_id=message_id,
+                                         reason=reason)
+
+    async def _resume_respond(self, peer_id: str, msg: dict, data: dict,
+                              message_id: str) -> str | None:
+        """-> None on success (reply sent), else the typed reject reason."""
+        if not self._resumption_negotiated(peer_id):
+            return "resumption_disabled"
+        if self.draining:
+            return "draining"
+        err = self._check_host(peer_id, data)
+        if err is not None:
+            return err.value
+        client_nonce = str(data.get("client_nonce", ""))
+        if not client_nonce or len(client_nonce) > 64:
+            return "malformed_ticket"
+        blob = bytes(msg.get("ticket") or b"")
+        # chaos seam (faults/plan.py "ticket" scope): a plan may corrupt
+        # the presented blob or force the expiry/replay verdicts — each
+        # exercises one typed reject + fallback path end-to-end
+        forced = _faults.ticket_validation(self.node_id, peer_id)
+        if "corrupt" in forced and blob:
+            doctored = bytearray(blob)
+            doctored[len(doctored) // 2] ^= 0xFF
+            blob = bytes(doctored)
+        try:
+            fields, rsec = self.tickets.open_ticket(blob)
+        except TicketError as e:
+            return e.reason
+        expires_at = float(fields.get("expires_at") or 0.0)
+        nonce = str(fields.get("nonce") or "")
+        if not nonce:
+            return "malformed_ticket"
+        if "expire" in forced or expires_at <= time.time():
+            return "expired_ticket"
+        if fields.get("holder") != peer_id:
+            return "holder_mismatch"
+        if (fields.get("kem"), fields.get("aead"), fields.get("sig")) != (
+                self.kem.name, self.symmetric.name, self.signature.name):
+            return "suite_mismatch"
+        want = resume_binder(rsec, _canonical(data), blob)
+        if not hmac.compare_digest(want, str(msg.get("binder", ""))):
+            return "bad_binder"
+        if "replay" in forced or self._replay.seen(nonce, expires_at,
+                                                   time.time()):
+            return "replayed_ticket"
+        # accepted: derive, install, re-mint (single-use), confirm — the
+        # whole exchange is host-side HKDF/HMAC, ~0 device-seconds (the
+        # cost ledger's resume probe pins that claim in the storm bench)
+        server_nonce = os.urandom(16).hex()
+        key = derive_resumed_key(rsec, client_nonce, server_nonce,
+                                 self.symmetric.name)
+        next_secret = ratchet_resumption_secret(rsec, client_nonce,
+                                                server_nonce)
+        fresh_expires = time.time() + RESUME_TICKET_TTL_S
+        fresh = self.tickets.seal_ticket(mint_fields(
+            peer_id, self.node_id, next_secret, self.kem.name,
+            self.symmetric.name, self.signature.name, fresh_expires))
+        self._adopt_secret(peer_id, rsec)
+        self.shared_keys[peer_id] = key
+        self.ke_state[peer_id] = KeyExchangeState.ESTABLISHED
+        self._ctr_resumes_ok.inc()
+        self._ctr_tickets_minted.inc()
+        obs_flight.record("ticket_resumed", peer=peer_id[:8],
+                          role="responder")
+        self._log("key_exchange", peer=peer_id, success=True,
+                  algorithm="ticket_resume", role="responder")
+        await self.node.send_message(
+            peer_id, "ke_resume_ok", message_id=message_id,
+            server_nonce=server_nonce,
+            confirm=resume_confirm_tag(key, message_id, client_nonce,
+                                       server_nonce),
+            ticket=fresh, expires_at=fresh_expires,
+        )
+        return None
+
+    async def _handle_ke_resume_ok(self, peer_id: str, msg: dict) -> None:
+        """Initiator: verify the responder's proof-of-secret, install the
+        resumed key, store the fresh ticket (ratcheted secret)."""
+        message_id = str(msg.get("message_id", ""))
+        ctx = self._resume_pending.get(message_id)
+        if ctx is None or ctx["peer"] != peer_id:
+            logger.warning("ke_resume_ok for unknown resume %s", message_id)
+            return
+        self._resume_pending.pop(message_id, None)
+        server_nonce = str(msg.get("server_nonce", ""))
+        rsec = bytes(ctx["secret"])
+        key = derive_resumed_key(rsec, ctx["client_nonce"], server_nonce,
+                                 self.symmetric.name)
+        want = resume_confirm_tag(key, message_id, ctx["client_nonce"],
+                                  server_nonce)
+        if not (server_nonce and len(server_nonce) <= 64
+                and hmac.compare_digest(want, str(msg.get("confirm", "")))):
+            _wipe(ctx["secret"])
+            self._fail_pending(message_id, "bad_confirm")
+            return
+        next_secret = ratchet_resumption_secret(rsec, ctx["client_nonce"],
+                                                server_nonce)
+        self._adopt_secret(peer_id, rsec)
+        _wipe(ctx["secret"])
+        self.shared_keys[peer_id] = key
+        self.ke_state[peer_id] = KeyExchangeState.ESTABLISHED
+        fresh = bytes(msg.get("ticket") or b"")
+        if fresh:
+            self._store_ticket(peer_id, fresh,
+                               float(msg.get("expires_at") or 0.0),
+                               next_secret)
+        self._ctr_resumes_used.inc()
+        obs_flight.record("ticket_resumed", peer=peer_id[:8],
+                          role="initiator")
+        self._log("key_exchange", peer=peer_id, success=True,
+                  algorithm="ticket_resume", role="initiator")
+        fut = self._pending.pop(message_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(True)
+
+    async def _handle_ke_resume_reject(self, peer_id: str, msg: dict) -> None:
+        """Initiator: a typed resume rejection — release the parked
+        context and fail the pending future (the caller falls back to the
+        full handshake, loudly)."""
+        message_id = str(msg.get("message_id", ""))
+        reason = str(msg.get("reason", "unknown"))[:64]
+        ctx = self._resume_pending.pop(message_id, None)
+        if ctx is not None:
+            _wipe(ctx["secret"])
+        if self.ke_state.get(peer_id) == KeyExchangeState.INITIATED:
+            self.ke_state[peer_id] = KeyExchangeState.NONE
+        self._fail_pending(message_id, reason)
+
+    # ---------------------------------------------------------- graceful drain
+
+    async def drain(self, reason: str = "drain") -> dict[str, Any]:
+        """Graceful drain (docs/robustness.md "Rolling restarts"): stop
+        admitting (new handshakes shed BUSY, resumes draw a typed
+        ``draining`` reject, /readyz answers 503), flush every healable
+        outbox, then nudge every connected peer (``ke_rehome``) to resume
+        on its ring successor — their held tickets make that reconnect a
+        cheap 1-RTT resume instead of a full-handshake storm.  Idempotent."""
+        if self.draining:
+            return {"reason": self.drain_reason, "already_draining": True}
+        self.draining = True
+        self.drain_reason = reason
+        peers = self.node.get_peers()
+        obs_flight.trigger("drain_started", node=self.node_id[:8],
+                           reason=reason, peers=len(peers))
+        flushed = 0
+        for peer_id in list(self._outbox):
+            queued = len(self._outbox.get(peer_id, ()))
+            if queued and self.verify_key_exchange_state(peer_id):
+                await self._flush_outbox(peer_id)
+                flushed += queued
+        nudged = 0
+        for peer_id in self.node.get_peers():
+            if await self.node.send_message(peer_id, "ke_rehome",
+                                            reason=reason):
+                nudged += 1
+        logger.warning(
+            "draining (%s): admission stopped; %d queued message(s) "
+            "flushed, %d peer(s) nudged to resume elsewhere",
+            reason, flushed, nudged,
+        )
+        obs_flight.record("drain_done", node=self.node_id[:8], nudged=nudged,
+                          flushed=flushed)
+        return {"reason": reason, "nudged": nudged, "flushed": flushed}
+
+    async def _handle_ke_rehome(self, peer_id: str, msg: dict) -> None:
+        """A peer announced it is draining: the disconnect that follows is
+        PLANNED — surfaced to listeners so apps can re-route proactively
+        (the fleet storm clients re-route on the drop either way; their
+        ticket makes the new gateway a 1-RTT resume)."""
+        reason = str(msg.get("reason", ""))[:64]
+        self._ctr_rehome_nudges.inc()
+        obs_flight.record("rehome_nudge", peer=peer_id[:8], reason=reason)
+        logger.info("peer %s is draining (%s); expect a planned disconnect",
+                    peer_id[:8], reason)
+        self._notify(peer_id, Message(
+            content=b"Peer draining: reconnect will resume via ticket",
+            sender_id=peer_id, recipient_id=self.node_id, is_system=True,
+            key_exchange_algo=self.kem.name,
+            symmetric_algo=self.symmetric.name,
+            signature_algo=self.signature.name,
+        ))
 
     # --------------------------------------------------------- secure message
 

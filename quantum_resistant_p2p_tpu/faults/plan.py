@@ -41,7 +41,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any
 
-SCOPES = ("net.send", "device.dispatch", "scalar.op", "warmup", "process")
+SCOPES = ("net.send", "device.dispatch", "scalar.op", "warmup", "process",
+          "ticket")
 ACTIONS = {
     "net.send": ("drop", "delay", "corrupt"),
     "device.dispatch": ("raise", "poison", "delay"),
@@ -52,8 +53,17 @@ ACTIONS = {
     # order on ONE loop — so rule counters advance on a deterministic event
     # stream and the injected log is byte-reproducible from the seed even
     # though the actions themselves are wall-clock chaos (a SIGKILL, a
-    # SIGSTOP, a dropped control link).
-    "process": ("kill_gateway", "pause_gateway", "partition"),
+    # SIGSTOP, a dropped control link).  ``drain_gateway`` runs the
+    # graceful-drain protocol mid-storm — composed with a kill rule on the
+    # next tick it is the drain-interrupt scenario.
+    "process": ("kill_gateway", "pause_gateway", "partition",
+                "drain_gateway"),
+    # ticket-scope faults (app/messaging.py ticket-resume validation): each
+    # action forces exactly one typed reject verdict on the responder —
+    # "corrupt" flips a byte of the presented blob (MAC failure),
+    # "expire"/"replay" force those verdicts — so chaos plans exercise
+    # every reject + full-handshake-fallback path end-to-end.
+    "ticket": ("corrupt", "expire", "replay"),
 }
 
 
@@ -244,6 +254,18 @@ class FaultPlan:
                 self._record(entry)
                 raise FaultInjected(f"injected warm-up kill for {label!r}")
 
+    def ticket_validation(self, node: str, peer: str) -> list[str]:
+        """-> the ticket-scope actions firing on this resume-validation
+        event (app/messaging.py applies them: corrupt the presented blob /
+        force the expired / replayed verdict).  Every fired entry is
+        recorded to ``injected``."""
+        out: list[str] = []
+        for _i, rule, entry in self._fire("ticket",
+                                          {"node": node, "peer": peer}):
+            self._record(entry)
+            out.append(rule.action)
+        return out
+
     def process_control(self, gateway: str) -> list[dict[str, Any]]:
         """-> the process-scope actions firing on this fleet-tick event.
 
@@ -376,6 +398,16 @@ def warmup(label: str) -> None:
     plan = _ACTIVE
     if plan is not None:
         plan.warmup(label)
+
+
+def ticket_validation(node: str, peer: str) -> list:
+    """Ticket-scope hook (app/messaging.py resume validation): the fired
+    corrupt/expire/replay actions for this presentation, [] without a
+    plan."""
+    plan = _ACTIVE
+    if plan is None:
+        return []
+    return plan.ticket_validation(node, peer)
 
 
 def process_control(gateway: str) -> list:

@@ -23,6 +23,23 @@ collide with application message types):
                        shard at fleet scope; one probe round-trip is the
                        evidence that lets it take ring ownership back.
 * ``__gw_probe_ok__``  gateway -> router: probe reply (echoes ``n``).
+* ``__gw_stek__``      router -> gateway: the fleet's session-ticket-
+                       encryption keys (current + previous — the dual-key
+                       rotation window), pushed on registration and on
+                       every rotation.  ONE ring per fleet is what lets a
+                       ticket minted by gw1 resume on gw2 after a handoff,
+                       and on the respawned gw1 after a rolling restart.
+                       The control link is the fleet's trusted channel
+                       (localhost/pod-internal by construction — see
+                       docs/fleet.md); key material never rides any
+                       peer-facing or observability surface.
+* ``__gw_drain__``     router -> gateway: GRACEFUL drain (also wired to
+                       SIGTERM in the gateway): stop admitting (/readyz
+                       goes 503 draining), flush outboxes, nudge peers to
+                       resume on their ring successor (``ke_rehome``),
+                       write the slo report, send ``__gw_bye__``, exit 0.
+                       The planned half of a rolling restart — vs
+                       ``__gw_stop__``, the fast teardown.
 * ``__gw_stop__``      router -> gateway: drain and exit; the gateway
                        writes its per-node ``slo_report.json`` first.
 * ``__gw_bye__``       gateway -> router: final stats before exit.
@@ -49,6 +66,8 @@ GW_HELLO = "__gw_hello__"
 GW_HEARTBEAT = "__gw_heartbeat__"
 GW_PROBE = "__gw_probe__"
 GW_PROBE_OK = "__gw_probe_ok__"
+GW_TICKET_KEYS = "__gw_stek__"
+GW_DRAIN = "__gw_drain__"
 GW_STOP = "__gw_stop__"
 GW_BYE = "__gw_bye__"
 ROUTE = "__route__"

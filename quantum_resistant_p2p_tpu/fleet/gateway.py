@@ -35,6 +35,7 @@ import asyncio
 import json
 import logging
 import os
+import signal
 import sys
 from pathlib import Path
 from typing import Any
@@ -89,6 +90,12 @@ def _engine_stats(engine, received: int) -> dict[str, Any]:
         "handshake_attempts": engine._handshake_latency.count,
         "telemetry_port": engine.telemetry_port,
         "cost": engine.cost.totals(),
+        # the resumption/drain surface (the router's /fleet view and the
+        # roll-storm report read these per gateway)
+        "draining": engine.draining,
+        "tickets_minted": engine._ctr_tickets_minted.value,
+        "resumes_ok": engine._ctr_resumes_ok.value,
+        "resume_rejects": engine._ctr_resume_rejects.value,
     }
     total = fb = 0
     for fam in ("kem_queue", "sig_queue", "fused_queue"):
@@ -198,10 +205,37 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
                     return
 
         hb_task = asyncio.create_task(heartbeat())
+        # graceful drain triggers: the router's __gw_drain__ verb OR a
+        # SIGTERM (a rolling restart / orchestrator shutdown delivers
+        # SIGTERM — a PLANNED restart must not look like a crash).  The
+        # event is select()ed against the control read below.
+        drain_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        sigterm_armed = False
+        if cfg.get("own_process"):
+            # subprocess mode only (main() sets the flag): an in-process
+            # task gateway must not steal the driver's SIGTERM handling
+            try:
+                loop.add_signal_handler(signal.SIGTERM, drain_ev.set)
+                sigterm_armed = True
+            except (NotImplementedError, ValueError, RuntimeError):
+                pass  # non-main thread / platform without signal support
         try:
+            drained = False
             while not stop_ev.is_set():
+                read_t = asyncio.ensure_future(control.read_ctrl(reader))
+                drain_t = asyncio.ensure_future(drain_ev.wait())
                 try:
-                    msg = await control.read_ctrl(reader)
+                    await asyncio.wait({read_t, drain_t},
+                                       return_when=asyncio.FIRST_COMPLETED)
+                finally:
+                    drain_t.cancel()
+                if not read_t.done():
+                    read_t.cancel()
+                    drained = True
+                    break
+                try:
+                    msg = read_t.result()
                 except (asyncio.IncompleteReadError, ConnectionError, OSError):
                     break  # router gone: drain and exit
                 mtype = msg.get("type")
@@ -213,10 +247,32 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
                         })
                     except (ConnectionError, OSError):
                         break  # router gone mid-probe: drain and exit
+                elif mtype == control.GW_TICKET_KEYS:
+                    # the fleet's ticket-sealing keys (current + previous):
+                    # replace the engine's private ring so tickets minted
+                    # ANYWHERE in the fleet resume here
+                    try:
+                        engine.tickets.install([
+                            (str(epoch), bytes.fromhex(str(key_hex)))
+                            for epoch, key_hex in (msg.get("keys") or [])
+                        ])
+                    except (ValueError, TypeError):
+                        logger.warning("gateway %s: malformed STEK push "
+                                       "ignored", gid)
+                elif mtype == control.GW_DRAIN:
+                    drained = True
+                    break
                 elif mtype == control.GW_STOP:
                     break
-            # graceful drain: per-node SLO report first (the fleet merge
-            # input), then the final stats frame
+            if drained or drain_ev.is_set():
+                # the graceful-drain protocol (app/messaging.py): stop
+                # admitting (/readyz -> 503 draining), flush outboxes,
+                # nudge every peer to resume — via ticket — on its ring
+                # successor; then fall through to the report/bye path
+                await engine.drain(
+                    reason="sigterm" if drain_ev.is_set() else "router")
+            # per-node SLO report first (the fleet merge input), then the
+            # final stats frame
             stop_ev.set()
             report_dir = cfg.get("report_dir")
             if report_dir:
@@ -242,6 +298,11 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
             # peers see the drop immediately
             stop_ev.set()
             hb_task.cancel()
+            if sigterm_armed:
+                try:
+                    loop.remove_signal_handler(signal.SIGTERM)
+                except (NotImplementedError, ValueError, RuntimeError):
+                    pass
             engine.stop_telemetry()
             writer.close()
             await node.stop()
@@ -258,6 +319,8 @@ def main(argv: list[str] | None = None) -> int:
     if not blob.lstrip().startswith("{") and Path(blob).is_file():
         blob = Path(blob).read_text()
     cfg = json.loads(blob)
+    # this process IS the gateway: SIGTERM means "drain gracefully"
+    cfg["own_process"] = True
     logging.basicConfig(level=logging.WARNING)
     asyncio.run(run_gateway(cfg))
     return 0

@@ -55,6 +55,7 @@ import time
 from pathlib import Path
 from typing import Any, Callable
 
+from ..app.resumption import STEKRing
 from ..faults import plan as _faults
 from ..obs import flight as obs_flight
 from ..obs import slo as obs_slo
@@ -92,6 +93,8 @@ class GatewayMember:
                  clock: Callable[[], float] = time.monotonic):
         self.gateway_id = gateway_id
         self.index = index
+        self._cooloffs = (cooloff_s, cooloff_max_s)
+        self._clock = clock
         #: fleet-scope breaker: the provider-layer state machine reused at
         #: the second placement level (module docstring)
         self.breaker = Breaker(cooloff_s, cooloff_max_s, clock=clock)
@@ -128,12 +131,46 @@ class GatewayMember:
         #: excluded from routing and probing
         self.stopped = False
         self.killed = False
+        #: True while a graceful drain / rolling restart owns this member:
+        #: excluded from routing and from death-detection (the exit is
+        #: PLANNED — declaring it dead would be noise), cleared when the
+        #: respawned process re-registers
+        self.draining = False
+        #: rolling restarts survived (snapshot bookkeeping)
+        self.restarts = 0
         self._probe_fut: asyncio.Future | None = None
         self._probe_n = 0
 
     @property
     def registered(self) -> bool:
         return self.port is not None
+
+    def reset_for_respawn(self) -> None:
+        """Forget the dead incarnation's transport/liveness state so the
+        respawned process registers like a fresh member — ring arc,
+        identity, and cumulative route counters unchanged; the fleet
+        breaker is rebuilt closed (a planned restart is not failure
+        evidence)."""
+        self.proc = None
+        self.task = None
+        self.writer = None
+        self.port = None
+        self.pid = None
+        self.telemetry_port = None
+        self.last_hb = None
+        self.final_stats = None
+        self.stats = {}
+        self.slo_totals = {}
+        self.killed = False
+        self.stopped = False
+        self._probe_fut = None
+        self._probe_n = 0
+        self.inflight = 0
+        self.routed_since_hb = 0
+        self.routed_prev_hb = 0
+        self.restarts += 1
+        self.breaker = Breaker(*self._cooloffs, clock=self._clock)
+        self.breaker.label = self.gateway_id
 
     def snapshot(self) -> dict[str, Any]:
         b = self.breaker
@@ -150,6 +187,8 @@ class GatewayMember:
             "breaker_closes": b.closes,
             "killed": self.killed,
             "stopped": self.stopped,
+            "draining": self.draining,
+            "restarts": self.restarts,
             "telemetry_port": self.telemetry_port,
             "stats": self.stats,
         }
@@ -178,6 +217,7 @@ class GatewayFleet:
         clock: Callable[[], float] = time.monotonic,
         register_timeout: float = 60.0,
         telemetry_port: int | None = None,
+        ticket_key_rotation_s: float = 0.0,
     ):
         if spawn not in ("process", "task"):
             raise ValueError(f"spawn must be 'process' or 'task', got {spawn!r}")
@@ -220,6 +260,17 @@ class GatewayFleet:
         self.rebalance_picks = 0
         self.handoffs = 0
         self._last_healthy: frozenset[str] = frozenset(ids)
+        #: the fleet's authoritative session-ticket-encryption keys
+        #: (app/resumption.py STEKRing: current + previous = the dual-key
+        #: accept window), pushed to every gateway over the control link
+        #: on registration and on rotation — one ring per fleet is what
+        #: makes a ticket minted by gw1 resume on gw2 after a handoff
+        self.ticket_keys = STEKRing()
+        #: automatic rotation cadence on the injected clock (0 = manual
+        #: rotation only via rotate_stek())
+        self.ticket_key_rotation_s = ticket_key_rotation_s
+        self._last_key_rotation_t = clock()
+        self.key_rotations = 0
         self.registry = Registry(name="fleet")
         self.slo = self._build_slo_engine()
         #: router-side telemetry (obs/http.py): None = off (the default).
@@ -495,7 +546,29 @@ class GatewayFleet:
         member.telemetry_port = int(tport) if tport is not None else None
         member.writer = writer
         member.last_hb = self._clock()
+        member.draining = False  # a respawned member is serving again
         logger.info("gateway %s registered (p2p port %s)", gid, member.port)
+        # push the fleet STEK ring FIRST: a gateway must never mint (or
+        # refuse) tickets under its private random ring once it is part
+        # of a fleet — and a respawned gateway needs the ring before its
+        # first resume arrives, or every pre-restart ticket would draw
+        # unknown_stek instead of resuming
+        try:
+            await control.send_ctrl(writer, {
+                "type": control.GW_TICKET_KEYS,
+                "keys": self.ticket_keys.export(),
+            })
+        except (ConnectionError, OSError):
+            # the gateway died between hello and the push: undo the
+            # registration state set above — a half-registered member
+            # (port set, writer dead) would be routable, would satisfy
+            # restart_member's registered check, and would stall
+            # start()'s all-registered event
+            member.port = None
+            member.writer = None
+            member.last_hb = None
+            writer.close()
+            return
         self._fire("registered", gid)
         if all(m.registered for m in self.members.values()):
             self._registered_ev.set()
@@ -573,8 +646,17 @@ class GatewayFleet:
                 continue
             for entry in _faults.process_control(member.gateway_id):
                 self._apply_chaos(member, entry)
+        # automatic STEK rotation (dual-key window: the demoted key still
+        # opens tickets minted just before the rotation)
+        if (self.ticket_key_rotation_s
+                and now - self._last_key_rotation_t
+                >= self.ticket_key_rotation_s):
+            self._last_key_rotation_t = now
+            self._spawn(self.rotate_stek(), "stek rotation")
         for member in self._members_sorted():
-            if member.stopped or member.last_hb is None:
+            if member.stopped or member.draining or member.last_hb is None:
+                # a draining member's exit is PLANNED (rolling restart):
+                # declaring it dead would flap the breaker for noise
                 continue
             missed_for = now - member.last_hb
             if (member.breaker.state == "closed"
@@ -595,7 +677,8 @@ class GatewayFleet:
         # probe routing through the SHARED placement policy: select_slot
         # prefers a probe-eligible slot — at fleet scope the unit of work
         # it receives is a control canary, never a client session
-        live = [m for m in self._members_sorted() if not m.stopped]
+        live = [m for m in self._members_sorted()
+                if not m.stopped and not m.draining]
         slot = select_slot(live)
         if slot is None or not slot.breaker.probe_ready():
             return
@@ -617,6 +700,12 @@ class GatewayFleet:
         elif action == "partition":
             self.partition(member.gateway_id,
                            float(entry.get("delay_s", 1.0)))
+        elif action == "drain_gateway":
+            # graceful-drain chaos: the gateway runs the full drain
+            # protocol mid-storm (a kill rule on a later tick makes this
+            # the drain-interrupt scenario)
+            self._spawn(self.drain(member.gateway_id),
+                        f"chaos drain:{member.gateway_id}")
 
     async def _probe_call(self, member: GatewayMember, n: int) -> None:
         """ONE half-open canary round-trip: send ``__gw_probe__``, await
@@ -663,7 +752,8 @@ class GatewayFleet:
     def _note_rebalance(self) -> None:
         healthy = frozenset(
             m.gateway_id for m in self.members.values()
-            if not m.stopped and m.breaker.state == "closed")
+            if not m.stopped and not m.draining
+            and m.breaker.state == "closed")
         if healthy != self._last_healthy:
             obs_flight.record(
                 "fleet_rebalance", healthy=sorted(healthy),
@@ -686,7 +776,8 @@ class GatewayFleet:
         if not self.per_gateway_max_peers:
             return None
         healthy = sum(1 for m in self.members.values()
-                      if not m.stopped and m.breaker.state == "closed")
+                      if not m.stopped and not m.draining
+                      and m.breaker.state == "closed")
         return self.per_gateway_max_peers * healthy
 
     def route(self, peer_id: str,
@@ -708,7 +799,8 @@ class GatewayFleet:
             # re-routed — charging them against the shrunken budget would
             # over-shed during exactly the handoff window
             inflight = sum(m.inflight for m in self.members.values()
-                           if not m.stopped and m.breaker.state == "closed")
+                           if not m.stopped and not m.draining
+                           and m.breaker.state == "closed")
             if inflight >= budget:
                 self.route_sheds += 1
                 if self.route_sheds == 1 or self.route_sheds % 64 == 0:
@@ -727,7 +819,8 @@ class GatewayFleet:
             if owner is None:
                 owner = gid
             member = self.members[gid]
-            if gid in exclude or member.stopped or not member.registered:
+            if (gid in exclude or member.stopped or member.draining
+                    or not member.registered):
                 continue
             if member.breaker.state == "closed":
                 chosen = member
@@ -741,7 +834,7 @@ class GatewayFleet:
             # freshly dead; its probe is the health loop's job), falling
             # back to anyone only when every survivor is probe-ready.
             pool = [m for m in self._members_sorted()
-                    if not m.stopped and m.registered
+                    if not m.stopped and not m.draining and m.registered
                     and m.gateway_id not in exclude]
             non_probe = [m for m in pool if not m.breaker.probe_ready()]
             chosen = select_slot(non_probe or pool)
@@ -762,6 +855,135 @@ class GatewayFleet:
         member = self.members.get(gateway_id)
         if member is not None and member.inflight > 0:
             member.inflight -= 1
+
+    # -- STEK rotation / graceful drain / rolling restart ---------------------
+
+    async def rotate_stek(self) -> str:
+        """Rotate the fleet's ticket-sealing key (the old current stays in
+        the accept window) and push the new ring to every live gateway.
+        Returns the new epoch.  Tickets minted before the PREVIOUS
+        rotation stop resuming — the documented forward-secrecy bound."""
+        epoch = self.ticket_keys.rotate()
+        self.key_rotations += 1
+        obs_flight.record("stek_rotated", epoch=epoch,
+                          rotations=self.key_rotations)
+        logger.warning("fleet STEK rotated (epoch %s); pushing to %d "
+                       "gateway(s)", epoch, len(self.members))
+        for member in self._members_sorted():
+            if member.writer is None or member.stopped:
+                continue
+            try:
+                await control.send_ctrl(member.writer, {
+                    "type": control.GW_TICKET_KEYS,
+                    "keys": self.ticket_keys.export(),
+                })
+            except (ConnectionError, OSError, RuntimeError):
+                # a dying gateway misses the push; re-registration (or the
+                # respawn after its restart) re-sends the current ring
+                logger.warning("STEK push to %s failed", member.gateway_id)
+        return epoch
+
+    async def drain(self, gateway_id: str) -> None:
+        """Ask one gateway to drain gracefully: it stops admitting,
+        flushes outboxes, nudges its peers to resume on their ring
+        successor, writes its slo report, and exits 0.  The member is
+        excluded from routing (and death detection) until it — or its
+        respawned successor — re-registers."""
+        member = self.members[gateway_id]
+        member.draining = True
+        obs_flight.record("fleet_gateway_drain", gateway=gateway_id)
+        logger.warning("draining gateway %s (routing excluded)", gateway_id)
+        if member.writer is not None:
+            try:
+                await control.send_ctrl(member.writer,
+                                        {"type": control.GW_DRAIN})
+            except (ConnectionError, OSError, RuntimeError):
+                pass  # already dying; the exit path is the same
+
+    async def _await_exit(self, member: GatewayMember,
+                          timeout: float) -> bool:
+        """Wait for a draining gateway to exit; escalate to SIGKILL/cancel
+        on timeout.  True = exited within the grace window."""
+        if member.proc is not None:
+            try:
+                await asyncio.wait_for(member.proc.wait(), timeout)
+                return True
+            except asyncio.TimeoutError:
+                logger.warning("gateway %s ignored drain for %.1fs; killing",
+                               member.gateway_id, timeout)
+                member.proc.kill()
+                await member.proc.wait()
+                return False
+        if member.task is not None:
+            try:
+                await asyncio.wait_for(member.task, timeout)
+                return True
+            except asyncio.TimeoutError:
+                member.task.cancel()
+                return False
+            except asyncio.CancelledError:
+                return True  # chaos already cancelled it
+            except Exception:
+                logger.exception("gateway %s task died during drain",
+                                 member.gateway_id)
+                return True
+        return True
+
+    async def _await_registered(self, member: GatewayMember,
+                                timeout: float) -> bool:
+        """Poll (real time — respawn is a wall-clock operation) until the
+        respawned member's hello lands."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if member.registered:
+                return True
+            await asyncio.sleep(0.05)
+        return member.registered
+
+    async def restart_member(self, gateway_id: str,
+                             drain_timeout: float = 30.0) -> dict[str, Any]:
+        """Gracefully restart ONE gateway: drain -> wait for exit ->
+        respawn -> wait for re-registration (the STEK ring rides the
+        re-registration hello, so pre-restart tickets resume on the new
+        process)."""
+        member = self.members[gateway_id]
+        t0 = time.monotonic()
+        await self.drain(gateway_id)
+        graceful = await self._await_exit(member, drain_timeout)
+        member.reset_for_respawn()
+        await self._spawn_member(member)
+        registered = await self._await_registered(member,
+                                                  self._register_timeout)
+        out = {
+            "gateway": gateway_id,
+            "graceful_exit": graceful,
+            "registered": registered,
+            "took_s": round(time.monotonic() - t0, 3),
+        }
+        obs_flight.record("fleet_gateway_restarted", **out)
+        if not registered:
+            logger.error("gateway %s never re-registered after restart",
+                         gateway_id)
+        return out
+
+    async def rolling_restart(self,
+                              drain_timeout: float = 30.0) -> dict[str, Any]:
+        """Restart the whole fleet one gateway at a time (docs/robustness.md
+        "Rolling restarts"): each member is drained (its peers nudged to
+        resume — via ticket — on the ring successor), awaited, respawned,
+        and re-registered before the next begins, so the fleet never loses
+        more than one gateway of capacity and every moved session resumes
+        for two HKDFs instead of a full handshake."""
+        results = []
+        for gateway_id in sorted(self.members):
+            if self.members[gateway_id].stopped:
+                continue
+            results.append(await self.restart_member(gateway_id,
+                                                     drain_timeout))
+        ok = all(r["registered"] for r in results)
+        obs_flight.record("fleet_rolling_restart",
+                          gateways=[r["gateway"] for r in results], ok=ok)
+        return {"restarted": results, "ok": ok}
 
     def _route_reply(self, msg: dict) -> dict:
         peer_id = str(msg.get("peer_id", ""))
@@ -892,6 +1114,8 @@ class GatewayFleet:
             "rebalance_picks": self.rebalance_picks,
             "handoffs": self.handoffs,
             "fleet_budget": self.fleet_budget(),
+            "stek_epoch": self.ticket_keys.current_epoch,
+            "stek_rotations": self.key_rotations,
             "members": [m.snapshot() for m in self._members_sorted()],
         }
 

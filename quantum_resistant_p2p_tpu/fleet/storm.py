@@ -35,6 +35,7 @@ import logging
 import random
 import tempfile
 import time
+from collections import deque
 from pathlib import Path
 from typing import Any
 
@@ -79,6 +80,10 @@ async def run_fleet_storm(
     report_dir: str | Path | None = None,
     telemetry: bool = False,
     scrape_cb=None,
+    roll: bool = False,
+    roll_delay_s: float = 3.0,
+    drain_timeout: float = 30.0,
+    msg_interval_s: float = 0.0,
 ) -> dict[str, Any]:
     """One seeded fleet storm; returns the JSON-ready report.
 
@@ -88,7 +93,16 @@ async def run_fleet_storm(
     ``tools.qrtop.snapshot_endpoints`` — is called WHILE the gateways
     are still alive (just before drain) with ``{gateway_id: "host:port"}``
     and its return value lands in the report as ``cost_snapshot`` (the
-    committed ``fleet_storm_cost_snapshot.json`` artifact)."""
+    committed ``fleet_storm_cost_snapshot.json`` artifact).
+
+    ``roll=True`` runs a mid-storm ROLLING RESTART of every gateway
+    (``GatewayFleet.rolling_restart`` — each drained, SIGTERM-style, then
+    respawned) ``roll_delay_s`` after the first session launches.  A
+    displaced session carries its resumption ticket to wherever the ring
+    re-routes it, so post-restart reconnects are cheap 1-RTT resumes —
+    the report splits them (``post_roll_resumed`` vs ``post_roll_full``)
+    and the ``--roll`` ratchet gates on a >=90% resume rate (the
+    committed ``fleet_roll_r0N.json`` artifact)."""
     register_storm_providers()
     from ..app.messaging import SecureMessaging
     from ..net.p2p_node import P2PNode
@@ -133,6 +147,16 @@ async def run_fleet_storm(
     route_busy = 0
     msgs_delivered = 0
     first_lat: list[float] = []
+    # resumption accounting: reconnects of ALREADY-established sessions,
+    # split by whether the ticket resumed (vs a full re-handshake) and by
+    # whether they happened after the rolling restart began — the >=90%
+    # post-restart resume rate is the roll ratchet's acceptance currency
+    resumed_reconnects = 0
+    full_reconnects = 0
+    post_roll_resumed = 0
+    post_roll_full = 0
+    reconnects_no_ticket = [0]
+    roll_state: dict[str, Any] = {"t0": None, "report": None}
 
     proto = None
     with storm_env(ke_timeout, fd_need=4 * sessions + 128):
@@ -200,25 +224,46 @@ async def run_fleet_storm(
                         await asyncio.sleep(delay)
                         delay *= 2
                         continue
-                    return None  # NO_ROUTE: nothing routable
+                    # NO_ROUTE is TRANSIENT during a rolling restart (one
+                    # gateway draining + one freshly dead can empty the
+                    # pool for a beat): back off and re-ask — only a
+                    # fleet that stays unroutable through the retry
+                    # budget gives up
+                    await asyncio.sleep(delay)
+                    delay *= 2
                 return None
 
-            async def one_session(i: int, start_at: float,
-                                  t_origin: float) -> None:
+            async def one_session(i: int, start_at: float, t_origin: float,
+                                  srng: random.Random) -> None:
                 nonlocal established_sessions, completed, failures
                 nonlocal lost_established, handoffs, handshake_failures
-                nonlocal msgs_delivered
+                nonlocal msgs_delivered, resumed_reconnects, full_reconnects
+                nonlocal post_roll_resumed, post_roll_full
                 delay = start_at - (time.perf_counter() - t_origin)
                 if delay > 0:
                     await asyncio.sleep(delay)
                 async with sem:
                     peer_id = f"peer{i:05d}"
                     sm = make_client(i)
-                    exclude: list[str] = []
+                    # bounded exclude (the most recent death only): under a
+                    # ROLLING restart every gateway fails once — excluding
+                    # more than the latest failure can transiently exclude
+                    # every survivor and manufacture NO_ROUTE for itself
+                    exclude: deque = deque(maxlen=1)
                     was_established = False
+                    #: which gateway the held ticket is keyed under — NOT
+                    #: simply the previous route target: an intermediate
+                    #: failed handshake must not orphan the ticket minted
+                    #: by the gateway before it
+                    ticket_gid: str | None = None
                     delivered = 0
                     for attempt in range(session_attempts):
-                        reply = await route(peer_id, exclude)
+                        if attempt:
+                            # seeded, bounded reroute jitter: N clients of
+                            # one dead/drained gateway must not hammer the
+                            # ring successor in the same tick
+                            await asyncio.sleep(srng.uniform(0.0, 0.25))
+                        reply = await route(peer_id, list(exclude))
                         if reply is None:
                             break
                         gid = reply["gateway"]
@@ -230,8 +275,17 @@ async def run_fleet_storm(
                             await control.route_done(fleet.host, fleet.ctrl_port,
                                                      gid)
                             continue
+                        if ticket_gid is not None and ticket_gid != gid:
+                            # fleet handoff: the ticket the dead/drained
+                            # gateway minted resumes on the successor (one
+                            # STEK ring per fleet)
+                            sm.adopt_ticket(gid, sm.take_ticket(ticket_gid))
+                            ticket_gid = gid
+                        had_ticket = sm.ticket_for(gid) is not None
                         t0 = time.perf_counter()
+                        r0 = sm._ctr_resumes_used.value
                         ok = await sm.initiate_key_exchange(gid)
+                        resumed = sm._ctr_resumes_used.value > r0
                         if not ok:
                             handshake_failures += 1
                             await control.route_done(fleet.host, fleet.ctrl_port,
@@ -242,10 +296,30 @@ async def run_fleet_storm(
                                 # arc to the ring successor
                                 exclude.append(gid)
                             continue
-                        if not was_established:
+                        if was_established:
+                            # a reconnect of a live session: the resume-vs-
+                            # full split is the roll ratchet's currency
+                            after_roll = (roll_state["t0"] is not None
+                                          and t0 >= roll_state["t0"])
+                            if resumed:
+                                resumed_reconnects += 1
+                                post_roll_resumed += 1 if after_roll else 0
+                            else:
+                                full_reconnects += 1
+                                post_roll_full += 1 if after_roll else 0
+                                if not had_ticket:
+                                    # diagnostic split: a full reconnect
+                                    # WITH a ticket means a reject/timeout
+                                    # (investigate); without one it is the
+                                    # mint-delivery race at establishment
+                                    reconnects_no_ticket[0] += 1
+                        else:
                             first_lat.append(time.perf_counter() - t0)
                             established_sessions += 1
                             was_established = True
+                        # the just-established gateway minted (or will
+                        # refresh) this session's ticket
+                        ticket_gid = gid
                         while delivered < msgs_per_session:
                             sent = await sm.send_message(
                                 gid, b"fleet storm %d/%d" % (i, delivered))
@@ -253,6 +327,12 @@ async def run_fleet_storm(
                                 break
                             delivered += 1
                             msgs_delivered += 1
+                            if msg_interval_s:
+                                # paced traffic: sessions LIVE long enough
+                                # to be displaced by a mid-storm restart —
+                                # back-to-back sends finish in microseconds
+                                # and prove nothing about displacement
+                                await asyncio.sleep(msg_interval_s)
                         if delivered >= msgs_per_session:
                             completed += 1
                             await control.route_done(fleet.host, fleet.ctrl_port,
@@ -274,15 +354,33 @@ async def run_fleet_storm(
                     t += rng.uniform(0.0, 2.0 / arrival_rate)
                 offsets.append(t)
 
+            session_rngs = [random.Random(rng.getrandbits(64))
+                            for _ in range(sessions)]
             plan = FaultPlan(seed, list(fault_rules)) if fault_rules else None
             ctx = plan.activate() if plan is not None else None
             if ctx is not None:
                 ctx.__enter__()
             t_origin = time.perf_counter()
+            roll_task = None
+            if roll:
+                async def _roll() -> None:
+                    # mid-storm rolling restart: drain -> respawn -> re-
+                    # register every gateway in turn while the sessions run
+                    await asyncio.sleep(roll_delay_s)
+                    roll_state["t0"] = time.perf_counter()
+                    roll_state["report"] = await fleet.rolling_restart(
+                        drain_timeout=drain_timeout)
+
+                roll_task = asyncio.create_task(_roll())
             try:
-                await asyncio.gather(*(one_session(i, offsets[i], t_origin)
-                                       for i in range(sessions)))
+                await asyncio.gather(*(
+                    one_session(i, offsets[i], t_origin, session_rngs[i])
+                    for i in range(sessions)))
+                if roll_task is not None:
+                    await roll_task
             finally:
+                if roll_task is not None:
+                    roll_task.cancel()
                 if ctx is not None:
                     ctx.__exit__(None, None, None)
             elapsed = time.perf_counter() - t_origin
@@ -381,6 +479,26 @@ async def run_fleet_storm(
         "handshake_failures": handshake_failures,
         "route_busy": route_busy,
         "msgs_delivered": msgs_delivered,
+        # reconnects of established sessions, split resume-vs-full (and by
+        # whether they fell after the rolling restart began): the ticket
+        # machinery's acceptance currency (docs/protocol.md "Session
+        # resumption"; the --roll ratchet gates on the post-roll rate)
+        "resumed_reconnects": resumed_reconnects,
+        "full_handshake_reconnects": full_reconnects,
+        "ticket_resume_rate": (
+            round(resumed_reconnects / (resumed_reconnects + full_reconnects),
+                  4) if (resumed_reconnects + full_reconnects) else None),
+        "post_roll_resumed": post_roll_resumed,
+        "post_roll_full": post_roll_full,
+        "post_roll_resume_rate": (
+            round(post_roll_resumed / (post_roll_resumed + post_roll_full), 4)
+            if (post_roll_resumed + post_roll_full) else None),
+        "full_reconnects_without_ticket": reconnects_no_ticket[0],
+        "client_resumes_used": sum(
+            sm._ctr_resumes_used.value for sm in clients),
+        "client_resume_fallbacks": sum(
+            sm._ctr_resume_fallbacks.value for sm in clients),
+        "roll": roll_state["report"],
         # the engine refuses to send without a shared key (fail-closed,
         # tests/test_faults.py pins it) and this harness only sends
         # through send_message — plaintext on the wire is structurally

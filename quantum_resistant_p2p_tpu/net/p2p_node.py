@@ -44,6 +44,7 @@ import base64
 import json
 import logging
 import os
+import random
 import struct
 import uuid
 from dataclasses import dataclass, field
@@ -74,6 +75,19 @@ _BIN_WIRE_NAME = "bin1"
 _BIN_KIND_RAW = 0
 _BIN_KIND_JSON = 1
 
+#: session-resumption negotiation token (docs/protocol.md "Session
+#: resumption"): offered in the hello exactly like the wire format —
+#: tickets/resume frames flow only when BOTH sides offered, so an
+#: opted-out (``QRP2P_RESUMPTION=0``) or older peer sees byte-identical
+#: pre-resumption frames (pinned by tests/test_resumption.py)
+_RESUME_NAME = "tik1"
+
+#: bounded reconnect jitter (seconds): N clients of one dead gateway must
+#: not redial its ring successor in the same tick — each reconnect sleeps
+#: a seeded uniform [0, this) before dialing (docs/robustness.md
+#: "Reconnect thundering herd")
+RECONNECT_JITTER_S = 0.25
+
 MessageHandler = Callable[[str, dict], Awaitable[None]]
 ConnectionHandler = Callable[[str, str], None]  # (event, peer_id)
 
@@ -97,6 +111,13 @@ class WireError(ValueError):
 def binary_wire_default() -> bool:
     """``QRP2P_BINARY_WIRE`` policy: offer the binary wire unless ``0``."""
     return os.environ.get("QRP2P_BINARY_WIRE", "1") != "0"
+
+
+def resumption_offer_default() -> bool:
+    """``QRP2P_RESUMPTION`` policy: offer ticket resumption unless ``0``
+    (the transport-side twin of ``app.resumption.resumption_default`` —
+    kept local so net/ never imports the app layer)."""
+    return os.environ.get("QRP2P_RESUMPTION", "1") != "0"
 
 
 def _encode_bin(message: dict) -> list:
@@ -186,6 +207,8 @@ class _Peer:
     #: negotiated wire format: "json" (compat default) or "bin1" (both
     #: sides offered it in the hello exchange)
     wire: str = "json"
+    #: session resumption negotiated (both sides offered "tik1")
+    resume: bool = False
 
 
 class P2PNode:
@@ -201,6 +224,8 @@ class P2PNode:
         max_peers: int = 0,
         accept_backlog: int = 256,
         binary_wire: bool | None = None,
+        resumption: bool | None = None,
+        jitter_rng: "random.Random | None" = None,
     ):
         if node_id is None:
             from .identity import load_or_generate_node_id
@@ -240,6 +265,22 @@ class P2PNode:
         #: None reads QRP2P_BINARY_WIRE (default: offer).
         self.binary_wire = (binary_wire_default() if binary_wire is None
                             else binary_wire)
+        #: offer session-resumption tickets in hellos (the session layer
+        #: only mints/presents for peers where BOTH sides offered).
+        #: None reads QRP2P_RESUMPTION (default: offer).
+        self.resumption = (resumption_offer_default() if resumption is None
+                           else resumption)
+        #: seeded reconnect-jitter RNG: derived from a digest of the FULL
+        #: node id (a raw prefix would hand every 'peerNNNNN'-style id
+        #: sharing 8 leading bytes the SAME stream — re-synchronizing
+        #: exactly the reconnect wave the jitter exists to spread);
+        #: injectable so tests pin the exact jitter sequence
+        if jitter_rng is None:
+            import hashlib
+
+            jitter_rng = random.Random(int.from_bytes(
+                hashlib.sha256(self.node_id.encode()).digest()[:8], "big"))
+        self._jitter_rng = jitter_rng
         #: typed wire-protocol violations (WireError) observed on read
         #: loops — each one dropped exactly one connection, loudly
         self.wire_errors = 0
@@ -298,14 +339,24 @@ class P2PNode:
         p = self._peers.get(peer_id)
         return p.wire if p else None
 
+    def peer_resumption(self, peer_id: str) -> bool:
+        """True when session resumption was negotiated with this live peer
+        (both hellos offered it) — the session layer's gate for minting
+        and presenting tickets."""
+        p = self._peers.get(peer_id)
+        return bool(p and p.resume)
+
     def _hello(self) -> dict:
         """Hello payload: node identity + (when enabled) the wire-format
-        offer.  With the offer disabled the payload — and therefore the
-        hello frame bytes — is identical to the historical one (pinned)."""
+        and resumption offers.  With the offers disabled the payload — and
+        therefore the hello frame bytes — is identical to the historical
+        one (pinned)."""
         hello = {"type": "__hello__", "node_id": self.node_id,
                  "listen_port": self.port}
         if self.binary_wire:
             hello["wire"] = [_BIN_WIRE_NAME]
+        if self.resumption:
+            hello["resume"] = [_RESUME_NAME]
         return hello
 
     def _negotiated_wire(self, hello: dict) -> str:
@@ -316,6 +367,13 @@ class P2PNode:
                 and _BIN_WIRE_NAME in offered):
             return _BIN_WIRE_NAME
         return "json"
+
+    def _negotiated_resume(self, hello: dict) -> bool:
+        """Session resumption iff BOTH sides offered it (hostile hello
+        shapes — wrong types, unknown tokens — read as not-offered)."""
+        offered = hello.get("resume")
+        return bool(self.resumption and isinstance(offered, (list, tuple))
+                    and _RESUME_NAME in offered)
 
     def register_message_handler(self, msg_type: str, handler: MessageHandler) -> None:
         handlers = self._msg_handlers.setdefault(msg_type, [])
@@ -367,14 +425,27 @@ class P2PNode:
             and peer_id not in self._intentional
         )
 
+    def _reconnect_jitter(self) -> float:
+        """The next seeded reconnect-jitter delay (uniform
+        [0, RECONNECT_JITTER_S)): one draw per redial, pinned
+        deterministic under an injected ``jitter_rng``."""
+        return self._jitter_rng.uniform(0.0, RECONNECT_JITTER_S)
+
     async def reconnect(self, peer_id: str, timeout: float = 10.0,
                         retries: int = 2) -> bool:
         """Redial a dropped peer at its last known address (existing
         connect backoff applies).  False when unknown, unreachable, or a
-        DIFFERENT node now answers there."""
+        DIFFERENT node now answers there.
+
+        Each redial first sleeps a seeded, bounded jitter: after a
+        gateway death every one of its N clients enters this path at the
+        same moment, and without the jitter they all hammer the ring
+        successor in the same tick (the thundering herd the fleet
+        handoff machinery would otherwise create for itself)."""
         addr = self._addr.get(peer_id)
         if addr is None:
             return False
+        await asyncio.sleep(self._reconnect_jitter())
         prior_dialed = set(self._dialed)
         got = await self.connect_to_peer(addr[0], addr[1], timeout, retries)
         if got is not None and got != peer_id:
@@ -434,7 +505,8 @@ class P2PNode:
         peer_id = hello["node_id"]
         self._register_peer(peer_id, reader, writer, host,
                             int(hello.get("listen_port", port)),
-                            wire=self._negotiated_wire(hello))
+                            wire=self._negotiated_wire(hello),
+                            resume=self._negotiated_resume(hello))
         return peer_id, False
 
     async def _on_inbound(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -475,6 +547,7 @@ class P2PNode:
             peer_id, reader, writer, addr[0],
             int(hello.get("listen_port", addr[1])),
             wire=self._negotiated_wire(hello),
+            resume=self._negotiated_resume(hello),
         )
         self.admitted += 1
 
@@ -500,14 +573,15 @@ class P2PNode:
         writer.close()
 
     def _register_peer(self, peer_id, reader, writer, host, port,
-                       wire: str = "json") -> None:
+                       wire: str = "json", resume: bool = False) -> None:
         old = self._peers.pop(peer_id, None)
         if old is not None:
             old.writer.close()
             task = self._read_tasks.pop(peer_id, None)
             if task:
                 task.cancel()
-        peer = _Peer(peer_id, reader, writer, host, port, wire=wire)
+        peer = _Peer(peer_id, reader, writer, host, port, wire=wire,
+                     resume=resume)
         self._peers[peer_id] = peer
         self._addr[peer_id] = (host, port)
         self._intentional.discard(peer_id)

@@ -48,6 +48,7 @@ from . import trace as _trace
 #: copies byte-equal so they cannot drift.
 SECRET_NAME_RE = re.compile(
     r"(password|passwd|secret|private|master|keypair)"
+    r"|(^|_)stek($|_)"
     r"|(^|_)(sk|skey)($|_)"
     r"|(^|_)key$"
     r"|^key$",

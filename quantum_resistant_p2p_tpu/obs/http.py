@@ -18,9 +18,12 @@ Endpoints (all ``GET``; everything else is 405):
                    exposition logic, by construction)
 ``/metrics.json``  the registry's JSON snapshot (instruments + collectors)
 ``/healthz``       liveness: 200 with node id + uptime while serving
-``/readyz``        readiness: 200 only when the warm-up sweep finished AND
-                   no breaker is open (a cold or degraded gateway answers
-                   503 so a load balancer routes around it)
+``/readyz``        readiness: 200 only when the warm-up sweep finished,
+                   no breaker is open, AND the engine is not draining (a
+                   cold, degraded, or draining gateway answers 503 — with
+                   ``draining``/``drain_reason`` in the body — so a load
+                   balancer routes around it and qrtop renders the DRAIN
+                   state during a rolling restart)
 ``/slo``           the SLO engine's burn/budget report (evaluating it —
                    a scraped gateway's burn windows advance)
 ``/trace``         recent spans as a chrome://tracing document (bounded by

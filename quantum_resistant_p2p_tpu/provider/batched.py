@@ -44,6 +44,12 @@ from .base import (KeyExchangeAlgorithm, SignatureAlgorithm,
 #: (lane, arrival) order, so with single-lane traffic (every pre-gateway
 #: caller) the drain is bit-for-bit the old insertion-order slice.
 LANE_REKEY, LANE_HANDSHAKE, LANE_BULK = 0, 1, 2
+#: (Ticket-resume classification, docs/protocol.md "Session resumption":
+#: the abbreviated exchange dispatches NO device ops, and any op a
+#: RESUMED session later queues — a post-resume rekey, its bulk seals —
+#: already classifies onto LANE_REKEY through the engine's
+#: had-a-completed-session rule, which a successful resume marks exactly
+#: like a full handshake.  No separate lane tag exists on purpose.)
 LANE_NAMES = {LANE_REKEY: "rekey", LANE_HANDSHAKE: "handshake",
               LANE_BULK: "bulk"}
 

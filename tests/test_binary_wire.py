@@ -84,9 +84,12 @@ def test_wire_negotiation_requires_both_sides(run, a_bin, b_bin, expect):
 
 
 def test_env_flag_and_hello_compat(run, monkeypatch):
-    """QRP2P_BINARY_WIRE=0 keeps the hello payload EXACTLY the historical
-    dict (no ``wire`` key) — un-upgraded peers see nothing new."""
+    """QRP2P_BINARY_WIRE=0 (+ QRP2P_RESUMPTION=0, the session-resumption
+    offer's twin knob) keeps the hello payload EXACTLY the historical
+    dict (no ``wire``/``resume`` keys) — un-upgraded peers see nothing
+    new."""
     monkeypatch.setenv("QRP2P_BINARY_WIRE", "0")
+    monkeypatch.setenv("QRP2P_RESUMPTION", "0")
     node = P2PNode(node_id="n", host="127.0.0.1", port=4242)
     assert node.binary_wire is False
     assert node._hello() == {"type": "__hello__", "node_id": "n",
@@ -95,6 +98,10 @@ def test_env_flag_and_hello_compat(run, monkeypatch):
     node2 = P2PNode(node_id="n", host="127.0.0.1", port=4242)
     assert node2.binary_wire is True
     assert node2._hello()["wire"] == ["bin1"]
+    assert "resume" not in node2._hello()  # resumption still opted out
+    monkeypatch.delenv("QRP2P_RESUMPTION")
+    node3 = P2PNode(node_id="n", host="127.0.0.1", port=4242)
+    assert node3._hello()["resume"] == ["tik1"]
 
 
 def test_json_frames_byte_identical_when_disabled(run, monkeypatch):

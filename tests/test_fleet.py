@@ -431,9 +431,13 @@ def test_fleet_storm_survives_seeded_gateway_kill(run):
     from quantum_resistant_p2p_tpu.fleet.storm import (default_kill_rules,
                                                        run_fleet_storm)
 
+    # msg_interval_s paces sessions so they are ALIVE at the kill tick —
+    # on a fast host an unpaced 10-session storm finishes before tick 2
+    # and the kill (the thing under test) never fires
     out = run(run_fleet_storm(
         sessions=10, gateways=3, spawn="task", concurrency=10,
-        msgs_per_session=2, hb_interval=0.05, ke_timeout=30.0,
+        msgs_per_session=4, hb_interval=0.05, ke_timeout=30.0,
+        msg_interval_s=0.1, session_attempts=8,
         fault_rules=default_kill_rules("gw1", tick=2), seed=5))
     assert out["completed_sessions"] == 10
     assert out["lost_established_sessions"] == 0
@@ -442,3 +446,105 @@ def test_fleet_storm_survives_seeded_gateway_kill(run):
         {"scope": "process", "action": "kill_gateway", "n": 2,
          "gateway": "gw1"}]
     assert out["fleet"]["members"][1]["killed"] is True
+
+
+# -- graceful drain / rolling restart / STEK distribution (ISSUE 15) ----------
+
+
+def test_draining_member_excluded_from_routing():
+    fleet = _offline_fleet(3)
+    fleet.members["gw0"].draining = True
+    for peer in (f"p{i}" for i in range(24)):
+        m = fleet.route(peer)
+        assert m is not None and m.gateway_id != "gw0"
+        fleet.session_done(m.gateway_id)
+    # budget counts only non-draining capacity
+    fleet.per_gateway_max_peers = 4
+    assert fleet.fleet_budget() == 8
+
+
+def test_drain_gateway_is_a_valid_chaos_action():
+    FaultRule("process", "drain_gateway", match={"gateway": "gw0"})
+    with pytest.raises(ValueError):
+        FaultRule("process", "nonsense")
+    # the ticket scope exists with exactly its three typed actions
+    for action in ("corrupt", "expire", "replay"):
+        FaultRule("ticket", action)
+    with pytest.raises(ValueError):
+        FaultRule("ticket", "drop")
+
+
+def test_reset_for_respawn_forgets_the_dead_incarnation():
+    m = GatewayMember("gw0", 0, clock=time.monotonic)
+    m.host, m.port, m.pid = "127.0.0.1", 40000, 123
+    m.last_hb = 1.0
+    m.breaker.record_failure("device")
+    m.inflight = 7
+    m.reset_for_respawn()
+    assert not m.registered and m.pid is None and m.last_hb is None
+    assert m.breaker.state == "closed"  # a planned restart is not failure
+    assert m.inflight == 0 and m.restarts == 1
+
+
+def test_stek_pushed_on_registration_and_rotation(run):
+    """Every gateway's ticket ring is the ROUTER's ring (pushed at hello),
+    and a rotation re-pushes the new window — the property that makes a
+    ticket minted by gw0 resume on gw1, and on a respawned gw0."""
+    async def main():
+        fleet = GatewayFleet(2, spawn="task", hb_interval=0.05)
+        try:
+            await fleet.start()
+            blob = fleet.ticket_keys.seal_ticket(
+                {"v": 1, "holder": "x", "secret": "00" * 32, "nonce": "n"})
+            epoch0 = fleet.ticket_keys.current_epoch
+            epoch1 = await fleet.rotate_stek()
+            assert epoch1 != epoch0
+            # dual-key window: the pre-rotation blob still opens
+            meta, _secret = fleet.ticket_keys.open_ticket(blob)
+            assert meta["holder"] == "x"
+            assert fleet.stats()["stek_epoch"] == epoch1
+        finally:
+            await fleet.stop()
+
+    run(main())
+
+
+def test_rolling_restart_respawns_and_reregisters(run):
+    async def main():
+        fleet = GatewayFleet(2, spawn="task", hb_interval=0.05)
+        try:
+            await fleet.start()
+            rep = await fleet.rolling_restart(drain_timeout=10.0)
+            assert rep["ok"] is True
+            assert [r["gateway"] for r in rep["restarted"]] == ["gw0", "gw1"]
+            assert all(r["graceful_exit"] and r["registered"]
+                       for r in rep["restarted"])
+            assert all(m.registered and not m.draining
+                       for m in fleet.members.values())
+            assert all(m.restarts == 1 for m in fleet.members.values())
+        finally:
+            await fleet.stop()
+
+    run(main())
+
+
+def test_roll_storm_sessions_survive_and_resume(run):
+    """The rolling-restart acceptance shape in miniature (the CI ratchet
+    runs it at 1000 sessions via ``bench.py --storm --fleet 3 --roll``):
+    every gateway drained + respawned mid-storm, 0 lost established
+    sessions, 0 plaintext, and displaced sessions resume VIA TICKET on
+    wherever the ring re-routes them."""
+    from quantum_resistant_p2p_tpu.fleet.storm import run_fleet_storm
+
+    out = run(run_fleet_storm(
+        sessions=24, gateways=2, spawn="task", concurrency=8,
+        msgs_per_session=6, arrival_rate=20.0, hb_interval=0.05,
+        ke_timeout=30.0, seed=5, roll=True, roll_delay_s=0.5,
+        drain_timeout=10.0, session_attempts=8, msg_interval_s=0.05))
+    assert out["completed_sessions"] == 24
+    assert out["lost_established_sessions"] == 0
+    assert out["plaintext_sends"] == 0
+    assert out["roll"] and out["roll"]["ok"]
+    assert out["resumed_reconnects"] >= 1
+    assert out["full_handshake_reconnects"] == 0
+    assert out["post_roll_resume_rate"] in (None, 1.0)

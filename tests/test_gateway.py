@@ -618,8 +618,13 @@ def _storm_lite(seed: int):
         FaultRule("net.send", "delay", match={"msg_type": "ke_response"},
                   nth=3, times=4, delay_s=0.02),
     ]
+    # 4 messages x rekey-every-1 per session: the storm must SPAN the
+    # autotuner's 250 ms decision cadence — a fast host finishes a
+    # 1-message storm before any tuner window fills, and the degraded-
+    # plane assertion below then has no decision to observe (flaked
+    # order-dependently on fast hosts)
     return asyncio.run(run_storm(
-        24, concurrency=24, msgs_per_session=1, rekey_every=1,
+        24, concurrency=24, msgs_per_session=4, rekey_every=1,
         churn_fraction=0.0, seed=seed, max_wait_ms=1.0, autotune=True,
         handshake_budget=16, ke_timeout=10.0, fault_rules=rules,
     ))

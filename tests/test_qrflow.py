@@ -369,6 +369,78 @@ def test_deterministic_seal_open_models_stay_public():
     ) == []
 
 
+def test_resumption_ticket_models():
+    """Session-resumption models (app/resumption.py): the STEK and the
+    resumption master secret are SECRET sources; the STEK-sealed blob is
+    public BY CONSTRUCTION (like sign/encrypt outputs), and open_ticket's
+    tuple keeps the metadata branchable while the secret stays hot."""
+    # trigger: the derived resumption secret reaching a logging sink
+    assert rule_ids(
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def mint(raw, a, b):
+            rsec = derive_resumption_secret(raw, a, b)
+            logger.info("minting %s", rsec)
+        """
+    ) == ["flow-secret-in-log"]
+    # trigger: a stek-named key is a SECRET source wherever it goes
+    assert rule_ids(
+        """
+        def push(node, stek_key):
+            node.send_message("peer", "keys", k=stek_key)
+        """
+    ) == ["flow-secret-to-network"]
+    # clean: the SEALED blob is public by construction — minting a ticket
+    # from the secret and sending the blob raises nothing
+    assert rule_ids(
+        """
+        def mint_and_send(node, ring, raw, a, b):
+            rsec = derive_resumption_secret(raw, a, b)
+            blob = ring.seal_ticket({"secret": rsec.hex()})
+            node.send_message("peer", "ke_response", ticket=blob)
+        """
+    ) == []
+    # clean: open_ticket's tuple separates branchable metadata from the
+    # SECRET second element; deriving the resumed key is fine...
+    assert rule_ids(
+        """
+        def respond(ring, blob, aead):
+            fields, rsec = ring.open_ticket(blob)
+            if fields["expires_at"] < 0:
+                return None
+            return derive_resumed_key(rsec, "c", "s", aead)
+        """
+    ) == []
+    # ...but logging the secret element is the violation
+    assert rule_ids(
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def respond(ring, blob):
+            fields, rsec = ring.open_ticket(blob)
+            logger.info("resume %s", rsec)
+        """
+    ) == ["flow-secret-in-log"]
+
+
+def test_resumption_model_suppression_policed():
+    findings, suppressed = lint(
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def debug_mint(raw, a, b):
+            rsec = derive_resumption_secret(raw, a, b)
+            logger.debug("rsec %s", rsec)  # qrlint: disable=flow-secret-in-log — fixture: justified debug tap in a test harness
+        """
+    )
+    assert not findings
+    assert [s.rule for s in suppressed] == ["flow-secret-in-log"]
+
+
 def test_sink_format_trigger_and_clean():
     assert rule_ids(
         """

@@ -1,0 +1,689 @@
+"""Session-resumption tickets + graceful drain (docs/protocol.md
+"Session resumption"; ISSUE 15).
+
+Covered here:
+
+* STEKRing seal/open mechanics: roundtrip, the dual-key rotation accept
+  window, typed rejects for every hostile blob shape, replay-cache bounds;
+* the e2e happy path: full handshake -> ticket delivered IN the
+  ke_response frame -> disconnect -> reconnect -> 1-RTT resume (no
+  KEM/sig) -> messages under the resumed key -> ratcheted fresh ticket;
+* the hostile-ticket matrix end-to-end: truncated / oversized / garbage /
+  flipped-epoch / wrong-STEK / expired / replayed / foreign-holder
+  tickets each draw a TYPED reject and fall back to a full handshake —
+  never a stall, never plaintext;
+* the faults/ ``ticket`` scope: seeded corrupt/expire/replay injection
+  with a byte-reproducible injected log;
+* ``QRP2P_RESUMPTION=0`` and un-negotiated peers: wire byte-identical to
+  the pre-resumption protocol (hello golden + message-type trace);
+* graceful drain: /readyz 503 draining, BUSY sheds, typed resume reject,
+  rehome nudges, outbox flush;
+* seeded reconnect jitter (the thundering-herd fix) pinned deterministic
+  under an injected RNG.
+
+Stdlib toy algorithms (RES-KEM/RES-SIG twins of the chaos suite's toys)
+keep the whole suite wheel-less and fast.
+"""
+
+import asyncio
+import hashlib
+import hmac
+import os
+import random
+import time
+
+import pytest
+
+from quantum_resistant_p2p_tpu.app import messaging as messaging_mod
+from quantum_resistant_p2p_tpu.app import resumption as resumption_mod
+from quantum_resistant_p2p_tpu.app.messaging import SecureMessaging
+from quantum_resistant_p2p_tpu.app.resumption import (
+    ReplayCache, STEKRing, TicketError, derive_resumption_secret,
+    mint_fields, resumption_default)
+from quantum_resistant_p2p_tpu.faults import FaultPlan, FaultRule
+from quantum_resistant_p2p_tpu.net.p2p_node import (P2PNode,
+                                                    RECONNECT_JITTER_S)
+from quantum_resistant_p2p_tpu.provider.base import (KeyExchangeAlgorithm,
+                                                     SignatureAlgorithm,
+                                                     SymmetricAlgorithm)
+from quantum_resistant_p2p_tpu.provider.registry import (register_kem,
+                                                         register_signature)
+
+# -- stdlib toys (the chaos suite's pattern; distinct names so registries
+# -- never collide across test modules) ---------------------------------------
+
+
+def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+    out = b""
+    ctr = 0
+    while len(out) < n:
+        out += hashlib.sha256(key + nonce + ctr.to_bytes(8, "big")).digest()
+        ctr += 1
+    return out[:n]
+
+
+class ToyAEAD(SymmetricAlgorithm):
+    name = "RES-AEAD"
+    display_name = "RES-AEAD"
+    key_size = 32
+    nonce_size = 16
+
+    def encrypt(self, key, plaintext, associated_data=None):
+        nonce = os.urandom(self.nonce_size)
+        ct = bytes(a ^ b for a, b in
+                   zip(plaintext, _keystream(key, nonce, len(plaintext))))
+        tag = hmac.new(key, nonce + ct + (associated_data or b""),
+                       hashlib.sha256).digest()
+        return nonce + ct + tag
+
+    def decrypt(self, key, data, associated_data=None):
+        if len(data) < self.nonce_size + 32:
+            raise ValueError("ciphertext too short")
+        nonce, ct, tag = (data[: self.nonce_size], data[self.nonce_size:-32],
+                          data[-32:])
+        want = hmac.new(key, nonce + ct + (associated_data or b""),
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise ValueError("authentication failed")
+        return bytes(a ^ b for a, b in zip(ct, _keystream(key, nonce, len(ct))))
+
+
+class ToyKEM(KeyExchangeAlgorithm):
+    name = "RES-KEM"
+    display_name = "RES-KEM"
+    public_key_len = 32
+    secret_key_len = 32
+    ciphertext_len = 32
+    shared_secret_len = 32
+
+    def __init__(self, backend="cpu"):
+        self.backend = backend
+
+    def generate_keypair(self):
+        sk = os.urandom(32)
+        return hashlib.sha256(b"pk" + sk).digest(), sk
+
+    def encapsulate(self, public_key):
+        ct = os.urandom(32)
+        return ct, hashlib.sha256(public_key + ct).digest()
+
+    def decapsulate(self, secret_key, ciphertext):
+        pk = hashlib.sha256(b"pk" + secret_key).digest()
+        return hashlib.sha256(pk + ciphertext).digest()
+
+
+class ToySig(SignatureAlgorithm):
+    name = "RES-SIG"
+    display_name = "RES-SIG"
+    public_key_len = 32
+    secret_key_len = 32
+    signature_len = 32
+
+    def __init__(self, backend="cpu"):
+        self.backend = backend
+
+    def generate_keypair(self):
+        sk = os.urandom(32)
+        return hashlib.sha256(b"pk" + sk).digest(), sk
+
+    def sign(self, secret_key, message):
+        pk = hashlib.sha256(b"pk" + secret_key).digest()
+        return hashlib.sha256(b"sig" + pk + message).digest()
+
+    def verify(self, public_key, message, signature):
+        return hmac.compare_digest(
+            signature, hashlib.sha256(b"sig" + public_key + message).digest()
+        )
+
+
+register_kem("RES-KEM", lambda backend, devices=0: ToyKEM(backend),
+             ("cpu", "tpu"))
+register_signature("RES-SIG", lambda backend, devices=0: ToySig(backend),
+                   ("cpu", "tpu"))
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+@pytest.fixture(autouse=True)
+def fast_timeout(monkeypatch):
+    monkeypatch.setattr(messaging_mod, "KEY_EXCHANGE_TIMEOUT", 1.5)
+    monkeypatch.setattr(messaging_mod, "KE_RETRY_BACKOFF_S", 0.05)
+    monkeypatch.setattr(messaging_mod, "HEAL_BACKOFF_S", 0.05)
+
+
+def _engine(node, **kw):
+    return SecureMessaging(node, kem=ToyKEM(), symmetric=ToyAEAD(),
+                           signature=ToySig(), **kw)
+
+
+async def _pair(a_kw=None, b_kw=None, a_node_kw=None, b_node_kw=None):
+    a_node = P2PNode(node_id="alice", host="127.0.0.1", port=0,
+                     **(a_node_kw or {}))
+    b_node = P2PNode(node_id="bob", host="127.0.0.1", port=0,
+                     **(b_node_kw or {}))
+    await a_node.start()
+    await b_node.start()
+    a = _engine(a_node, **(a_kw or {}))
+    b = _engine(b_node, **(b_kw or {}))
+    assert await a_node.connect_to_peer("127.0.0.1", b_node.port) == "bob"
+    for _ in range(100):
+        if b_node.is_connected("alice"):
+            break
+        await asyncio.sleep(0.01)
+    return a, b
+
+
+async def _stop(*engines):
+    for e in engines:
+        await e.node.stop()
+
+
+async def _reconnect(a, b):
+    await a.node.disconnect_from_peer("bob", intentional=True)
+    await asyncio.sleep(0.05)
+    assert await a.node.connect_to_peer("127.0.0.1", b.node.port) == "bob"
+
+
+# -- STEKRing / ReplayCache units ---------------------------------------------
+
+
+def test_seal_open_roundtrip_and_secret_separation():
+    ring = STEKRing()
+    fields = mint_fields("alice", "bob", b"s" * 32, "K", "A", "S", 4e9)
+    blob = ring.seal_ticket(fields)
+    meta, secret = ring.open_ticket(blob)
+    assert secret == b"s" * 32
+    assert "secret" not in meta  # metadata and secret never travel together
+    assert meta["holder"] == "alice" and meta["nonce"] == fields["nonce"]
+
+
+def test_dual_key_rotation_window():
+    ring = STEKRing()
+    blob = ring.seal_ticket(mint_fields("a", "b", b"x" * 32, "K", "A", "S", 4e9))
+    ring.rotate()
+    # previous key still in the accept window
+    meta, _ = ring.open_ticket(blob)
+    assert meta["holder"] == "a"
+    ring.rotate()
+    # two rotations on: the sealing key left the window
+    with pytest.raises(TicketError) as e:
+        ring.open_ticket(blob)
+    assert e.value.reason == "unknown_stek"
+
+
+def test_install_export_roundtrip_distributes_the_ring():
+    router = STEKRing()
+    gw = STEKRing()  # private random ring, about to be replaced
+    blob = router.seal_ticket(mint_fields("a", "b", b"y" * 32, "K", "A", "S",
+                                          4e9))
+    with pytest.raises(TicketError):
+        gw.open_ticket(blob)  # never saw the STEK
+    gw.install([(e, bytes.fromhex(k)) for e, k in router.export()])
+    meta, secret = gw.open_ticket(blob)
+    assert secret == b"y" * 32
+
+
+@pytest.mark.parametrize("doctor, reason", [
+    (lambda b: b[:10], "malformed_ticket"),                 # truncated
+    (lambda b: b + b"z" * 5000, "malformed_ticket"),        # oversized
+    (lambda b: b"garbage", "malformed_ticket"),             # garbage
+    (lambda b: b"XX" + b[2:], "malformed_ticket"),          # wrong magic
+    (lambda b: b[:3] + b"ffffffff" + b[11:], "unknown_stek"),  # flipped epoch
+    (lambda b: b[:-1] + bytes([b[-1] ^ 0xFF]), "bad_ticket_auth"),  # bad MAC
+    (lambda b: b[:20] + bytes([b[20] ^ 0xFF]) + b[21:], "bad_ticket_auth"),
+])
+def test_hostile_blob_matrix_is_typed(doctor, reason):
+    ring = STEKRing()
+    blob = ring.seal_ticket(mint_fields("a", "b", b"x" * 32, "K", "A", "S",
+                                        4e9))
+    with pytest.raises(TicketError) as e:
+        ring.open_ticket(doctor(blob))
+    assert e.value.reason == reason
+
+
+def test_same_epoch_different_key_fails_auth():
+    """A forged ring reusing the REAL epoch name cannot mint: the MAC is
+    keyed by the key, not named by the epoch."""
+    ring = STEKRing()
+    forged = STEKRing()
+    forged.rotate(stek=os.urandom(32), epoch=ring.current_epoch)
+    blob = forged.seal_ticket(mint_fields("a", "b", b"x" * 32, "K", "A", "S",
+                                          4e9))
+    with pytest.raises(TicketError) as e:
+        ring.open_ticket(blob)
+    assert e.value.reason == "bad_ticket_auth"
+
+
+def test_replay_cache_single_use_and_bounds():
+    cache = ReplayCache(capacity=8)
+    assert not cache.seen("n0", 100.0, 0.0)
+    assert cache.seen("n0", 100.0, 0.0)
+    assert cache.replays == 1
+    # expired first-uses do not count as replays
+    assert not cache.seen("exp", 1.0, 0.0)
+    assert not cache.seen("exp", 50.0, 10.0)  # its expiry passed: fresh again
+    # bounded: a nonce flood evicts the earliest-expiring half
+    for i in range(20):
+        cache.seen(f"flood{i}", 1000.0 + i, 0.0)
+    assert len(cache) <= 9
+
+
+# -- e2e: happy path ----------------------------------------------------------
+
+
+def test_resume_happy_path_end_to_end(run):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        # the ticket rides the ke_response frame: held the instant the
+        # session is live (no separate-delivery window)
+        entry = a.ticket_for("bob")
+        assert entry is not None
+        first_blob = bytes(entry["ticket"])
+        assert b._ctr_tickets_minted.value == 1
+        await _reconnect(a, b)
+        assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 1
+        assert b._ctr_resumes_ok.value == 1
+        assert a._ctr_resume_fallbacks.value == 0
+        # messages flow under the resumed key
+        got = []
+        b.register_message_listener(lambda p, m: got.append(m))
+        assert await a.send_message("bob", b"resumed traffic") is not None
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.01)
+        assert got and got[0].content == b"resumed traffic"
+        # a FRESH single-use ticket (ratcheted secret) replaced the used one
+        entry2 = a.ticket_for("bob")
+        assert entry2 is not None and bytes(entry2["ticket"]) != first_blob
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_resume_skips_kem_and_signatures(run):
+    """The abbreviated exchange does no KEM/sig work: provider scalar-op
+    counters (via the fault hook's event stream) stay untouched."""
+    async def main():
+        a, b = await _pair()
+        # bob's "established" system message marks the ke_test decrypt —
+        # the FIRST handshake's last crypto op — as fully processed, so
+        # the plan window below sees resume-only traffic
+        done = []
+        b.register_message_listener(
+            lambda p, m: done.append(m) if m.is_system else None)
+        assert await a.initiate_key_exchange("bob")
+        for _ in range(200):
+            if done:
+                break
+            await asyncio.sleep(0.01)
+        assert done
+        plan = FaultPlan(0, [FaultRule("scalar.op", "raise", nth=10_000)])
+        with plan.activate():
+            await _reconnect(a, b)
+            assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 1
+        # no scalar crypto op (keygen/encaps/decaps/sign/verify) ran
+        # during the resume: the plan matched ZERO scalar events
+        assert plan._matched == [0]
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_in_session_rekey_still_runs_full_handshake(run):
+    """Resumption is a RECONNECT fast path only: dropping the key on a
+    live connection (the AEAD-failure rekey shape) re-keys through the
+    full KEM handshake — fresh entropy, no ticket consumed."""
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        a.shared_keys.pop("bob", None)
+        a.ke_state["bob"] = messaging_mod.KeyExchangeState.NONE
+        assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 0  # no resume on a live conn
+        assert a.ticket_for("bob") is not None  # ticket intact (refreshed)
+        await _stop(a, b)
+
+    run(main())
+
+
+# -- e2e: hostile tickets -----------------------------------------------------
+
+
+def _doctored_entry(entry, blob):
+    return {"ticket": blob, "expires_at": entry["expires_at"],
+            "secret": bytearray(entry["secret"])}
+
+
+@pytest.mark.parametrize("doctor", [
+    lambda blob: blob[:10],                                  # truncated
+    lambda blob: blob + b"x" * 5000,                         # oversized
+    lambda blob: os.urandom(len(blob)),                      # garbage
+    lambda blob: blob[:3] + b"00000000" + blob[11:],         # flipped epoch
+    lambda blob: blob[:-4] + bytes(4),                       # broken MAC
+])
+def test_hostile_ticket_falls_back_to_full_handshake(run, doctor):
+    """Every hostile shape ends in: typed reject at the responder, loud
+    fallback at the initiator, an ESTABLISHED session via the full
+    handshake — no plaintext, no stall."""
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        entry = a.take_ticket("bob")
+        await _reconnect(a, b)
+        a.adopt_ticket("bob", _doctored_entry(entry, doctor(entry["ticket"])))
+        assert await a.initiate_key_exchange("bob")  # fallback established
+        assert a._ctr_resumes_used.value == 0
+        assert a._ctr_resume_fallbacks.value == 1
+        assert b._ctr_resume_rejects.value == 1
+        assert b._ctr_resumes_ok.value == 0
+        assert a.verify_key_exchange_state("bob")
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_replayed_ticket_second_use_full_handshakes_and_counts(run):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        entry = a.ticket_for("bob")
+        saved = _doctored_entry(entry, bytes(entry["ticket"]))
+        await _reconnect(a, b)
+        assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 1  # first use: resumed
+        await _reconnect(a, b)
+        a.adopt_ticket("bob", saved)  # replay the consumed single-use blob
+        assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 1  # did NOT resume again
+        assert b._replay.replays == 1          # the replay counter bumped
+        assert b._ctr_resume_rejects.value == 1
+        assert a.verify_key_exchange_state("bob")
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_expired_ticket_rejected_typed(run):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        # a ticket the RESPONDER sealed, already expired
+        secret = bytearray(os.urandom(32))
+        blob = b.tickets.seal_ticket(mint_fields(
+            "alice", "bob", bytes(secret), a.kem.name, a.symmetric.name,
+            a.signature.name, time.time() - 5.0))
+        await _reconnect(a, b)
+        a.adopt_ticket("bob", {"ticket": blob,
+                               "expires_at": time.time() + 100.0,
+                               "secret": secret})
+        assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 0
+        assert b._ctr_resume_rejects.value == 1
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_ticket_to_gateway_that_never_saw_the_stek(run):
+    """A valid ticket presented to a responder with a DIFFERENT (private)
+    STEK ring: unknown_stek -> typed reject -> full-handshake fallback."""
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        entry = a.take_ticket("bob")
+        await _reconnect(a, b)
+        b.tickets = STEKRing()  # bob "restarted" without the fleet's keys
+        a.adopt_ticket("bob", entry)
+        assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 0
+        assert a._ctr_resume_fallbacks.value == 1
+        assert b._ctr_resume_rejects.value == 1
+        assert a.verify_key_exchange_state("bob")
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_stolen_blob_without_secret_fails_binder(run):
+    """Holding the sealed blob alone authorizes nothing: a presenter with
+    the wrong resumption secret draws bad_binder and never a session."""
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        entry = a.take_ticket("bob")
+        await _reconnect(a, b)
+        a.adopt_ticket("bob", {"ticket": entry["ticket"],
+                               "expires_at": entry["expires_at"],
+                               "secret": bytearray(os.urandom(32))})
+        assert await a.initiate_key_exchange("bob")  # full-handshake fallback
+        assert a._ctr_resumes_used.value == 0
+        assert b._ctr_resume_rejects.value == 1
+        await _stop(a, b)
+
+    run(main())
+
+
+# -- faults/ ticket scope -----------------------------------------------------
+
+
+@pytest.mark.parametrize("action", ["corrupt", "expire", "replay"])
+def test_ticket_fault_injection_is_typed_and_logged(run, action):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        await _reconnect(a, b)
+        plan = FaultPlan(5, [FaultRule("ticket", action, nth=1)])
+        with plan.activate():
+            assert await a.initiate_key_exchange("bob")  # fallback heals it
+        assert a._ctr_resumes_used.value == 0
+        assert a._ctr_resume_fallbacks.value == 1
+        assert b._ctr_resume_rejects.value == 1
+        assert plan.injected and plan.injected[0]["action"] == action
+        assert a.verify_key_exchange_state("bob")
+        await _stop(a, b)
+
+    run(main())
+
+
+# -- opt-out / negotiation golden ---------------------------------------------
+
+
+def test_env_opt_out_and_hello_golden(monkeypatch):
+    monkeypatch.setenv("QRP2P_RESUMPTION", "0")
+    assert resumption_default() is False
+    node = P2PNode(node_id="n", port=7)
+    # byte-identical to the PRE-resumption hello (the PR-13 shape)
+    assert node._hello() == {"type": "__hello__", "node_id": "n",
+                             "listen_port": 7, "wire": ["bin1"]}
+    monkeypatch.setenv("QRP2P_RESUMPTION", "1")
+    node2 = P2PNode(node_id="n", port=7)
+    assert node2._hello()["resume"] == ["tik1"]
+
+
+def test_opted_out_wire_is_byte_identical_to_pre_pr(run):
+    """With resumption off (either side), the full message-type sequence
+    and every frame's key set are EXACTLY the pre-resumption protocol's —
+    pinned by spying on both transports."""
+    async def main():
+        sent: list[tuple[str, frozenset]] = []
+
+        a, b = await _pair(a_kw={"resumption": False},
+                           b_kw={"resumption": False},
+                           a_node_kw={"resumption": False},
+                           b_node_kw={"resumption": False})
+        for node in (a.node, b.node):
+            orig = node.send_message
+
+            async def spy(peer_id, msg_type, _orig=orig, **payload):
+                sent.append((msg_type, frozenset(payload)))
+                return await _orig(peer_id, msg_type, **payload)
+
+            node.send_message = spy
+        assert await a.initiate_key_exchange("bob")
+        types = [t for t, _ in sent if t.startswith("ke_")]
+        assert types == ["ke_init", "ke_response", "ke_confirm", "ke_test"]
+        resp_keys = next(keys for t, keys in sent if t == "ke_response")
+        assert resp_keys == frozenset(
+            {"ke_data", "sig", "sig_algo", "sig_pk"})  # no ticket fields
+        assert a.ticket_for("bob") is None
+        assert b._ctr_tickets_minted.value == 0
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_unnegotiated_peer_gets_no_tickets(run):
+    """One side opted out -> negotiation fails -> NO ticket minted, NO
+    resume attempted; reconnects run the classic full handshake."""
+    async def main():
+        a, b = await _pair(b_kw={"resumption": False},
+                           b_node_kw={"resumption": False})
+        assert await a.initiate_key_exchange("bob")
+        assert a.ticket_for("bob") is None
+        assert b._ctr_tickets_minted.value == 0
+        await _reconnect(a, b)
+        assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 0
+        assert a._ctr_resume_fallbacks.value == 0  # never even attempted
+        await _stop(a, b)
+
+    run(main())
+
+
+# -- graceful drain -----------------------------------------------------------
+
+
+def test_drain_readyz_sheds_and_nudges(run):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        res = await b.drain("rolling-restart-test")
+        assert b.draining and res["nudged"] == 1
+        ready = b.ready_status()
+        assert ready["ready"] is False
+        assert ready["draining"] is True
+        assert ready["drain_reason"] == "rolling-restart-test"
+        # the nudge reached alice (counted + surfaced as a system message)
+        for _ in range(100):
+            if a._ctr_rehome_nudges.value:
+                break
+            await asyncio.sleep(0.01)
+        assert a._ctr_rehome_nudges.value == 1
+        # new full handshakes shed with the typed BUSY...
+        a.shared_keys.pop("bob", None)
+        a._tickets.pop("bob", None)
+        a.ke_state["bob"] = messaging_mod.KeyExchangeState.NONE
+        assert not await a.initiate_key_exchange("bob", retries=0)
+        assert b._ctr_handshake_sheds.value >= 1
+        # ...and resumes draw the typed draining reject
+        assert b.draining
+        assert (await b._resume_respond("alice", {}, {}, "x")) == "draining"
+        # drain is idempotent
+        again = await b.drain("second")
+        assert again.get("already_draining")
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_drain_flushes_outbox(run):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        got = []
+        b.register_message_listener(
+            lambda p, m: got.append(m) if not m.is_system else None)
+        # park a message in alice's outbox by hand, then drain alice
+        a._outbox["bob"] = [messaging_mod.Message(
+            content=b"parked", sender_id=a.node_id, recipient_id="bob")]
+        res = await a.drain("test")
+        assert res["flushed"] == 1
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.01)
+        assert got and got[0].content == b"parked"
+        await _stop(a, b)
+
+    run(main())
+
+
+# -- reconnect jitter (thundering herd) ---------------------------------------
+
+
+def test_reconnect_jitter_is_seeded_and_bounded():
+    rng = random.Random(42)
+    expected = [random.Random(42).uniform(0.0, RECONNECT_JITTER_S)
+                for _ in range(1)][0]
+    node = P2PNode(node_id="j", port=0, jitter_rng=rng)
+    draws = [node._reconnect_jitter() for _ in range(8)]
+    assert draws[0] == expected
+    assert all(0.0 <= d < RECONNECT_JITTER_S for d in draws)
+    # same injected seed -> identical sequence (determinism pinned)
+    node2 = P2PNode(node_id="j2", port=0, jitter_rng=random.Random(42))
+    assert [node2._reconnect_jitter() for _ in range(8)] == draws
+
+
+def test_reconnect_sleeps_the_jitter(run, monkeypatch):
+    async def main():
+        slept = []
+        node = P2PNode(node_id="j", port=0, jitter_rng=random.Random(1))
+        node._addr["ghost"] = ("127.0.0.1", 1)  # nothing listens there
+
+        real_sleep = asyncio.sleep
+
+        async def spy_sleep(d):
+            slept.append(d)
+            await real_sleep(0)
+
+        monkeypatch.setattr(asyncio, "sleep", spy_sleep)
+        assert not await node.reconnect("ghost", timeout=0.2, retries=0)
+        expected = random.Random(1).uniform(0.0, RECONNECT_JITTER_S)
+        assert slept and slept[0] == expected
+
+    run(main())
+
+
+# -- surface checks -----------------------------------------------------------
+
+
+def test_metrics_resumption_section_and_slo_spec(run):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        m = b.metrics()["resumption"]
+        for key in ("enabled", "tickets_minted", "tickets_held",
+                    "resumes_ok", "resume_rejects", "resumes_used",
+                    "resume_fallbacks", "replay_cache", "draining"):
+            assert key in m
+        assert m["tickets_minted"] == 1
+        assert "resume_success" in b.slo.names()
+        counters = b.slo_report()["counters"]
+        assert counters["tickets_minted"] == 1
+        await _stop(a, b)
+
+    run(main())
+
+
+def test_ticket_secrets_wiped_on_drop_paths(run):
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        entry = a.ticket_for("bob")
+        buf = entry["secret"]
+        assert any(buf)
+        a._drop_ticket("bob")
+        assert not any(buf)  # zeroized in place
+        await _stop(a, b)
+
+    run(main())

@@ -368,7 +368,10 @@ def test_messaging_without_batching_has_core_slos():
     m = SecureMessaging(node, backend="cpu", sig_keypair=(b"p", b"s"),
                         symmetric=type("A", (), {"name": "X"})())
     names = set(m.slo.names())
-    assert names == {"handshake_p99", "gateway_shed_rate"}
+    # resume_success joined the core set in PR 15 (docs/protocol.md
+    # "Session resumption") — like the other two it needs no scheduler
+    assert names == {"handshake_p99", "gateway_shed_rate",
+                     "resume_success"}
 
 
 # -- the seeded chaos acceptance ----------------------------------------------

@@ -184,9 +184,22 @@ MODELS: dict[str, Taint] = {
     # the result
     "seal": T_PUBLIC, "open_": T_PUBLIC,
     "seal_batch": T_PUBLIC, "open_batch": T_PUBLIC,
+    # session-resumption tickets (app/resumption.py): the STEK-sealed blob
+    # is public BY CONSTRUCTION (like a signature/ciphertext — it reveals
+    # nothing without the STEK); opening one yields (public metadata,
+    # SECRET resumption secret) as a tuple so metadata checks never branch
+    # on secret-tainted values; the derivation chain mirrors the KEM one
+    # (master secret SECRET, per-resume message key DERIVED)
+    "seal_ticket": T_PUBLIC,
+    "open_ticket": Taint(SECRET, (T_PUBLIC, Taint(SECRET, why="open_ticket() resumption secret")),
+                         why="open_ticket()"),
+    "derive_resumption_secret": Taint(SECRET, why="derive_resumption_secret()"),
+    "ratchet_resumption_secret": Taint(SECRET, why="ratchet_resumption_secret()"),
+    "derive_resumed_key": Taint(DERIVED, why="derive_resumed_key()"),
     "derive_message_key": Taint(DERIVED, why="derive_message_key()"),
     "_hkdf_sha256": Taint(DERIVED, why="_hkdf_sha256()"),
     "hkdf": Taint(DERIVED, why="hkdf()"),
+    "hkdf_sha256": Taint(DERIVED, why="hkdf_sha256()"),
     "derive_key": Taint(DERIVED, why="derive_key()"),
     "retrieve": Taint(DERIVED, why="vault retrieve()"),
     "compare_digest": T_PUBLIC,

@@ -26,6 +26,7 @@ from .engine import FileContext, Rule, call_name, last_attr
 #: the NONSECRET list walks back the public/verification-side names.
 SECRET_NAME_RE = re.compile(
     r"(password|passwd|secret|private|master|keypair)"
+    r"|(^|_)stek($|_)"
     r"|(^|_)(sk|skey)($|_)"
     r"|(^|_)key$"
     r"|^key$",

@@ -48,6 +48,20 @@ echo "bulk-mix smoke ok (batched AEAD + binary wire, 0 failures)"
 python bench.py --storm --fleet 3 --sessions 60 >/dev/null
 echo "fleet chaos smoke ok (3 gateways, 60 sessions, seeded gw1 kill survived)"
 
+# Resumption smoke (docs/protocol.md "Session resumption"): every session
+# drops its TCP connection mid-workload and must re-establish via its
+# ticket — gated on 0 failures, a >=90% resume rate, resume-p50 under the
+# full handshake's, and ~0 device trips across the sequential cost probe.
+python bench.py --storm --resume-mix --sessions 24 >/dev/null
+echo "resume-mix smoke ok (1-RTT ticket resumes, 0 failures)"
+
+# Drain / rolling-restart smoke (docs/robustness.md "Rolling restarts"):
+# a 2-gateway PROCESS fleet, every gateway drained (SIGTERM-style) and
+# respawned mid-storm — 0 lost established sessions and at least one
+# displaced session resuming VIA TICKET on wherever the ring re-routed it.
+python bench.py --storm --fleet 2 --roll --sessions 40 >/dev/null
+echo "drain smoke ok (rolling restart survived: 0 lost sessions, >=1 ticket resume)"
+
 # Telemetry scrape smoke (docs/observability.md "Live endpoints"): an
 # engine with telemetry_port=0 (ephemeral) must serve /healthz and a
 # Prometheus /metrics exposing the cost ledger's padding-waste gauge and

@@ -57,6 +57,7 @@ SECTIONS: dict[str, list[str]] = {
     ],
     "app-net-storage": [
         "quantum_resistant_p2p_tpu.app.messaging",
+        "quantum_resistant_p2p_tpu.app.resumption",
         "quantum_resistant_p2p_tpu.app.message_store",
         "quantum_resistant_p2p_tpu.net.p2p_node",
         "quantum_resistant_p2p_tpu.net.discovery",

@@ -98,6 +98,11 @@ def scrape_gateway(name: str, base: str) -> dict[str, Any]:
         "node": health.get("node"),
         "uptime_s": round(uptime, 3),
         "ready": bool(ready.get("ready")),
+        # a draining gateway (503 /readyz with the reason) renders as the
+        # DRAIN state: a rolling restart is visible live, gateway by
+        # gateway, instead of reading as mystery unreadiness
+        "draining": bool(ready.get("draining")),
+        "drain_reason": ready.get("drain_reason"),
         "breakers": ready.get("breakers") or {},
         "handshakes": handshakes,
         "handshake_attempts": int(health.get("handshake_attempts") or 0),
@@ -165,8 +170,8 @@ def render(rows: list[dict[str, Any]], prev: dict[str, dict[str, Any]],
     """One dashboard frame.  hs/s comes from the poll-to-poll delta over
     the REAL elapsed seconds when a previous sample exists (the live
     rate), else the uptime average."""
-    cols = ("GATEWAY", "UP(s)", "RDY", "HS", "HS/S", "SHED", "WASTE",
-            "COMP(n/s)", "OPCACHE", "BURN", "BREAKERS")
+    cols = ("GATEWAY", "UP(s)", "STATE", "RDY", "HS", "HS/S", "SHED",
+            "WASTE", "COMP(n/s)", "OPCACHE", "BURN", "BREAKERS")
     lines = ["  ".join(f"{c:<10}" for c in cols)]
     for row in rows:
         name = row["gateway"]
@@ -189,7 +194,11 @@ def render(rows: list[dict[str, Any]], prev: dict[str, dict[str, Any]],
         breakers = ",".join(f"{k}:{v}" for k, v in
                             sorted(row["breakers"].items())) or "-"
         alert = "!" if row["slo_alerting"] else ""
-        vals = (name, _fmt(row["uptime_s"]), "y" if row["ready"] else "N",
+        # DRAIN makes a rolling restart legible live; otherwise the
+        # state is simply whether the gateway serves (run) or not
+        state = "DRAIN" if row.get("draining") else "run"
+        vals = (name, _fmt(row["uptime_s"]), state,
+                "y" if row["ready"] else "N",
                 str(row["handshakes"]), _fmt(hs_rate), str(sheds),
                 _fmt(row["padding_waste_fraction"], pct=True), comp, opc,
                 _fmt(burn) + alert, breakers)

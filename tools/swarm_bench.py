@@ -385,6 +385,7 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
                     shard_devices: int = 0, ke_timeout: float = 120.0,
                     prewarm: bool = True, prewarm_cap: int = 256,
                     aead_mode: str = "storm", payload_bytes: int = 0,
+                    resume_mix: bool = False,
                     fault_rules=None) -> dict:
     """Sustained-traffic storm: ``sessions`` live peers through one hub.
 
@@ -407,6 +408,16 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
     ``payload_bytes`` pads every bulk message's content up to that size
     (0 keeps the historical tiny payloads).  Per-message send latency
     (sign + seal + write) is measured and reported as p50/p99_msg_s.
+
+    ``resume_mix`` (the ``--resume-mix`` ratchet, docs/protocol.md
+    "Session resumption"): every session DROPS its TCP connection halfway
+    through its workload, redials, and re-establishes — with a held
+    resumption ticket that reconnect is a 1-RTT resume (no KEM, no
+    signatures, no device dispatch) instead of a full handshake.  The
+    report carries the resume rate, resume-vs-full latency split, and a
+    sequential post-storm cost probe pinning the "resumes cost ~0
+    device-seconds" claim (device trips + cost-ledger device seconds
+    across N pure resume cycles).
 
     Returns one JSON-ready dict: handshakes/s, p50/p99 split by first
     handshake vs rekey lane, shed counters (connection / handshake /
@@ -511,7 +522,9 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
             first_lat: list[float] = []
             rekey_lat: list[float] = []
             msg_lat: list[float] = []
+            resume_lat: list[float] = []
             churns = rekeys = 0
+            resumes_done = resume_fulls = 0
             failures = 0
             sem = asyncio.Semaphore(concurrency)
 
@@ -541,6 +554,35 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
                     failures += 1
                 return ok
 
+            async def resume_cycle(sm) -> bool:
+                """One resume-mix reconnect: drop the TCP session, redial,
+                re-establish (a held ticket makes it a 1-RTT resume; any
+                failure falls back to the full handshake inside
+                initiate_key_exchange — never a stall)."""
+                nonlocal resumes_done, resume_fulls, failures
+                await sm.node.disconnect_from_peer("hub")
+                if await sm.node.connect_to_peer("127.0.0.1", hub_node.port,
+                                                 retries=4) != "hub":
+                    failures += 1
+                    return False
+                r0 = sm._ctr_resumes_used.value
+                rt0 = time.perf_counter()
+                ok = await sm.initiate_key_exchange("hub")
+                took = time.perf_counter() - rt0
+                if not ok:
+                    failures += 1
+                    return False
+                if sm._ctr_resumes_used.value > r0:
+                    resumes_done += 1
+                    # only ACTUAL resumes feed the resume-latency split —
+                    # a fallback's full-handshake time in this bucket
+                    # would let the "resumes are cheap" gate compare full
+                    # handshakes to full handshakes
+                    resume_lat.append(took)
+                else:
+                    resume_fulls += 1
+                return True
+
             async def one_session(i: int, start_at: float, t_origin: float,
                                   srng: random.Random) -> None:
                 nonlocal churns, rekeys, failures
@@ -559,6 +601,11 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
                         mt0 = time.perf_counter()
                         await sm.send_message("hub", _payload(i, k))
                         msg_lat.append(time.perf_counter() - mt0)
+                        if (resume_mix
+                                and k + 1 == max(1, msgs_per_session // 2)):
+                            # mid-workload reconnect: the resume fast path
+                            if not await resume_cycle(sm):
+                                return
                         if rekey_every and (k + 1) % rekey_every == 0:
                             # forced re-key: drop the session key and run the
                             # 5-message handshake again — rides the REKEY lane on
@@ -601,6 +648,38 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
                 if ctx is not None:
                     ctx.__exit__(None, None, None)
             elapsed = time.perf_counter() - t_origin
+
+            resume_probe = None
+            if resume_mix and clients:
+                # sequential post-storm cost probe: N pure resume cycles on
+                # one client, device trips + cost-ledger device seconds
+                # sampled around them — the committed artifact's evidence
+                # that resumes cost ~0 device-seconds (no KEM, no sigs, no
+                # AEAD dispatch rides the abbreviated exchange)
+                sm = clients[0]
+                trips0 = hub._trips_now() + proto._trips_now()
+                dsec0 = ((hub.cost.totals().get("device_seconds") or 0.0)
+                         + (proto.cost.totals().get("device_seconds") or 0.0))
+                probe_ok = 0
+                for _ in range(8):
+                    r0 = sm._ctr_resumes_used.value
+                    await sm.node.disconnect_from_peer("hub")
+                    if await sm.node.connect_to_peer(
+                            "127.0.0.1", hub_node.port, retries=4) != "hub":
+                        break
+                    if not await sm.initiate_key_exchange("hub"):
+                        break
+                    if sm._ctr_resumes_used.value > r0:
+                        probe_ok += 1
+                resume_probe = {
+                    "resumes": probe_ok,
+                    "device_trips": (hub._trips_now() + proto._trips_now()
+                                     - trips0),
+                    "device_seconds": round(
+                        (hub.cost.totals().get("device_seconds") or 0.0)
+                        + (proto.cost.totals().get("device_seconds") or 0.0)
+                        - dsec0, 6),
+                }
 
             hub_metrics = hub.metrics()
             proto_metrics = proto.metrics()
@@ -657,6 +736,19 @@ async def run_storm(sessions: int = 1000, providers: str = "stdlib",
         "p50_rekey_s": _percentile(r_sorted, 50),
         "p99_rekey_s": _percentile(r_sorted, 99),
         "churns": churns,
+        # the resume-mix split (docs/protocol.md "Session resumption"):
+        # reconnects that resumed via ticket vs full-handshake fallbacks,
+        # their latency, and the post-storm device-cost probe
+        "resume_mix": resume_mix,
+        "resumed_reconnects": resumes_done,
+        "full_handshake_reconnects": resume_fulls,
+        "ticket_resume_rate": (
+            round(resumes_done / (resumes_done + resume_fulls), 4)
+            if (resumes_done + resume_fulls) else None),
+        "p50_resume_s": _percentile(sorted(resume_lat), 50),
+        "p99_resume_s": _percentile(sorted(resume_lat), 99),
+        "resume_cost_probe": resume_probe,
+        "resumption_hub": hub_metrics.get("resumption"),
         "device_served_fraction": (
             round((total_ops - fb_ops) / total_ops, 4) if total_ops else None),
         "sheds": {
@@ -883,6 +975,11 @@ def main(argv=None) -> int:
                     help="pad bulk message contents to this size "
                          "(0 = tiny legacy payloads; --bulk-mix defaults "
                          "this to 2048)")
+    ap.add_argument("--resume-mix", action="store_true",
+                    help="storm mode: every session drops its TCP "
+                         "connection mid-workload and re-establishes via "
+                         "its resumption ticket (1-RTT resume, no KEM/sig) "
+                         "— reports the resume rate + cost probe")
     ap.add_argument("--rekey-every", type=int, default=0,
                     help="force a re-key every N bulk messages per session")
     ap.add_argument("--churn", type=float, default=0.0,
@@ -935,6 +1032,7 @@ def main(argv=None) -> int:
             bulk_lane_capacity=args.bulk_lane_capacity,
             shard_devices=args.shard_devices, ke_timeout=args.ke_timeout,
             aead_mode=args.aead, payload_bytes=payload,
+            resume_mix=args.resume_mix,
         ))
         if args.obs_dir:
             write_obs_artifacts(stats, args.obs_dir, stem="storm")
