@@ -115,6 +115,10 @@ class GatewayMember:
         #: the gateway's own telemetry listener (obs/http.py), announced
         #: in its hello/heartbeats; None when it runs without one
         self.telemetry_port: int | None = None
+        #: the per-gateway admission cap the process announced in its
+        #: hello — cross-checked against the router's configured cap so a
+        #: respawn running a stale config is caught at registration
+        self.announced_max_peers: int | None = None
         self.proc: Any = None  # asyncio subprocess (spawn="process")
         self.task: asyncio.Task | None = None  # spawn="task"
         self.writer: asyncio.StreamWriter | None = None
@@ -157,6 +161,7 @@ class GatewayMember:
         self.port = None
         self.pid = None
         self.telemetry_port = None
+        self.announced_max_peers = None
         self.last_hb = None
         self.final_stats = None
         self.stats = {}
@@ -544,6 +549,19 @@ class GatewayFleet:
         member.pid = int(hello.get("pid") or 0) or member.pid
         tport = hello.get("telemetry_port")
         member.telemetry_port = int(tport) if tport is not None else None
+        announced = hello.get("max_peers")
+        member.announced_max_peers = (int(announced) if announced is not None
+                                      else None)
+        if (member.announced_max_peers is not None
+                and self.per_gateway_max_peers
+                and member.announced_max_peers != self.per_gateway_max_peers):
+            # a respawn running a stale config: its own admission cap and
+            # the router's budget arithmetic (_fleet_budget) now disagree —
+            # routing still works, but surface the drift loudly
+            logger.warning(
+                "gateway %s announced max_peers=%d but the router is "
+                "configured for %d per gateway — config drift", gid,
+                member.announced_max_peers, self.per_gateway_max_peers)
         member.writer = writer
         member.last_hb = self._clock()
         member.draining = False  # a respawned member is serving again
@@ -576,6 +594,16 @@ class GatewayFleet:
             while True:
                 msg = await control.read_ctrl(reader)
                 mtype = msg.get("type")
+                sender = str(msg.get("gateway", gid) or gid)
+                if sender != gid:
+                    # a frame claiming another member's identity on gid's
+                    # registered connection (stale config / confused
+                    # respawn): it must not mutate gid's state, and it
+                    # CERTAINLY must not mutate the claimed member's
+                    logger.warning(
+                        "gateway %s sent %s claiming identity %r — frame "
+                        "dropped", gid, mtype, sender)
+                    continue
                 if mtype == control.GW_HEARTBEAT:
                     self._on_heartbeat(member, msg)
                 elif mtype == control.GW_PROBE_OK:

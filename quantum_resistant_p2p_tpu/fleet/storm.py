@@ -221,14 +221,24 @@ async def run_fleet_storm(
                         return reply
                     if rtype == control.BUSY:
                         route_busy += 1
+                        if str(reply.get("scope") or "fleet") == "fleet":
+                            # fleet-wide saturation: re-asking sooner cannot
+                            # help, every member counts against one budget
+                            await asyncio.sleep(delay)
+                            delay *= 2
+                        # a narrower shed scope re-asks immediately — the
+                        # router can still route around a busy member
+                        continue
+                    if rtype == control.NO_ROUTE:
+                        # NO_ROUTE is TRANSIENT during a rolling restart
+                        # (one gateway draining + one freshly dead can
+                        # empty the pool for a beat): back off and re-ask —
+                        # only a fleet that stays unroutable through the
+                        # retry budget gives up
                         await asyncio.sleep(delay)
                         delay *= 2
                         continue
-                    # NO_ROUTE is TRANSIENT during a rolling restart (one
-                    # gateway draining + one freshly dead can empty the
-                    # pool for a beat): back off and re-ask — only a
-                    # fleet that stays unroutable through the retry
-                    # budget gives up
+                    # unknown reply verb (version skew): treat as transient
                     await asyncio.sleep(delay)
                     delay *= 2
                 return None

@@ -7,10 +7,11 @@ diagnostic bundle dump.  The dump is what turns a PR-3 chaos run from "the
 breaker opened at some point" into an event-by-event story.
 
 **Redaction happens at record time**, not dump time: key material must
-never sit in the ring at all.  The vocabulary mirrors qrlint's
-secret-hygiene pack (tools/analysis/rules_secret.py — ``SECRET_NAME_RE`` /
-``NONSECRET_NAME_RE``); ``tests/test_obs.py`` pins the two copies equal so
-they cannot drift.  Defense in depth: qrflow's ``flow-secret-in-trace``
+never sit in the ring at all.  The vocabulary (``SECRET_NAME_RE`` /
+``NONSECRET_NAME_RE``) lives in obs/redaction.py and is the SAME object
+qrlint's secret-hygiene pack imports (tools/analysis/rules_secret.py);
+``tests/test_obs.py`` pins the import identity.  Defense in depth:
+qrflow's ``flow-secret-in-trace``
 rule statically forbids tainted values reaching ``record``/span/label
 sinks, and this module redacts whatever arrives anyway (secret-named
 fields, raw bytes, oversized strings).
@@ -41,20 +42,9 @@ from typing import Any, Callable
 from . import metrics as _metrics
 from . import trace as _trace
 
-#: mirror of tools/analysis/rules_secret.py SECRET_NAME_RE /
-#: NONSECRET_NAME_RE — the obs package must stay importable without the
-#: tools/ tree installed, so the vocabulary is copied, and
-#: tests/test_obs.py::test_redaction_vocabulary_matches_qrlint pins the
-#: copies byte-equal so they cannot drift.
-SECRET_NAME_RE = re.compile(
-    r"(password|passwd|secret|private|master|keypair)"
-    r"|(^|_)stek($|_)"
-    r"|(^|_)(sk|skey)($|_)"
-    r"|(^|_)key$"
-    r"|^key$",
-    re.IGNORECASE,
-)
-NONSECRET_NAME_RE = re.compile(r"(public|pub($|_)|(^|_)pk($|_)|verify|test)", re.IGNORECASE)
+# re-exported so existing importers keep working; the vocabulary itself
+# lives in redaction.py (shared with tools/analysis/rules_secret.py)
+from .redaction import NONSECRET_NAME_RE, SECRET_NAME_RE, is_secret_name
 
 #: strings longer than this are summarised, not stored (payload hygiene +
 #: ring size bound; no legitimate flight field is this long)
@@ -66,8 +56,7 @@ FLIGHT_DIR_ENV = "QRP2P_FLIGHT_DIR"
 BUNDLE_VERSION = 1
 
 
-def _is_secret_field(name: str) -> bool:
-    return bool(SECRET_NAME_RE.search(name)) and not NONSECRET_NAME_RE.search(name)
+_is_secret_field = is_secret_name
 
 
 def redact_value(name: str, value: Any, depth: int = 0) -> Any:

@@ -1,0 +1,36 @@
+"""Secret-name vocabulary — the ONE copy shared by runtime redaction and
+static analysis.
+
+``obs/flight.py`` redacts secret-named fields at record time; qrlint's
+secret-hygiene pack (``tools/analysis/rules_secret.py``) and qrflow's
+taint tracking forbid the same names reaching log/trace sinks statically.
+Both sides import THIS module, so the vocabulary cannot drift — the old
+arrangement kept two copies pinned byte-equal by a test; now
+``tests/test_obs.py`` pins import identity instead.
+
+Stdlib-only on purpose: the obs package must import without the tools/
+tree installed, and the analysis tree must import without jax.
+"""
+
+from __future__ import annotations
+
+import re
+
+#: identifiers that hold secret material.  ``_key`` suffixes are secret by
+#: default in this codebase (entry_key, index_key, log_key, shared_key, ...);
+#: the NONSECRET list walks back the public/verification-side names.
+SECRET_NAME_RE = re.compile(
+    r"(password|passwd|secret|private|master|keypair)"
+    r"|(^|_)stek($|_)"
+    r"|(^|_)(sk|skey)($|_)"
+    r"|(^|_)key$"
+    r"|^key$",
+    re.IGNORECASE,
+)
+NONSECRET_NAME_RE = re.compile(r"(public|pub($|_)|(^|_)pk($|_)|verify|test)", re.IGNORECASE)
+
+
+def is_secret_name(name: str | None) -> bool:
+    if not name:
+        return False
+    return bool(SECRET_NAME_RE.search(name)) and not NONSECRET_NAME_RE.search(name)

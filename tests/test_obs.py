@@ -251,12 +251,16 @@ def test_chrome_trace_export_golden():
 
 
 def test_redaction_vocabulary_matches_qrlint():
-    """obs/flight.py copies qrlint's secret-hygiene vocabulary (the obs
-    package must import without tools/); this parity pin stops drift."""
+    """Runtime redaction and qrlint's secret-hygiene pack share ONE
+    vocabulary module (obs/redaction.py) — pin the import identity, not
+    just pattern equality, so a re-forked copy can't sneak back in."""
     from tools.analysis import rules_secret
+    from quantum_resistant_p2p_tpu.obs import redaction
 
-    assert obs_flight.SECRET_NAME_RE.pattern == rules_secret.SECRET_NAME_RE.pattern
-    assert obs_flight.NONSECRET_NAME_RE.pattern == rules_secret.NONSECRET_NAME_RE.pattern
+    assert obs_flight.SECRET_NAME_RE is rules_secret.SECRET_NAME_RE
+    assert obs_flight.SECRET_NAME_RE is redaction.SECRET_NAME_RE
+    assert obs_flight.NONSECRET_NAME_RE is rules_secret.NONSECRET_NAME_RE
+    assert rules_secret.is_secret_name is redaction.is_secret_name
 
 
 def test_flight_redacts_at_record_time():

@@ -613,8 +613,9 @@ def test_budget_file_matches_live_tree(capsys):
     budget = json.loads(
         (REPO_ROOT / "tools" / "analysis" / "suppression_budget.json")
         .read_text(encoding="utf-8"))
-    assert set(budget) == {"qrlint", "qrflow", "qrkernel"}
+    assert set(budget) == {"qrlint", "qrflow", "qrkernel", "qrproto"}
     assert budget["qrkernel"] == 0  # every kernel site is proved, not waived
+    assert budget["qrproto"] == 0   # every protocol contract holds, not waived
 
 
 def test_budget_overrun_fails_loudly(tmp_path, monkeypatch, capsys):
@@ -631,7 +632,8 @@ def test_budget_overrun_fails_loudly(tmp_path, monkeypatch, capsys):
         """
     ))
     budget = tmp_path / "budget.json"
-    budget.write_text('{"qrlint": 0, "qrflow": 0, "qrkernel": 0}\n')
+    budget.write_text(
+        '{"qrlint": 0, "qrflow": 0, "qrkernel": 0, "qrproto": 0}\n')
     monkeypatch.setattr(driver, "BUDGET_PATH", budget)
     monkeypatch.chdir(tmp_path)
     rc = driver.main(["quantum_resistant_p2p_tpu"])
@@ -692,7 +694,7 @@ def test_merged_sarif_has_one_run_per_analyzer(tmp_path, capsys):
     doc = json.loads(out.read_text(encoding="utf-8"))
     assert check_sarif(doc) == []
     names = [r["tool"]["driver"]["name"] for r in doc["runs"]]
-    assert names == ["qrlint", "qrflow", "qrkernel"]
+    assert names == ["qrlint", "qrflow", "qrkernel", "qrproto"]
 
 
 def test_cli_json_select_proofs_and_exit_codes(tmp_path, capsys):

@@ -1,0 +1,546 @@
+"""qrproto self-tests: protocol-model extraction mechanics (send sites,
+splat fields, verb constants, dispatch compares, shared pre-dispatch
+reads, registry handler-table resolution, negotiated features), per-rule
+trigger/clean/suppressed fixtures, the three seeded-mutation pins against
+the live ``app/messaging.py`` (deleting one handler registration, one
+send-site kwarg, or one negotiation guard each flips its rule), the
+docs/protocol.md drift pin, SARIF schema validation, and the live-tree
+clean + perf gates (the fourth CI ratchet).
+
+Pure AST on the qrlint engine: no jax import anywhere, so this file runs
+on minimal no-jax images.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import textwrap
+import time
+from functools import lru_cache
+from pathlib import Path
+
+from tools.analysis.engine import Engine, FileContext, Project
+from tools.analysis.flow.callgraph import build_callgraph
+from tools.analysis.flow.sarif import check_sarif
+from tools.analysis.proto import proto_rules
+from tools.analysis.proto.model import (ProtocolModel, extract_model,
+                                        render_model_markdown)
+from tools.analysis.proto.packs import ProtoAnalysis
+from tools.analysis.proto.run import main as qrproto_main
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "quantum_resistant_p2p_tpu"
+MESSAGING = PACKAGE / "app" / "messaging.py"
+
+
+def lint(source: str, path: str = "fixture.py"):
+    findings, suppressed = Engine(proto_rules()).lint_source(
+        textwrap.dedent(source), path)
+    return findings, suppressed
+
+
+def rule_ids(source: str, path: str = "fixture.py") -> list[str]:
+    return sorted(f.rule for f in lint(source, path)[0])
+
+
+def model_of(source: str, path: str = "fixture.py") -> ProtocolModel:
+    src = textwrap.dedent(source)
+    return extract_model(Project({path: FileContext(path, src)}))
+
+
+@lru_cache(maxsize=1)
+def _live_project() -> Project:
+    contexts = {str(p): FileContext(str(p), p.read_text(encoding="utf-8"))
+                for p in sorted(PACKAGE.rglob("*.py"))}
+    return Project(contexts)
+
+
+# -- extraction mechanics -----------------------------------------------------
+
+
+def test_send_site_fields_splat_and_open():
+    m = model_of(
+        """
+        class Node:
+            async def hello(self, peer):
+                opts = {"resume": 1}
+                opts["wire"] = 2
+                await self.conn.send_message(peer, "__x__", a=1, **opts)
+
+            async def fwd(self, peer, **extra):
+                await self.conn.send_message(peer, "__y__", **extra)
+        """
+    )
+    (sx,) = m.sends_of("__x__")
+    assert sx.fields == ("a",)
+    assert sx.optional == ("resume", "wire")  # resolved through the dict build
+    assert sx.open_fields is False
+    (sy,) = m.sends_of("__y__")
+    assert sy.open_fields is True  # **extra is unresolvable: field set open
+
+
+def test_verb_constant_resolves_through_dict_literal():
+    m = model_of(
+        """
+        BUSY = "__busy__"
+
+        class Router:
+            def make(self, scope):
+                return {"type": BUSY, "scope": scope}
+        """
+    )
+    assert m.verb_consts["BUSY"] == "__busy__"
+    (s,) = m.sends_of("__busy__")
+    assert s.fields == ("scope",)
+
+
+def test_dispatch_compare_via_assigned_local_and_shared_reads():
+    """Pre-dispatch reads fold into EVERY branch's field set; sibling
+    dispatch branches and statements after the compare are pruned so one
+    verb's fields never leak onto another's."""
+    m = model_of(
+        """
+        class Fleet:
+            async def loop(self, msg, peer):
+                sender = msg.get("gateway")
+                mtype = msg.get("type")
+                if mtype == "__a__":
+                    self.a = msg.get("x")
+                elif mtype == "__b__":
+                    self.b = msg.get("y")
+                self.after = msg.get("z")
+        """
+    )
+    (ha,) = m.handlers_of("__a__")
+    (hb,) = m.handlers_of("__b__")
+    assert set(ha.reads) == {"gateway", "type", "x"}
+    assert set(hb.reads) == {"gateway", "type", "y"}  # no x, no z
+    # "type" is an envelope field: excluded from the contract checks
+
+
+def test_non_frame_compare_is_not_a_handler():
+    m = model_of(
+        """
+        if __name__ == "__main__":
+            print("hi")
+
+        def check(kind):
+            if kind == "__gw_stop__":
+                return True
+        """
+    )
+    assert m.handlers == []  # neither compare traces to msg["type"]
+
+
+def test_registry_handler_table_resolves_through_callgraph():
+    """Satellite: the qrflow callgraph emits handler:<verb> edges for the
+    messaging.py tuple-table idiom, and qrproto builds HandlerSites (with
+    field reads) from them."""
+    src = textwrap.dedent(
+        """
+        class App:
+            def start(self):
+                for mtype, handler in (
+                    ("ke_init", self._on_init),
+                    ("ke_ok", self._on_ok),
+                ):
+                    self.node.register_message_handler(mtype, handler)
+                self.node.register_handler("__stop__", self._on_stop)
+
+            async def _on_init(self, peer, msg):
+                self.x = msg.get("a")
+
+            async def _on_ok(self, peer, msg):
+                self.y = msg["b"]
+
+            async def _on_stop(self, peer, msg):
+                self.stopped = True
+        """
+    )
+    project = Project({"fixture.py": FileContext("fixture.py", src)})
+    cg = build_callgraph(project)
+    labels = {e.label: e.callee.qualname for e in cg.edges
+              if e.label.startswith("handler:")}
+    assert labels == {"handler:ke_init": "App._on_init",
+                      "handler:ke_ok": "App._on_ok",
+                      "handler:__stop__": "App._on_stop"}
+    m = extract_model(project)
+    (hi,) = m.handlers_of("ke_init")
+    assert hi.kind == "registry" and hi.reads == ("a",)
+    (ho,) = m.handlers_of("ke_ok")
+    assert ho.reads == ("b",)
+
+
+def test_live_model_features_and_verbs():
+    m = extract_model(_live_project())
+    features = {f.offer_key: f for f in m.features}
+    assert features["resume"].env == "QRP2P_RESUMPTION"
+    assert "tik1" in features["resume"].tokens
+    assert features["wire"].env == "QRP2P_BINARY_WIRE"
+    assert m.feature_of("ke_resume").offer_key == "resume"
+    verbs = m.verbs()
+    for v in ("ke_init", "ke_response", "ke_resume", "__hello__",
+              "__gw_heartbeat__", "__route__", "__route_ok__", "__busy__"):
+        assert v in verbs, f"{v} missing from the extracted model"
+
+
+# -- rule fixtures: trigger / clean / suppressed ------------------------------
+
+_PING_HANDLED = """
+    class Node:
+        async def ping(self, peer):
+            await self.conn.send_message(peer, "__ping__", n=1)
+
+        async def on_frame(self, msg):
+            if msg.get("type") == "__ping__":
+                self.total += int(msg.get("n") or 0)
+"""
+
+
+def test_unhandled_type_trigger_clean_suppressed():
+    trigger = """
+        class Node:
+            async def ping(self, peer):
+                await self.conn.send_message(peer, "__ping__", n=1)
+    """
+    assert rule_ids(trigger) == ["proto-unhandled-type"]
+    assert rule_ids(_PING_HANDLED) == []
+    suppressed_src = """
+        class Node:
+            async def ping(self, peer):
+                await self.conn.send_message(peer, "__ping__", n=1)  # qrproto: disable=proto-unhandled-type — fixture: receiver lives out of tree
+    """
+    findings, suppressed = lint(suppressed_src)
+    assert findings == []
+    assert [s.rule for s in suppressed] == ["proto-unhandled-type"]
+
+
+def test_dead_handler_trigger_and_clean():
+    trigger = """
+        class Node:
+            async def on_frame(self, msg):
+                if msg.get("type") == "__ping__":
+                    self.last = msg.get("n")
+    """
+    assert rule_ids(trigger) == ["proto-dead-handler"]
+    assert rule_ids(_PING_HANDLED) == []
+
+
+def test_field_mismatch_read_direction():
+    trigger = """
+        class Node:
+            async def ping(self, peer):
+                await self.conn.send_message(peer, "__ping__", n=1)
+
+            async def on_frame(self, msg):
+                if msg.get("type") == "__ping__":
+                    self.total = msg.get("n") + msg.get("seq")
+    """
+    (f,) = lint(trigger)[0]
+    assert f.rule == "proto-field-mismatch" and "'seq'" in f.message
+
+
+def test_field_mismatch_sent_direction_and_wildcard():
+    trigger = """
+        class Node:
+            async def ping(self, peer):
+                await self.conn.send_message(peer, "__ping__", n=1, extra=2)
+
+            async def on_frame(self, msg):
+                if msg.get("type") == "__ping__":
+                    self.total = msg.get("n")
+    """
+    (f,) = lint(trigger)[0]
+    assert f.rule == "proto-field-mismatch" and "'extra'" in f.message
+    wildcard = """
+        class Node:
+            async def ping(self, peer):
+                await self.conn.send_message(peer, "__ping__", n=1, extra=2)
+
+            async def on_frame(self, msg):
+                if msg.get("type") == "__ping__":
+                    self.snapshot = dict(msg)
+    """
+    assert rule_ids(wildcard) == []  # whole-dict use: field set unknowable
+
+
+def test_open_fields_send_suppresses_read_direction():
+    src = """
+        class Node:
+            async def fwd(self, peer, **extra):
+                await self.conn.send_message(peer, "__ping__", **extra)
+
+            async def on_frame(self, msg):
+                if msg.get("type") == "__ping__":
+                    self.total = msg.get("n")
+    """
+    assert rule_ids(src) == []  # **extra may carry n: benefit of the doubt
+
+
+def test_unnegotiated_send_trigger_and_guarded_clean():
+    trigger = """
+        class Node:
+            def start(self):
+                self.node.register_message_handler("ke_resume", self._on_resume)
+
+            async def _on_resume(self, peer, msg):
+                self.last_ticket = msg.get("ticket")
+
+            async def resume(self, peer, ticket):
+                await self.conn.send_message(peer, "ke_resume", ticket=ticket)
+    """
+    assert rule_ids(trigger) == ["proto-unnegotiated-send"]
+    clean = """
+        class Node:
+            def start(self):
+                self.node.register_message_handler("ke_resume", self._on_resume)
+
+            async def _on_resume(self, peer, msg):
+                self.last_ticket = msg.get("ticket")
+
+            async def resume(self, peer, ticket):
+                if not self._resumption_negotiated(peer):
+                    return
+                await self.conn.send_message(peer, "ke_resume", ticket=ticket)
+    """
+    assert rule_ids(clean) == []
+
+
+def test_guard_does_not_propagate_through_async_send_path():
+    """A negotiation check inside one async callee (e.g. the app send
+    path) guards THAT function's frames, not every caller's — otherwise
+    the rule is vacuous on the live tree."""
+    src = """
+        class Node:
+            def start(self):
+                self.node.register_message_handler("ke_resume", self._on_resume)
+
+            async def _on_resume(self, peer, msg):
+                self.last_ticket = msg.get("ticket")
+
+            async def deliver(self, peer):
+                if self._resumption_negotiated(peer):
+                    self.n += 1
+
+            async def resume(self, peer, ticket):
+                await self.deliver(peer)
+                await self.conn.send_message(peer, "ke_resume", ticket=ticket)
+    """
+    assert rule_ids(src) == ["proto-unnegotiated-send"]
+
+
+def test_reject_dead_end_trigger_clean_suppressed():
+    trigger = """
+        class Client:
+            async def ask(self, peer):
+                await self.conn.send_message(peer, "__busy__", scope="fleet")
+
+            async def on_frame(self, msg):
+                if msg.get("type") == "__busy__":
+                    self.note = msg.get("scope")
+    """
+    assert rule_ids(trigger) == ["proto-reject-dead-end"]
+    clean = """
+        class Client:
+            async def ask(self, peer):
+                await self.conn.send_message(peer, "__busy__", scope="fleet")
+
+            async def on_frame(self, msg):
+                if msg.get("type") == "__busy__":
+                    self.note = msg.get("scope")
+                    self.busy_backoffs += 1
+    """
+    assert rule_ids(clean) == []
+    suppressed_src = """
+        class Client:
+            async def ask(self, peer):
+                await self.conn.send_message(peer, "__busy__", scope="fleet")
+
+            async def on_frame(self, msg):
+                if msg.get("type") == "__busy__":  # qrproto: disable=proto-reject-dead-end — fixture: the caller's select loop re-dials
+                    self.note = msg.get("scope")
+    """
+    findings, suppressed = lint(suppressed_src)
+    assert findings == []
+    assert [s.rule for s in suppressed] == ["proto-reject-dead-end"]
+
+
+def test_state_unreachable_require_trigger_and_clean():
+    trigger = """
+        class Node:
+            async def ping(self, peer):
+                await self.conn.send_message(peer, "__ping__", n=1)
+
+            async def on_frame(self, peer, msg):
+                if msg.get("type") == "__ping__":
+                    if self.ke_state[peer] == KeyExchangeState.CONFIRMING:
+                        self.n = msg.get("n")
+    """
+    (f,) = lint(trigger)[0]
+    assert f.rule == "proto-state-unreachable"
+    assert "KeyExchangeState.CONFIRMING" in f.message
+    clean = trigger + """
+            def arm(self, peer):
+                self.ke_state[peer] = KeyExchangeState.CONFIRMING
+    """
+    assert rule_ids(clean) == []
+
+
+def test_state_unreachable_reply_graph():
+    """A verb sent only from inside handlers of verbs nothing triggers is
+    dead protocol state."""
+    src = """
+        class Node:
+            async def on_a(self, msg, peer):
+                if msg.get("type") == "__a_ok__":
+                    await self.conn.send_message(peer, "__b__")
+
+            async def on_b(self, msg):
+                if msg.get("type") == "__b__":
+                    self.done = True
+    """
+    ids = rule_ids(src)
+    assert "proto-state-unreachable" in ids  # __b__ only reachable via __a_ok__
+    assert "proto-dead-handler" in ids       # nothing ever sends __a_ok__
+
+
+def test_unjustified_suppression_fires():
+    src = """
+        class Node:
+            async def ping(self, peer):
+                await self.conn.send_message(peer, "__ping__", n=1)  # qrproto: disable=proto-unhandled-type
+    """
+    ids = rule_ids(src)
+    assert ids == ["proto-unjustified-suppression"]
+
+
+# -- seeded mutation pins (live app/messaging.py) -----------------------------
+
+
+def _lint_messaging(source: str) -> list:
+    findings, _ = Engine(proto_rules()).lint_source(
+        source, str(MESSAGING.relative_to(REPO_ROOT)))
+    return findings
+
+
+def test_messaging_is_contract_clean():
+    assert _lint_messaging(MESSAGING.read_text(encoding="utf-8")) == []
+
+
+def test_mutation_deleted_handler_registration_flips_unhandled_type():
+    src = MESSAGING.read_text(encoding="utf-8")
+    mutated = src.replace('("ke_rehome", self._handle_ke_rehome),\n', "")
+    assert mutated != src, "handler-table entry moved: update the pin"
+    ids = {f.rule for f in _lint_messaging(mutated)}
+    assert "proto-unhandled-type" in ids
+
+
+def test_mutation_deleted_send_kwarg_flips_field_mismatch():
+    src = MESSAGING.read_text(encoding="utf-8")
+    mutated = re.sub(r'"ke_rehome",\s*\n\s*reason=reason', '"ke_rehome"', src)
+    assert mutated != src, "ke_rehome send site moved: update the pin"
+    findings = _lint_messaging(mutated)
+    assert any(f.rule == "proto-field-mismatch" and "'reason'" in f.message
+               for f in findings)
+
+
+def test_mutation_deleted_negotiation_guard_flips_unnegotiated_send():
+    src = MESSAGING.read_text(encoding="utf-8")
+    guard = ('if not self._resumption_negotiated(peer_id):\n'
+             '            return "resumption_disabled"\n'
+             '        if self.draining:')
+    mutated = src.replace(guard, "if self.draining:", 1)
+    assert mutated != src, "_resume_respond guard moved: update the pin"
+    findings = _lint_messaging(mutated)
+    assert any(f.rule == "proto-unnegotiated-send"
+               and "'ke_resume_ok'" in f.message for f in findings)
+
+
+# -- docs drift pin -----------------------------------------------------------
+
+
+def test_protocol_doc_verb_table_in_sync():
+    """docs/protocol.md embeds `qrproto --dump-model` between markers;
+    regenerate the block after any protocol change."""
+    doc = (REPO_ROOT / "docs" / "protocol.md").read_text(encoding="utf-8")
+    begin, end = "<!-- qrproto:model:begin -->", "<!-- qrproto:model:end -->"
+    assert begin in doc and end in doc
+    block = doc.split(begin, 1)[1].split(end, 1)[0].strip("\n")
+    rendered = render_model_markdown(extract_model(_live_project())).strip("\n")
+    assert block == rendered, (
+        "docs/protocol.md verb table drifted — regenerate with\n"
+        "  python -m tools.analysis.proto.run quantum_resistant_p2p_tpu "
+        "--dump-model")
+
+
+# -- CLI / output formats -----------------------------------------------------
+
+
+def test_list_rules(capsys):
+    assert qrproto_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("proto-unhandled-type", "proto-dead-handler",
+                "proto-field-mismatch", "proto-unnegotiated-send",
+                "proto-reject-dead-end", "proto-state-unreachable",
+                "proto-unjustified-suppression"):
+        assert rid in out
+
+
+def test_cli_select_json_sarif_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(
+        """
+        class Node:
+            async def ping(self, peer):
+                await self.conn.send_message(peer, "__ping__", n=1)
+        """
+    ))
+    assert qrproto_main([str(bad)]) == 1
+    capsys.readouterr()
+    assert qrproto_main([str(bad), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "proto-unhandled-type"
+    assert qrproto_main([str(bad), "--select", "proto-dead-handler"]) == 0
+    assert qrproto_main([str(bad), "--select", "no-such-rule"]) == 2
+    capsys.readouterr()
+    assert qrproto_main([str(bad), "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert check_sarif(doc) == []
+    assert doc["runs"][0]["tool"]["driver"]["name"] == "qrproto"
+
+
+def test_dump_model_markdown_and_json(capsys):
+    assert qrproto_main([str(PACKAGE), "--dump-model"]) == 0
+    out = capsys.readouterr().out
+    assert "| Verb | Flow | Fields | Feature | Handlers |" in out
+    assert "`ke_resume`" in out and "`QRP2P_RESUMPTION`" in out
+    assert qrproto_main([str(PACKAGE), "--dump-model", "--format", "json"]) == 0
+    model = json.loads(capsys.readouterr().out)
+    assert "ke_resume" in model["verbs"]
+    (resume,) = [f for f in model["features"] if f["offer_key"] == "resume"]
+    assert resume["env"] == "QRP2P_RESUMPTION"
+
+
+# -- the CI ratchet -----------------------------------------------------------
+
+
+def test_live_codebase_is_contract_clean(capsys):
+    """The whole package passes qrproto: every sent verb is handled, every
+    field contract holds.  New violations fail here AND in CI."""
+    rc = qrproto_main([str(PACKAGE)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"qrproto found new violations:\n{out}"
+
+
+def test_live_run_is_fast_enough_for_ci():
+    """Model extraction + contract checks are one pass over the qrflow
+    call graph: the whole package must verify in seconds (<30s gate)."""
+    contexts = {str(p): FileContext(str(p), p.read_text(encoding="utf-8"))
+                for p in sorted(PACKAGE.rglob("*.py"))}
+    t0 = time.perf_counter()
+    analysis = ProtoAnalysis(Project(contexts))
+    dt = time.perf_counter() - t0
+    assert dt < 30.0, f"protocol verification took {dt:.1f}s"
+    assert analysis.model.sends and analysis.model.handlers

@@ -27,11 +27,12 @@ from typing import Any, Callable, Iterable
 
 SEVERITIES = ("error", "warning")
 
-# both comment prefixes share one suppression grammar: `# qrlint: disable=…`
-# (qrlint/qrflow ids) and `# qrkernel: disable=…` (qrkernel ids) — rule ids
-# never collide across the analyzers, so a shared parser is unambiguous
+# all comment prefixes share one suppression grammar: `# qrlint: disable=…`
+# (qrlint/qrflow ids), `# qrkernel: disable=…` (qrkernel ids), and
+# `# qrproto: disable=…` (qrproto ids) — rule ids never collide across the
+# analyzers, so a shared parser is unambiguous
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:qrlint|qrkernel):\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[\w.,\- ]+)")
+    r"#\s*(?:qrlint|qrkernel|qrproto):\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[\w.,\- ]+)")
 
 
 @dataclasses.dataclass(frozen=True)

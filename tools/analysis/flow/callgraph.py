@@ -589,6 +589,46 @@ class CallGraph:
                         self._add_edge(fn, m.functions["main"], call,
                                        "subprocess")
             return
+        if leaf in ("register_message_handler", "register_handler") and len(call.args) >= 2:
+            # message-handler registration (net/p2p_node.py
+            # register_message_handler): the handler fires from the peer
+            # read loop — a loop-domain callback.  Two shapes: a literal
+            # verb (``register_handler("x", self._on_x)``), and the
+            # messaging.py tuple table, where both arguments are the loop
+            # variables of a ``for (msg_type, handler) in ((...), ...)``
+            # — resolved here element by element so every table-registered
+            # handler gets an edge labelled with its verb (qrproto reuses
+            # these as the protocol model's registry handlers, and taint
+            # reaches handler bodies only the table names)
+            pairs: list[tuple[str, ast.AST]] = []
+            verb_node, handler_node = call.args[0], call.args[1]
+            if (isinstance(verb_node, ast.Constant)
+                    and isinstance(verb_node.value, str)):
+                pairs.append((verb_node.value, handler_node))
+            elif isinstance(verb_node, ast.Name) and isinstance(handler_node, ast.Name):
+                for stmt in _own_statements(fn):
+                    if not isinstance(stmt, (ast.For, ast.AsyncFor)):
+                        continue
+                    t = stmt.target
+                    if not (isinstance(t, ast.Tuple) and len(t.elts) == 2
+                            and all(isinstance(e, ast.Name) for e in t.elts)
+                            and t.elts[0].id == verb_node.id
+                            and t.elts[1].id == handler_node.id):
+                        continue
+                    if isinstance(stmt.iter, (ast.Tuple, ast.List)):
+                        for elt in stmt.iter.elts:
+                            if (isinstance(elt, ast.Tuple) and len(elt.elts) == 2
+                                    and isinstance(elt.elts[0], ast.Constant)
+                                    and isinstance(elt.elts[0].value, str)):
+                                pairs.append((elt.elts[0].value, elt.elts[1]))
+            # the registration call itself still resolves (P2PNode method)
+            for target in self.resolve_callable(call.func, fn, mod, local_types):
+                self._add_edge(fn, target, call, "await" if in_await else "call")
+            for verb, href in pairs:
+                for target in resolve_ref(href):
+                    self._add_edge(fn, target, href, "loop_cb",
+                                   label=f"handler:{verb}")
+            return
         if leaf in ("call_soon", "call_later", "call_at", "call_soon_threadsafe"):
             idx = 0 if leaf == "call_soon" or leaf == "call_soon_threadsafe" else 1
             if len(call.args) > idx:

@@ -53,10 +53,11 @@ CT_RULES = ("flow-secret-compare", "flow-secret-branch")
 #: without CLI context)
 CT_ALL = False
 
-# both prefixes: the engine accepts `# qrkernel: disable=…` too, so a flow
-# rule suppressed through THAT spelling must be policed all the same
+# every prefix: the engine accepts `# qrkernel: disable=…` and
+# `# qrproto: disable=…` too, so a flow rule suppressed through THOSE
+# spellings must be policed all the same
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:qrlint|qrkernel):\s*disable(?:-file)?\s*=\s*"
+    r"#\s*(?:qrlint|qrkernel|qrproto):\s*disable(?:-file)?\s*=\s*"
     r"(?P<rules>[\w.,\- ]+)(?P<rest>.*)$")
 
 

@@ -1,0 +1,21 @@
+"""qrproto — cross-process protocol-contract & state-machine verifier.
+
+The fourth analyzer of the qr-analysis ratchet (qrlint → qrflow →
+qrkernel → qrproto).  Pure AST on the qrlint engine: extracts the
+whole-repo protocol model (send sites, handler registrations, field
+reads, negotiated features, per-role state machines) and verifies the
+wire contracts over it.  ``python -m tools.analysis.proto.run`` or the
+``qrproto`` console script; ``--dump-model`` emits the canonical
+verb/field/negotiation table docs/protocol.md pins.
+"""
+
+from __future__ import annotations
+
+from ..engine import Rule
+from .packs import PROTO_RULES
+
+
+def proto_rules() -> list[Rule]:
+    """Fresh instances of every qrproto rule (the all.py driver and the
+    CLI both construct per-run rule objects, mirroring flow/kernel)."""
+    return [cls() for cls in PROTO_RULES]

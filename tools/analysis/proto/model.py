@@ -1,0 +1,858 @@
+"""qrproto protocol-model extraction — the whole-repo wire contract as data.
+
+One pass over every parsed file recovers the three protocol surfaces the
+wire layer grew across PRs 11-15 (docs/protocol.md):
+
+* **send sites** — ``node.send_message(peer, "<verb>", **fields)`` calls
+  (keyword names = frame fields; ``**splat`` arguments are resolved to
+  the dict keys assigned in the enclosing function, so the conditional
+  ticket fields riding a ``ke_response`` stay visible), and control/
+  transport frame constructions: any dict literal carrying a ``"type"``
+  key whose value is a dunder string or resolves to a ``fleet/control.py``
+  verb constant (``{"type": control.GW_PROBE, "n": n}``) — including
+  fields added later by ``frame["k"] = v`` stores in the same function
+  (the hello's negotiated-offer keys).
+* **handler sites** — ``register_message_handler`` registrations (both
+  literal and the messaging.py tuple table, resolved through qrflow's
+  call graph — the ``handler:<verb>`` edges callgraph.py records), and
+  dispatch comparisons ``mtype == control.X`` / ``hello.get("type") ==
+  "__busy__"`` (``!=`` guards count too: the rest of the function is the
+  handler body).  Field reads inside a handler follow ``msg["x"]`` /
+  ``msg.get("x")`` / ``msg.pop("x")`` and recurse one call deep when the
+  message dict is passed on (``self._route_reply(msg)``); any other bare
+  use of the dict (``return reply``, ``member.stats = msg``) makes the
+  handler a wildcard reader.
+* **negotiated features** — hello offer lists (``hello["wire"] =
+  ["bin1"]`` stores on the ``__hello__`` frame), their ``QRP2P_*``
+  kill-switch env reads (resolved through the gating attribute's default
+  chain), and the negotiation-check predicates (functions whose name
+  marks them as negotiation guards, closed transitively over calls).
+
+Per-role state machines come from the send→handler graph (entry sends =
+sends outside any handler body) plus ``*State.X`` precondition compares
+and establishing assignments.  Everything is pure AST — no jax import,
+no runtime execution — and deterministic, so ``--dump-model`` output is
+byte-stable and docs/protocol.md can pin it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from ..engine import FileContext, Project, last_attr
+from ..flow.callgraph import CallGraph, FunctionInfo, build_callgraph
+
+#: wire/control verbs are dunder-named by convention (fleet/control.py);
+#: dispatch-comparison extraction keys on this so app-level string
+#: compares never read as protocol dispatch
+_DUNDER_VERB_RE = re.compile(r"^__\w+__$")
+
+#: verbs whose handler must have a retry/fallback/giveup edge
+REJECT_VERB_RE = re.compile(r"(reject|busy|no_route)")
+
+#: function names that ARE negotiation checks (seed of the guard closure)
+GUARD_NAME_RE = re.compile(r"(negotiated|peer_resumption)")
+
+#: kill-switch env vars of negotiated features
+_KILL_ENV_RE = re.compile(r"^QRP2P_\w+$")
+
+#: frame envelope fields owned by the transport, not by any verb contract:
+#: ``type`` routes the frame, ``_trace`` is the observability context the
+#: sender attaches and the dispatcher pops before handlers run
+ENVELOPE_FIELDS = frozenset({"type", "_trace"})
+
+#: feature-bound verbs, by hello offer key (declarative, like qrflow's
+#: crypto-op models): frames of these verbs may only be sent on paths
+#: guarded by that feature's negotiation check.  The binary wire binds no
+#: verbs — it changes the envelope, not the message set.
+FEATURE_VERBS: dict[str, tuple[str, ...]] = {
+    "resume": ("ke_resume", "ke_resume_ok"),
+    "wire": (),
+}
+
+_REGISTER_NAMES = ("register_message_handler", "register_handler")
+
+
+@dataclasses.dataclass
+class SendSite:
+    verb: str
+    fields: tuple[str, ...]          # keyword / dict-literal fields
+    optional: tuple[str, ...]        # splat- or store-attached fields
+    open_fields: bool                # unresolvable ``**splat``: set unknown
+    path: str
+    line: int
+    role: str
+    func: str                        # enclosing function qualname ("" = module)
+    node: ast.AST
+    ctx: FileContext
+    handler_verb: str | None = None  # verb of the handler containing this send
+
+
+@dataclasses.dataclass
+class HandlerSite:
+    verb: str
+    role: str
+    path: str
+    line: int
+    func: str
+    reads: tuple[str, ...]
+    wildcard: bool                   # handler consumes the dict wholesale
+    kind: str                        # "registry" | "dispatch"
+    node: ast.AST
+    ctx: FileContext
+    body: tuple[ast.AST, ...]
+    span: tuple[int, int]            # body line span (send→handler edges)
+    #: where the handler FUNCTION lives (differs from ctx/node for registry
+    #: handlers, whose registration site is the finding anchor)
+    def_ctx: FileContext | None = None
+    def_node: ast.AST | None = None
+
+
+@dataclasses.dataclass
+class Feature:
+    offer_key: str                   # hello key ("wire", "resume")
+    tokens: tuple[str, ...]          # offered format names ("bin1", "tik1")
+    env: str | None                  # kill-switch env var
+    guards: tuple[str, ...]          # seed negotiation-check function names
+    verbs: tuple[str, ...]           # feature-bound verbs (FEATURE_VERBS)
+
+
+@dataclasses.dataclass
+class StateRef:
+    enum: str
+    state: str
+    kind: str                        # "require" | "establish"
+    path: str
+    line: int
+    node: ast.AST
+    ctx: FileContext
+    in_handler: str | None = None
+
+
+def role_of(path: str) -> str:
+    p = path.replace("\\", "/")
+    if p.endswith("fleet/manager.py"):
+        return "router"
+    if p.endswith("fleet/gateway.py"):
+        return "gateway"
+    if p.endswith(("fleet/control.py", "fleet/storm.py", "fleet/stormlib.py")):
+        return "client"
+    if "/net/" in p or p.startswith("net/"):
+        return "transport"
+    return "peer"
+
+
+class ProtocolModel:
+    """The extracted protocol surface of one project run."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.cg: CallGraph = build_callgraph(project)
+        self.sends: list[SendSite] = []
+        self.handlers: list[HandlerSite] = []
+        self.features: list[Feature] = []
+        self.states: list[StateRef] = []
+        #: verb constant NAME -> value ("GW_HELLO" -> "__gw_hello__")
+        self.verb_consts: dict[str, str] = {}
+        #: module-level str constants per file (offer-token resolution)
+        self.str_consts: dict[str, str] = {}
+        #: env var -> function names whose body reads it
+        self._env_readers: dict[str, set[str]] = {}
+        #: hello offer key -> (tokens, gating attr name)
+        self._offers: dict[str, tuple[set[str], str | None]] = {}
+        #: bare function name -> leaf names of calls inside it
+        self._fn_calls: dict[str, set[str]] = {}
+        #: leaf name -> bare names of functions calling it
+        self._callers: dict[str, set[str]] = {}
+        self._fn_by_node: dict[int, FunctionInfo] = {
+            id(fn.node): fn for fn in self.cg.functions.values()}
+
+        self._index_constants()
+        for ctx in project.contexts.values():
+            self._extract_file(ctx)
+        self._extract_registry_handlers()
+        self._assemble_features()
+        self._attach_handler_verbs()
+        self.guard_closure = self._guard_closure()
+
+    # -- constants ------------------------------------------------------------
+
+    def _index_constants(self) -> None:
+        for ctx in self.project.contexts.values():
+            for stmt in ctx.tree.body:
+                if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)):
+                    continue
+                name, value = stmt.targets[0].id, stmt.value.value
+                self.str_consts.setdefault(name, value)
+                if _DUNDER_VERB_RE.match(value):
+                    self.verb_consts.setdefault(name, value)
+
+    def _verb_of(self, node: ast.AST) -> str | None:
+        """Resolve a verb expression: dunder literal or verb constant."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if _DUNDER_VERB_RE.match(node.value) else None
+        name = last_attr(node)
+        if name is not None:
+            return self.verb_consts.get(name)
+        return None
+
+    # -- per-file extraction --------------------------------------------------
+
+    def _extract_file(self, ctx: FileContext) -> None:
+        role = role_of(ctx.path)
+        stack: list[ast.AST] = []
+
+        def enclosing_fn() -> FunctionInfo | None:
+            for anc in reversed(stack):
+                fn = self._fn_by_node.get(id(anc))
+                if fn is not None:
+                    return fn
+            return None
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.Call):
+                self._on_call(ctx, role, node, stack, enclosing_fn())
+            elif isinstance(node, ast.Dict):
+                self._on_dict(ctx, role, node, stack, enclosing_fn())
+            elif isinstance(node, ast.Compare):
+                self._on_compare(ctx, role, node, stack, enclosing_fn())
+            elif isinstance(node, ast.Assign):
+                self._on_assign(ctx, node, stack, enclosing_fn())
+            stack.append(node)
+            try:
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+            finally:
+                stack.pop()
+
+        visit(ctx.tree)
+
+    # -- calls: send_message sites + env reads --------------------------------
+
+    def _on_call(self, ctx: FileContext, role: str, call: ast.Call,
+                 stack: list[ast.AST], fn: FunctionInfo | None) -> None:
+        leaf = last_attr(call.func) or ""
+        if (leaf == "send_message" and len(call.args) >= 2
+                and isinstance(call.args[1], ast.Constant)
+                and isinstance(call.args[1].value, str)):
+            fields = tuple(sorted(kw.arg for kw in call.keywords
+                                  if kw.arg is not None))
+            optional: set[str] = set()
+            open_fields = False
+            for kw in call.keywords:
+                if kw.arg is not None:
+                    continue
+                keys = self._splat_keys(kw.value, fn)
+                if keys is None:
+                    open_fields = True
+                else:
+                    optional |= keys
+            self.sends.append(SendSite(
+                verb=call.args[1].value, fields=fields,
+                optional=tuple(sorted(optional)), open_fields=open_fields,
+                path=ctx.path, line=call.lineno, role=role,
+                func=fn.qualname if fn else "", node=call, ctx=ctx))
+            return
+        if (isinstance(call.func, ast.Attribute) and leaf == "get"
+                and (last_attr(call.func.value) or "").endswith("environ")
+                and call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)
+                and _KILL_ENV_RE.match(call.args[0].value)
+                and fn is not None):
+            self._env_readers.setdefault(call.args[0].value,
+                                         set()).add(fn.name)
+
+    def _splat_keys(self, splat: ast.AST, fn: FunctionInfo | None) -> set[str] | None:
+        """Dict keys a ``**splat`` argument may contribute, from the
+        enclosing function's assignments to it; None = unresolvable."""
+        if not isinstance(splat, ast.Name) or fn is None:
+            return None
+        keys: set[str] = set()
+        found = False
+        for node in ast.walk(fn.node):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == splat.id
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Dict)):
+                found = True
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        keys.add(k.value)
+            elif (isinstance(node, ast.Assign)
+                  and any(isinstance(t, ast.Subscript)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id == splat.id
+                          and isinstance(t.slice, ast.Constant)
+                          and isinstance(t.slice.value, str)
+                          for t in node.targets)):
+                found = True
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == splat.id
+                            and isinstance(t.slice, ast.Constant)):
+                        keys.add(t.slice.value)
+        return keys if found else None
+
+    # -- dict literals: control/transport frame constructions -----------------
+
+    def _on_dict(self, ctx: FileContext, role: str, node: ast.Dict,
+                 stack: list[ast.AST], fn: FunctionInfo | None) -> None:
+        verb = None
+        fields: list[str] = []
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                continue
+            if k.value == "type":
+                verb = self._verb_of(v)
+            else:
+                fields.append(k.value)
+        if verb is None:
+            return
+        optional: set[str] = set()
+        # fields attached after construction: ``frame["k"] = v`` stores on
+        # the variable the literal was assigned to (the hello offers)
+        var = None
+        parent = stack[-1] if stack else None
+        if (isinstance(parent, ast.Assign) and len(parent.targets) == 1
+                and isinstance(parent.targets[0], ast.Name)
+                and parent.value is node):
+            var = parent.targets[0].id
+        if var is not None and fn is not None:
+            for sub in ast.walk(fn.node):
+                if (isinstance(sub, ast.Assign)
+                        and any(isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == var
+                                and isinstance(t.slice, ast.Constant)
+                                and isinstance(t.slice.value, str)
+                                for t in sub.targets)):
+                    for t in sub.targets:
+                        if not (isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == var
+                                and isinstance(t.slice, ast.Constant)
+                                and isinstance(t.slice.value, str)):
+                            continue
+                        key = t.slice.value
+                        optional.add(key)
+                        if verb == "__hello__":
+                            self._record_offer(key, sub, fn)
+        self.sends.append(SendSite(
+            verb=verb, fields=tuple(sorted(fields)),
+            optional=tuple(sorted(optional)), open_fields=False,
+            path=ctx.path, line=node.lineno, role=role,
+            func=fn.qualname if fn else "", node=node, ctx=ctx))
+
+    def _record_offer(self, key: str, assign: ast.Assign,
+                      fn: FunctionInfo) -> None:
+        """A negotiated-feature offer: ``hello["wire"] = [_BIN_WIRE_NAME]``.
+        Tokens resolve through module str constants; the gating attribute
+        is the ``self.X`` the enclosing ``if`` tests."""
+        tokens: set[str] = set()
+        for el in ast.walk(assign.value):
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                tokens.add(el.value)
+            elif isinstance(el, ast.Name) and el.id in self.str_consts:
+                tokens.add(self.str_consts[el.id])
+        gate = None
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.If) and any(
+                    sub is assign for sub in ast.walk(node)):
+                gate = last_attr(node.test)
+        existing = self._offers.get(key)
+        if existing:
+            existing[0].update(tokens)
+            if gate and not existing[1]:
+                self._offers[key] = (existing[0], gate)
+        else:
+            self._offers[key] = (tokens, gate)
+
+    # -- compares: dispatch handler sites + state preconditions ---------------
+
+    def _on_compare(self, ctx: FileContext, role: str, node: ast.Compare,
+                    stack: list[ast.AST], fn: FunctionInfo | None) -> None:
+        if len(node.ops) != 1 or len(node.comparators) != 1:
+            return
+        left, right = node.left, node.comparators[0]
+        op = node.ops[0]
+        state = self._state_chain(right) or self._state_chain(left)
+        if state is not None and isinstance(op, (ast.Eq, ast.Is)):
+            self.states.append(StateRef(
+                enum=state[0], state=state[1], kind="require",
+                path=ctx.path, line=node.lineno, node=node, ctx=ctx))
+            return
+        if not isinstance(op, (ast.Eq, ast.NotEq)):
+            return
+        verb = self._verb_of(left) or self._verb_of(right)
+        if verb is None:
+            return
+        other = right if self._verb_of(left) else left
+        msg_var = self._msg_var_of(other, fn)
+        if msg_var is None:
+            # not a frame dispatch: the compared expression does not trace
+            # back to a message dict's "type" (this is what keeps the
+            # ``if __name__ == "__main__"`` idiom out of the model)
+            return
+        if isinstance(op, ast.Eq):
+            body: tuple[ast.AST, ...] = ()
+            for anc in reversed(stack):
+                if isinstance(anc, ast.If) and any(
+                        sub is node for sub in ast.walk(anc.test)):
+                    body = tuple(anc.body)
+                    break
+        else:
+            # a ``!= VERB`` guard (raise/return otherwise): the remainder
+            # of the enclosing function handles the verb
+            body = tuple(fn.node.body) if fn is not None else tuple(ctx.tree.body)
+        reads, wildcard = (frozenset(), False)
+        if body:
+            reads, wildcard = self._collect_reads(body, msg_var, fn)
+        if isinstance(op, ast.Eq) and fn is not None:
+            # reads the dispatch loop performs BEFORE branching (sender-id
+            # cross-checks, trace adoption) apply to every verb dispatched
+            # in this function — fold them in, pruning sibling dispatch
+            # branches, nested functions, and everything textually after
+            # the compare, so one verb's fields never leak onto another's
+            def _prune(n: ast.AST) -> bool:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    return True
+                if isinstance(n, ast.If) and self._is_dispatch_if(
+                        n, msg_var, fn):
+                    return True
+                return (isinstance(n, ast.stmt)
+                        and getattr(n, "lineno", 0) > node.lineno)
+            shared, shared_wild = self._collect_reads(
+                tuple(fn.node.body), msg_var, fn, prune=_prune)
+            reads = frozenset(reads | shared)
+            wildcard = wildcard or shared_wild
+        lines = [getattr(n, "lineno", node.lineno) for n in body] or [node.lineno]
+        ends = [getattr(n, "end_lineno", None) or getattr(n, "lineno", node.lineno)
+                for n in body] or [node.lineno]
+        self.handlers.append(HandlerSite(
+            verb=verb, role=role, path=ctx.path, line=node.lineno,
+            func=(fn.qualname if fn else "<module>"),
+            reads=tuple(sorted(reads)), wildcard=wildcard, kind="dispatch",
+            node=node, ctx=ctx, body=body, span=(min(lines), max(ends))))
+
+    def _state_chain(self, node: ast.AST) -> tuple[str, str] | None:
+        """``KeyExchangeState.RESPONDED`` -> ("KeyExchangeState", "RESPONDED")."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        base = last_attr(node.value)
+        if base is not None and base.endswith("State"):
+            return base, node.attr
+        return None
+
+    def _msg_var_of(self, node: ast.AST, fn: FunctionInfo | None) -> str | None:
+        """The message-dict variable a ``... == VERB`` compare inspects:
+        ``msg.get("type")`` / ``msg["type"]`` directly, or a local assigned
+        from one of those in the same function."""
+        direct = self._type_read_receiver(node)
+        if direct is not None:
+            return direct
+        if isinstance(node, ast.Name) and fn is not None:
+            for sub in ast.walk(fn.node):
+                if (isinstance(sub, ast.Assign)
+                        and any(isinstance(t, ast.Name) and t.id == node.id
+                                for t in sub.targets)):
+                    recv = self._type_read_receiver(sub.value)
+                    if recv is not None:
+                        return recv
+        return None
+
+    def _is_dispatch_if(self, node: ast.If, var: str,
+                        fn: FunctionInfo | None) -> bool:
+        """Is this ``if`` a verb-dispatch branch over ``var``?"""
+        for sub in ast.walk(node.test):
+            if (isinstance(sub, ast.Compare) and len(sub.ops) == 1
+                    and len(sub.comparators) == 1
+                    and isinstance(sub.ops[0], (ast.Eq, ast.NotEq))):
+                left, right = sub.left, sub.comparators[0]
+                if self._verb_of(left) or self._verb_of(right):
+                    other = right if self._verb_of(left) else left
+                    if self._msg_var_of(other, fn) == var:
+                        return True
+        return False
+
+    def _type_read_receiver(self, node: ast.AST) -> str | None:
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "type"):
+            return node.func.value.id
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and isinstance(node.slice, ast.Constant)
+                and node.slice.value == "type"):
+            return node.value.id
+        return None
+
+    # -- assignments: state establishment -------------------------------------
+
+    def _on_assign(self, ctx: FileContext, node: ast.Assign,
+                   stack: list[ast.AST], fn: FunctionInfo | None) -> None:
+        state = self._state_chain(node.value)
+        if state is not None:
+            self.states.append(StateRef(
+                enum=state[0], state=state[1], kind="establish",
+                path=ctx.path, line=node.lineno, node=node, ctx=ctx))
+
+    # -- registry handlers (via qrflow callgraph handler edges) ---------------
+
+    def _extract_registry_handlers(self) -> None:
+        for edge in self.cg.edges:
+            if not edge.label.startswith("handler:"):
+                continue
+            verb = edge.label.split(":", 1)[1]
+            target = edge.callee
+            params = [p for p in target.params if p not in ("self", "cls")]
+            msg_param = "msg" if "msg" in params else (params[-1] if params else None)
+            reads: frozenset[str] = frozenset()
+            wildcard = False
+            if msg_param is not None:
+                reads, wildcard = self._collect_reads(
+                    tuple(target.node.body), msg_param, target)
+            node = target.node
+            self.handlers.append(HandlerSite(
+                verb=verb, role=role_of(target.path), path=edge.caller.path,
+                line=getattr(edge.node, "lineno", node.lineno),
+                func=target.qualname, reads=tuple(sorted(reads)),
+                wildcard=wildcard, kind="registry", node=edge.node,
+                ctx=edge.caller.ctx, body=tuple(node.body),
+                span=(node.lineno, node.end_lineno or node.lineno),
+                def_ctx=target.ctx, def_node=node))
+
+    # -- field-read collection ------------------------------------------------
+
+    def _collect_reads(self, body: tuple[ast.AST, ...], var: str,
+                       fn: FunctionInfo | None, depth: int = 0,
+                       seen: set | None = None,
+                       prune=None) -> tuple[frozenset, bool]:
+        """(field names read off ``var``, wildcard) for a handler body.
+
+        Follows the dict one call deep when passed on whole (resolved via
+        the qrflow call graph); any other bare use is a wildcard read.
+        ``prune`` skips whole subtrees (the sibling-dispatch-branch filter).
+        """
+        if seen is None:
+            seen = set()
+        reads: set[str] = set()
+        wildcard = False
+        consumed: set[int] = set()
+        nodes: list[ast.AST] = []
+        stack_ = list(body)
+        while stack_:
+            n = stack_.pop()
+            if prune is not None and prune(n):
+                continue
+            nodes.append(n)
+            stack_.extend(ast.iter_child_nodes(n))
+        for node in nodes:
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == var
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                consumed.add(id(node.value))
+                if isinstance(node.ctx, ast.Load):
+                    reads.add(node.slice.value)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in ("get", "pop")
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id == var
+                  and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)):
+                consumed.add(id(node.func.value))
+                reads.add(node.args[0].value)
+            elif isinstance(node, ast.Call):
+                # the dict passed on whole: recurse into resolved callees
+                positions = [i for i, a in enumerate(node.args)
+                             if isinstance(a, ast.Name) and a.id == var]
+                keywords = [kw.arg for kw in node.keywords
+                            if isinstance(kw.value, ast.Name)
+                            and kw.value.id == var and kw.arg]
+                if not positions and not keywords:
+                    continue
+                targets = [e.callee for e in self.cg.edges_at.get(id(node), ())
+                           if e.kind in ("call", "await")]
+                if not targets or depth >= 3:
+                    wildcard = True
+                    continue
+                resolved_any = False
+                for target in targets:
+                    offset = 1 if (target.params
+                                   and target.params[0] in ("self", "cls")
+                                   and target.class_name is not None) else 0
+                    names = []
+                    for i in positions:
+                        if i + offset < len(target.params):
+                            names.append(target.params[i + offset])
+                    names.extend(k for k in keywords if k in target.params)
+                    for pname in names:
+                        key = (target.fid, pname)
+                        if key in seen:
+                            resolved_any = True
+                            continue
+                        seen.add(key)
+                        sub_reads, sub_wild = self._collect_reads(
+                            tuple(target.node.body), pname, target,
+                            depth + 1, seen)
+                        reads |= sub_reads
+                        wildcard = wildcard or sub_wild
+                        resolved_any = True
+                if resolved_any:
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and a.id == var:
+                            consumed.add(id(a))
+                    for kw in node.keywords:
+                        if isinstance(kw.value, ast.Name) and kw.value.id == var:
+                            consumed.add(id(kw.value))
+                else:
+                    wildcard = True
+        for node in nodes:
+            if (isinstance(node, ast.Name) and node.id == var
+                    and isinstance(node.ctx, ast.Load)
+                    and id(node) not in consumed):
+                wildcard = True
+                break
+        return frozenset(reads), wildcard
+
+    # -- features -------------------------------------------------------------
+
+    def _assemble_features(self) -> None:
+        # the negotiation predicates are shared plumbing (one `_negotiated`
+        # family serves every offer), so every feature lists all seeds
+        # rather than guessing a partition
+        guard_seeds = tuple(sorted({fn.name for fn in self.cg.functions.values()
+                                    if GUARD_NAME_RE.search(fn.name)}))
+        for key in sorted(self._offers):
+            tokens, gate = self._offers[key]
+            self.features.append(Feature(
+                offer_key=key, tokens=tuple(sorted(tokens)),
+                env=self._env_of_gate(gate), guards=guard_seeds,
+                verbs=tuple(FEATURE_VERBS.get(key, ()))))
+
+    def _env_of_gate(self, gate: str | None) -> str | None:
+        """Kill-switch env for an offer's gating attribute: the default
+        chain ``self.X = default_fn(...) ...`` where ``default_fn`` reads
+        ``QRP2P_*``."""
+        if gate is None:
+            return None
+        env_fns = {fname: env for env, fns in self._env_readers.items()
+                   for fname in fns}
+        for fn in self.cg.functions.values():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not any(isinstance(t, ast.Attribute) and t.attr == gate
+                           and isinstance(t.value, ast.Name)
+                           and t.value.id == "self" for t in node.targets):
+                    continue
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        leaf = last_attr(sub.func) or ""
+                        if leaf in env_fns:
+                            return env_fns[leaf]
+        return None
+
+    # -- send→handler attribution + guard closure -----------------------------
+
+    def _attach_handler_verbs(self) -> None:
+        # registry spans are the handler function; dispatch spans are the
+        # matched branch (Eq) or whole guard function (NotEq).  Sends and
+        # state preconditions attribute to the innermost containing span.
+        spans = [(h.path, h.span[0], h.span[1], h.verb) for h in self.handlers]
+
+        def innermost(path: str, line: int) -> str | None:
+            best: tuple[int, str] | None = None
+            for p, start, end, verb in spans:
+                if p == path and start <= line <= end:
+                    width = end - start
+                    if best is None or width < best[0]:
+                        best = (width, verb)
+            return best[1] if best else None
+
+        for send in self.sends:
+            send.handler_verb = innermost(send.path, send.line)
+        for ref in self.states:
+            if ref.kind == "require":
+                ref.in_handler = innermost(ref.path, ref.line)
+
+    def _guard_closure(self) -> frozenset[str]:
+        """Bare names of functions that perform (or transitively call) a
+        negotiation check — the guard set proto-unnegotiated-send tests
+        membership of.
+
+        Guard status propagates UP (to callers) only through synchronous
+        members — predicate wrappers like ``_resume_allowed`` that return
+        the check's verdict without acting on it.  An ASYNC member joins
+        the closure (the check guards its own sends) but does not confer
+        it: the check inside e.g. the app send path guards that path's
+        frames, not every caller's — propagating through it would mark
+        the whole file guarded and make the rule vacuous."""
+        all_sync: dict[str, bool] = {}
+        for fn in self.cg.functions.values():
+            calls = {last_attr(n.func) or "" for n in ast.walk(fn.node)
+                     if isinstance(n, ast.Call)}
+            calls.discard("")
+            self._fn_calls.setdefault(fn.name, set()).update(calls)
+            for leaf in calls:
+                self._callers.setdefault(leaf, set()).add(fn.name)
+            all_sync[fn.name] = all_sync.get(fn.name, True) and not fn.is_async
+        guards = {name for name in self._fn_calls if GUARD_NAME_RE.search(name)}
+        for calls in self._fn_calls.values():
+            guards |= {leaf for leaf in calls if GUARD_NAME_RE.search(leaf)}
+        propagating = set(guards)
+        changed = True
+        while changed:
+            changed = False
+            for name, calls in self._fn_calls.items():
+                if name in guards or not (calls & propagating):
+                    continue
+                guards.add(name)
+                if all_sync.get(name, False):
+                    propagating.add(name)
+                changed = True
+        return frozenset(guards)
+
+    def is_guarded(self, func_qualname: str) -> bool:
+        """True when a send's enclosing function sits on a negotiation-
+        guarded path: it (or every transitive caller chain above it)
+        contains a negotiation check."""
+        bare = func_qualname.split(".")[-1] if func_qualname else ""
+        return self._guarded(bare, set())
+
+    def _guarded(self, bare: str, visiting: set[str]) -> bool:
+        if not bare or bare in visiting:
+            return False
+        if bare in self.guard_closure:
+            return True
+        visiting.add(bare)
+        callers = self._callers.get(bare, set()) - {bare}
+        if not callers:
+            return False
+        return all(self._guarded(c, visiting) for c in callers)
+
+    # -- derived views --------------------------------------------------------
+
+    def verbs(self) -> list[str]:
+        named = {s.verb for s in self.sends} | {h.verb for h in self.handlers}
+        return sorted(named, key=lambda v: (v.startswith("__"), v))
+
+    def sends_of(self, verb: str) -> list[SendSite]:
+        return [s for s in self.sends if s.verb == verb]
+
+    def handlers_of(self, verb: str) -> list[HandlerSite]:
+        return [h for h in self.handlers if h.verb == verb]
+
+    def feature_of(self, verb: str) -> Feature | None:
+        for f in self.features:
+            if verb in f.verbs:
+                return f
+        # features may be absent from a partial run (single-file fixture):
+        # fall back to the declarative binding so the rule still applies
+        for key, verbs in FEATURE_VERBS.items():
+            if verb in verbs:
+                return Feature(offer_key=key, tokens=(), env=None,
+                               guards=(), verbs=tuple(verbs))
+        return None
+
+    def reachable_verbs(self) -> frozenset[str]:
+        """Verbs reachable from entry sends over the send→handler graph."""
+        entry = {s.verb for s in self.sends if s.handler_verb is None}
+        edges: dict[str, set[str]] = {}
+        for s in self.sends:
+            if s.handler_verb is not None:
+                edges.setdefault(s.handler_verb, set()).add(s.verb)
+        seen = set(entry)
+        frontier = list(entry)
+        while frontier:
+            v = frontier.pop()
+            for nxt in edges.get(v, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        return frozenset(seen)
+
+    def as_dict(self) -> dict:
+        """JSON-stable dump of the model (``--dump-model --format json``)."""
+        verbs = {}
+        for verb in self.verbs():
+            sends = self.sends_of(verb)
+            handlers = self.handlers_of(verb)
+            fields = sorted({f for s in sends for f in s.fields})
+            optional = sorted({f for s in sends for f in s.optional}
+                              - set(fields))
+            feature = self.feature_of(verb)
+            verbs[verb] = {
+                "fields": fields,
+                "optional_fields": optional,
+                "senders": sorted({s.role for s in sends}),
+                "handlers": sorted({h.func for h in handlers}),
+                "handler_roles": sorted({h.role for h in handlers}),
+                "reads": sorted({r for h in handlers for r in h.reads}),
+                "wildcard_read": any(h.wildcard for h in handlers),
+                "feature": feature.offer_key if feature else None,
+            }
+        return {
+            "verbs": verbs,
+            "features": [{
+                "offer_key": f.offer_key, "tokens": list(f.tokens),
+                "env": f.env, "guards": list(f.guards),
+                "verbs": list(f.verbs),
+            } for f in self.features],
+            "states": {
+                "required": sorted({f"{s.enum}.{s.state}" for s in self.states
+                                    if s.kind == "require"}),
+                "established": sorted({f"{s.enum}.{s.state}"
+                                       for s in self.states
+                                       if s.kind == "establish"}),
+            },
+        }
+
+
+def render_model_markdown(model: ProtocolModel) -> str:
+    """The canonical verb/field/negotiation table (docs/protocol.md pins
+    this byte-for-byte; see tests/test_qrproto.py::test_protocol_md_pin)."""
+    d = model.as_dict()
+    lines = [
+        "| Verb | Flow | Fields | Feature | Handlers |",
+        "|---|---|---|---|---|",
+    ]
+    for verb, info in d["verbs"].items():
+        senders = "/".join(info["senders"]) or "?"
+        receivers = "/".join(info["handler_roles"]) or "(unhandled)"
+        fields = ", ".join(
+            [*info["fields"], *[f"{f}?" for f in info["optional_fields"]]]
+        ) or "—"
+        handlers = ", ".join(f"`{h}`" for h in info["handlers"]) or "—"
+        feature = f'`{info["feature"]}`' if info["feature"] else "—"
+        lines.append(f"| `{verb}` | {senders} → {receivers} | {fields} "
+                     f"| {feature} | {handlers} |")
+    lines.append("")
+    lines.append("| Feature (hello key) | Tokens | Kill switch | Bound verbs |")
+    lines.append("|---|---|---|---|")
+    for f in d["features"]:
+        tokens = ", ".join(f"`{t}`" for t in f["tokens"]) or "—"
+        env = f'`{f["env"]}`' if f["env"] else "—"
+        verbs = ", ".join(f"`{v}`" for v in f["verbs"]) or "—"
+        lines.append(f'| `{f["offer_key"]}` | {tokens} | {env} | {verbs} |')
+    return "\n".join(lines) + "\n"
+
+
+def extract_model(project: Project) -> ProtocolModel:
+    cached = getattr(project, "_qrproto_model", None)
+    if cached is None:
+        cached = ProtocolModel(project)
+        project._qrproto_model = cached  # type: ignore[attr-defined]
+    return cached

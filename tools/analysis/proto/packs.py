@@ -1,0 +1,335 @@
+"""qrproto contract rules, exposed as qrlint ``Rule`` objects.
+
+One :class:`ProtoAnalysis` is computed per project run (protocol-model
+extraction over qrflow's call graph, then contract checks over the
+model) and cached on the ``Project``; the thin rule classes below each
+publish their own finding id from it, so ``--select``/``--ignore`` and
+the inline ``# qrproto: disable=`` suppression machinery work unchanged.
+
+Rule ids:
+
+==========================  ==================================================
+proto-unhandled-type        a verb is sent cross-process but no receiving
+                            role registers or dispatches a handler for it
+proto-dead-handler          a handler is registered for a verb nothing sends
+proto-field-mismatch        a handler reads a frame field no send site for
+                            that verb supplies, or a send site attaches a
+                            field no handler ever reads
+proto-unnegotiated-send     a frame bound to a negotiated feature (hello
+                            offer + kill switch) is sent on a path with no
+                            negotiation check above it
+proto-reject-dead-end       a reject/busy/no-route verb's handler has no
+                            retry, fallback, or give-up edge — the peer
+                            stalls by construction
+proto-state-unreachable     a handler precondition (state-enum compare) that
+                            no code path establishes, or a handler reachable
+                            only through handlers that are themselves
+                            unreachable from an entry send
+proto-unjustified-suppression  a qrproto suppression with no justification
+==========================  ==================================================
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..engine import FileContext, Project, Rule, last_attr
+from .model import (ENVELOPE_FIELDS, REJECT_VERB_RE, HandlerSite,
+                    ProtocolModel, SendSite, extract_model)
+
+import ast
+
+#: handler statements that count as a fallback/giveup edge out of a
+#: reject: an explicit control transfer, a call into retry/fail plumbing,
+#: or a backoff/shed counter bump (the storm and dial loops' idiom)
+_FALLBACK_CALL_RE = re.compile(
+    r"(retry|re_?route|re_?connect|fall_?back|give_?up|fail|reject|backoff"
+    r"|sleep|shed|abort|close|set_exception|cancel)",
+    re.IGNORECASE,
+)
+_FALLBACK_COUNTER_RE = re.compile(
+    r"(busy|reject|fail|retr|fallback|shed|drop|backoff)", re.IGNORECASE)
+
+#: every analyzer prefix the engine accepts — a proto id suppressed via the
+#: qrlint/qrkernel spelling must be policed all the same
+_SUPPRESS_RE = re.compile(
+    r"#\s*(?:qrlint|qrkernel|qrproto):\s*disable(?:-file)?\s*=\s*"
+    r"(?P<rules>[\w.,\- ]+)(?P<rest>.*)$")
+
+
+class ProtoAnalysis:
+    """All qrproto findings for one project, computed once and cached."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.model: ProtocolModel = extract_model(project)
+        self.findings: list[tuple[str, FileContext, object, str]] = []
+        self._check_verbs()
+        self._check_fields()
+        self._check_negotiation()
+        self._check_rejects()
+        self._check_states()
+
+    @classmethod
+    def of(cls, project: Project) -> "ProtoAnalysis":
+        cached = getattr(project, "_qrproto_analysis", None)
+        if cached is None:
+            cached = cls(project)
+            project._qrproto_analysis = cached  # type: ignore[attr-defined]
+        return cached
+
+    def _add(self, rule_id: str, ctx: FileContext, node, message: str) -> None:
+        self.findings.append((rule_id, ctx, node, message))
+
+    # -- verb-level pairing ---------------------------------------------------
+
+    def _check_verbs(self) -> None:
+        m = self.model
+        for verb in m.verbs():
+            sends = sorted(m.sends_of(verb), key=lambda s: (s.path, s.line))
+            handlers = sorted(m.handlers_of(verb), key=lambda h: (h.path, h.line))
+            if sends and not handlers:
+                s = sends[0]
+                others = "" if len(sends) == 1 else f" (+{len(sends) - 1} more sites)"
+                self._add(
+                    "proto-unhandled-type", s.ctx, s.node,
+                    f"verb {verb!r} is sent here{others} but no role registers "
+                    "or dispatches a handler for it — the frame is dropped on "
+                    "the floor by every receiver",
+                )
+            elif handlers and not sends:
+                h = handlers[0]
+                self._add(
+                    "proto-dead-handler", h.ctx, h.node,
+                    f"handler {h.func} is registered for verb {verb!r} but no "
+                    "send site in the tree emits that verb",
+                )
+
+    # -- field contracts ------------------------------------------------------
+
+    def _check_fields(self) -> None:
+        m = self.model
+        for verb in m.verbs():
+            sends = sorted(m.sends_of(verb), key=lambda s: (s.path, s.line))
+            handlers = sorted(m.handlers_of(verb), key=lambda h: (h.path, h.line))
+            if not sends or not handlers:
+                continue  # the pairing rules own those cases
+            reads = {r for h in handlers for r in h.reads} - ENVELOPE_FIELDS
+            wildcard = any(h.wildcard for h in handlers)
+            sent = ({f for s in sends for f in s.fields}
+                    | {f for s in sends for f in s.optional}) - ENVELOPE_FIELDS
+            open_fields = any(s.open_fields for s in sends)
+            if not wildcard:
+                for field in sorted(sent - reads):
+                    site = next(s for s in sends
+                                if field in s.fields or field in s.optional)
+                    self._add(
+                        "proto-field-mismatch", site.ctx, site.node,
+                        f"field {field!r} of verb {verb!r} is sent but no "
+                        f"handler ({', '.join(sorted({h.func for h in handlers}))}) "
+                        "ever reads it — dead payload, or a read the model "
+                        "cannot see",
+                    )
+            if not open_fields:
+                for field in sorted(reads - sent):
+                    h = next(h for h in handlers if field in h.reads)
+                    self._add(
+                        "proto-field-mismatch",
+                        h.def_ctx or h.ctx, h.def_node or h.node,
+                        f"handler {h.func} reads field {field!r} of verb "
+                        f"{verb!r} but no send site supplies it — the read "
+                        "always sees the default",
+                    )
+
+    # -- negotiation discipline -----------------------------------------------
+
+    def _check_negotiation(self) -> None:
+        m = self.model
+        for send in sorted(m.sends, key=lambda s: (s.path, s.line)):
+            feature = m.feature_of(send.verb)
+            if feature is None:
+                continue
+            if not m.is_guarded(send.func):
+                self._add(
+                    "proto-unnegotiated-send", send.ctx, send.node,
+                    f"verb {send.verb!r} belongs to negotiated feature "
+                    f"{feature.offer_key!r} but is sent from "
+                    f"{send.func or '<module>'} with no negotiation check on "
+                    "any call path above it — peers that did not offer the "
+                    "feature receive a frame they never agreed to",
+                )
+
+    # -- reject liveness ------------------------------------------------------
+
+    def _check_rejects(self) -> None:
+        m = self.model
+        seen: set[tuple[str, str]] = set()
+        for h in sorted(m.handlers, key=lambda h: (h.path, h.line)):
+            if not REJECT_VERB_RE.search(h.verb):
+                continue
+            key = (h.verb, h.func)
+            if key in seen:
+                continue
+            seen.add(key)
+            if m.sends_of(h.verb) and not self._has_fallback_edge(h.body):
+                self._add(
+                    "proto-reject-dead-end", h.ctx, h.node,
+                    f"handler {h.func} for reject verb {h.verb!r} has no "
+                    "retry/fallback/give-up edge (no control transfer, no "
+                    "fail/backoff call, no shed counter) — the rejected side "
+                    "stalls with the exchange in limbo",
+                )
+
+    def _has_fallback_edge(self, body) -> bool:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Raise, ast.Continue, ast.Break)):
+                    return True
+                if isinstance(node, ast.Call):
+                    leaf = last_attr(node.func) or ""
+                    if _FALLBACK_CALL_RE.search(leaf):
+                        return True
+                if isinstance(node, ast.AugAssign):
+                    target = last_attr(node.target) or ""
+                    if _FALLBACK_COUNTER_RE.search(target):
+                        return True
+        return False
+
+    # -- state machine --------------------------------------------------------
+
+    def _check_states(self) -> None:
+        m = self.model
+        established = {(s.enum, s.state) for s in m.states
+                       if s.kind == "establish"}
+        seen: set[tuple[str, str]] = set()
+        for ref in sorted((s for s in m.states if s.kind == "require"),
+                          key=lambda s: (s.path, s.line)):
+            if ref.in_handler is None:
+                continue  # not a handler precondition
+            key = (ref.enum, ref.state)
+            if key in established or key in seen:
+                continue
+            seen.add(key)
+            self._add(
+                "proto-state-unreachable", ref.ctx, ref.node,
+                f"handler for {ref.in_handler!r} requires state "
+                f"{ref.enum}.{ref.state}, but no code path ever assigns that "
+                "state — the precondition can never hold",
+            )
+        reachable = m.reachable_verbs()
+        flagged: set[str] = set()
+        for h in sorted(m.handlers, key=lambda h: (h.path, h.line)):
+            if (h.verb in flagged or h.verb in reachable
+                    or not m.sends_of(h.verb)):
+                continue
+            flagged.add(h.verb)
+            self._add(
+                "proto-state-unreachable", h.ctx, h.node,
+                f"handler {h.func} for verb {h.verb!r} is reachable only "
+                "through reply chains whose own verbs no entry send ever "
+                "triggers — dead protocol state",
+            )
+
+
+class _ProtoRule(Rule):
+    """Base: publish one finding id out of the shared analysis."""
+
+    severity = "error"
+
+    def check_project(self, project: Project) -> None:
+        analysis = ProtoAnalysis.of(project)
+        for rule_id, ctx, node, message in analysis.findings:
+            if rule_id == self.id:
+                project.report(self, ctx, node, message)
+
+
+class UnhandledTypeRule(_ProtoRule):
+    id = "proto-unhandled-type"
+    description = ("a verb is sent cross-process but no receiving role "
+                   "registers or dispatches a handler for it")
+
+
+class DeadHandlerRule(_ProtoRule):
+    id = "proto-dead-handler"
+    description = "a handler is registered for a verb nothing sends"
+
+
+class FieldMismatchRule(_ProtoRule):
+    id = "proto-field-mismatch"
+    description = ("a handler reads a frame field no send site supplies, or "
+                   "a sent field no handler ever reads")
+
+
+class UnnegotiatedSendRule(_ProtoRule):
+    id = "proto-unnegotiated-send"
+    description = ("a frame bound to a negotiated feature is sent on a path "
+                   "with no negotiation check above it")
+
+
+class RejectDeadEndRule(_ProtoRule):
+    id = "proto-reject-dead-end"
+    description = ("a reject/busy/no-route handler has no retry, fallback, "
+                   "or give-up edge — stall by construction")
+
+
+class StateUnreachableRule(_ProtoRule):
+    id = "proto-state-unreachable"
+    description = ("a handler state precondition no send path establishes, "
+                   "or a handler unreachable from any entry send")
+
+
+class ProtoSuppressionRule(Rule):
+    """Suppressing a qrproto finding requires a one-line justification after
+    the rule ids — the same convention qrflow enforces for its ids."""
+
+    id = "proto-unjustified-suppression"
+    severity = "error"
+    description = ("a qrproto suppression comment carries no one-line "
+                   "justification after the rule id(s)")
+
+    _POLICED: frozenset[str] = frozenset({
+        "proto-unhandled-type", "proto-dead-handler", "proto-field-mismatch",
+        "proto-unnegotiated-send", "proto-reject-dead-end",
+        "proto-state-unreachable", "proto-unjustified-suppression",
+    })
+
+    def check_project(self, project: Project) -> None:
+        for ctx in project.contexts.values():
+            for lineno, line in enumerate(ctx.lines, start=1):
+                m = _SUPPRESS_RE.search(line)
+                if not m:
+                    continue
+                blob = m.group("rules")
+                rest = m.group("rest") or ""
+                sep = re.search(r"[^\w,\- ]", blob)
+                ids_part = blob[: sep.start()] if sep else blob
+                justification = (blob[sep.start():] if sep else "") + rest
+                ids = {tok for part in ids_part.split(",")
+                       for tok in part.strip().split() if tok}
+                proto_ids = ids & self._POLICED
+                if proto_ids and not re.search(r"\w", justification):
+                    node = _LineNode(lineno)
+                    project.report(
+                        self, ctx, node,
+                        f"suppression of {', '.join(sorted(proto_ids))} has "
+                        "no justification — append one after the rule id "
+                        "(e.g. `# qrproto: disable=proto-field-mismatch — "
+                        "field consumed by external tooling`)",
+                    )
+
+
+class _LineNode:
+    """Minimal AST-node stand-in so line-anchored findings route through
+    the normal report/suppression machinery."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.end_lineno = lineno
+        self.col_offset = 0
+
+
+PROTO_RULES = (
+    UnhandledTypeRule, DeadHandlerRule, FieldMismatchRule,
+    UnnegotiatedSendRule, RejectDeadEndRule, StateUnreachableRule,
+    ProtoSuppressionRule,
+)

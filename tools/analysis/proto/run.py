@@ -1,0 +1,108 @@
+"""qrproto CLI — ``python -m tools.analysis.proto.run <package-or-path>``.
+
+Exit status mirrors the qrlint/qrflow/qrkernel ratchet contract: 0 when
+the tree is clean (modulo explicit, JUSTIFIED suppressions), 1 when any
+error-severity finding remains, 2 on usage errors.  ``--format json``/
+``--format sarif`` emit machine-readable output; ``--dump-model`` prints
+the extracted protocol model instead of linting — the markdown verb/
+field/negotiation table docs/protocol.md commits (drift-pinned by
+tests/test_qrproto.py), or the full model as JSON with ``--format json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from ..engine import Engine, FileContext, Project, render_findings, resolve_target
+from ..flow.sarif import to_sarif
+from . import proto_rules
+from .model import extract_model, render_model_markdown
+
+
+def _resolve_target(target: str) -> Path:
+    return resolve_target(target, "qrproto")
+
+
+def _load_project(targets: list[Path]) -> Project:
+    files: list[Path] = []
+    for t in targets:
+        files.extend(sorted(t.rglob("*.py")) if t.is_dir() else [t])
+    contexts: dict[str, FileContext] = {}
+    for f in files:
+        try:
+            contexts[str(f)] = FileContext(str(f), f.read_text(encoding="utf-8"))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+    return Project(contexts)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="qrproto",
+        description=("cross-process protocol-contract & state-machine "
+                     "verifier for the wire layer (docs/static_analysis.md)"),
+    )
+    ap.add_argument("targets", nargs="*", default=["quantum_resistant_p2p_tpu"],
+                    help="files, directories, or package names (default: the package)")
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human", help="output format (default: human)")
+    ap.add_argument("--json", action="store_true",
+                    help="alias for --format json (qrlint compatibility)")
+    ap.add_argument("--select", help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    ap.add_argument("--dump-model", action="store_true",
+                    help=("print the extracted protocol model (markdown verb "
+                          "table; JSON with --format json) and exit"))
+    args = ap.parse_args(argv)
+
+    rules = proto_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.id:30} [{rule.severity}] {rule.description}")
+        return 0
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",")}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"qrproto: unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+    if args.ignore:
+        dropped = {r.strip() for r in args.ignore.split(",")}
+        rules = [r for r in rules if r.id not in dropped]
+
+    targets = [_resolve_target(t) for t in (args.targets or ["quantum_resistant_p2p_tpu"])]
+    fmt = "json" if args.json else args.format
+
+    if args.dump_model:
+        model = extract_model(_load_project(targets))
+        if fmt == "json":
+            print(json.dumps(model.as_dict(), indent=2))
+        else:
+            print(render_model_markdown(model), end="")
+        return 0
+
+    engine = Engine(rules)
+    findings, suppressed = engine.lint_paths(targets)
+
+    if fmt == "sarif":
+        print(json.dumps(to_sarif(findings, suppressed, rules,
+                                  tool_name="qrproto"), indent=2))
+    else:
+        out = render_findings(findings, suppressed, as_json=(fmt == "json"))
+        if out and fmt == "human":
+            lines = out.splitlines()
+            lines[-1] = lines[-1].replace("qrlint:", "qrproto:", 1)
+            out = "\n".join(lines)
+        if out:
+            print(out)
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

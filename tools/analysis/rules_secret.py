@@ -17,33 +17,22 @@ pack catches:
 from __future__ import annotations
 
 import ast
-import re
 
 from .engine import FileContext, Rule, call_name, last_attr
 
-#: identifiers that hold secret material.  ``_key`` suffixes are secret by
-#: default in this codebase (entry_key, index_key, log_key, shared_key, ...);
-#: the NONSECRET list walks back the public/verification-side names.
-SECRET_NAME_RE = re.compile(
-    r"(password|passwd|secret|private|master|keypair)"
-    r"|(^|_)stek($|_)"
-    r"|(^|_)(sk|skey)($|_)"
-    r"|(^|_)key$"
-    r"|^key$",
-    re.IGNORECASE,
+# the secret-name vocabulary is shared with the RUNTIME redactor
+# (obs/flight.py) — one module, imported by both sides, so static rules
+# and record-time redaction can never disagree on what "secret" means
+from quantum_resistant_p2p_tpu.obs.redaction import (  # noqa: F401  (re-export)
+    NONSECRET_NAME_RE,
+    SECRET_NAME_RE,
+    is_secret_name,
 )
-NONSECRET_NAME_RE = re.compile(r"(public|pub($|_)|(^|_)pk($|_)|verify|test)", re.IGNORECASE)
 
 #: method names treated as logging sinks.  log_event/_log are this repo's
 #: encrypted audit-log writers — decrypted and displayed by /logs.
 LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
                "log", "log_event", "_log"}
-
-
-def is_secret_name(name: str | None) -> bool:
-    if not name:
-        return False
-    return bool(SECRET_NAME_RE.search(name)) and not NONSECRET_NAME_RE.search(name)
 
 
 #: calls whose result no longer reveals the secret (sizes, types, hashes of

@@ -19,11 +19,18 @@ echo "python -m quantum_resistant_p2p_tpu --help ok"
 
 # Static-analysis ratchets (docs/static_analysis.md): the unified driver
 # runs qrlint (AST lint) -> qrflow (interprocedural taint/race) -> qrkernel
-# (abstract-interpretation kernel verifier) with ONE exit code, and asserts
-# the suppression budget (tools/analysis/suppression_budget.json): counts
-# per analyzer may only go down — an unbudgeted suppression fails loudly.
+# (abstract-interpretation kernel verifier) -> qrproto (protocol-contract
+# verifier) with ONE exit code, and asserts the suppression budget
+# (tools/analysis/suppression_budget.json): counts per analyzer may only
+# go down — an unbudgeted suppression fails loudly.
 python -m tools.analysis.all quantum_resistant_p2p_tpu
-echo "qr-analysis clean (qrlint + qrflow + qrkernel, within suppression budget)"
+echo "qr-analysis clean (qrlint + qrflow + qrkernel + qrproto, within suppression budget)"
+
+# The protocol model must still extract (send/handler/feature tables for
+# docs/protocol.md) — a refactor that breaks extraction would silently
+# blind the contract checks, so probe the dump path explicitly.
+python -m tools.analysis.proto.run quantum_resistant_p2p_tpu --dump-model >/dev/null
+echo "qrproto --dump-model ok"
 
 # Gateway storm smoke (docs/gateway.md): a fast 48-session storm through
 # the real TCP transport + protocol engine + autotuner must complete with
