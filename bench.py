@@ -734,6 +734,151 @@ def multichip_main(out_path: str | None, shards: str, hs_peers: int,
     return 0
 
 
+#: default dispatch rows for the --raw-ops --family frodo probe: a full
+#: lane tile x2 (the kernel's (8, 128) layout) — big enough to amortise
+#: the tunnel's fixed round trip, small enough for CPU-twin smoke runs
+FRODO_RAW_BATCH = 256
+#: the frodo raw-ops probe FAILS when less than this fraction of its ops
+#: rode the device path (same bar as --slo): a silently-degraded kernel
+#: path must not report fallback numbers as device numbers
+FRODO_MIN_DEVICE_SERVED = SLO_MIN_DEVICE_SERVED
+
+
+def frodo_raw_ops_main(out_path: str | None = None,
+                       batch: int = FRODO_RAW_BATCH,
+                       name: str = "FrodoKEM-640-SHAKE") -> int:
+    """Raw-ops probe for the FrodoKEM device path (``--raw-ops --family
+    frodo``): keygen / cold encaps / warm (operand-cached) encaps / decaps
+    per second at ``batch`` rows, same forced-readback methodology as the
+    ML-KEM headline (device-resident operands, 1-element readback fence).
+
+    The run is gated the way the SLO probe is: the pinned pyref KAT must
+    pass through the device path FIRST (provider/health.py), and the cost
+    ledger's ``device_served_fraction`` over the run must stay >=
+    ``FRODO_MIN_DEVICE_SERVED`` — a minimal image whose kernel path
+    regressed to fallback exits non-zero instead of shipping wrong numbers.
+    """
+    import sys
+    from pathlib import Path
+
+    import jax
+
+    from quantum_resistant_p2p_tpu.kem import frodo
+    from quantum_resistant_p2p_tpu.obs.cost import CostLedger
+    from quantum_resistant_p2p_tpu.provider import health
+    from quantum_resistant_p2p_tpu.provider.kem_providers import (
+        FrodoKEMKeyExchange)
+    from quantum_resistant_p2p_tpu.utils.benchmarking import (
+        enable_compile_cache, sync, timeit)
+
+    enable_compile_cache()
+    level = {"FrodoKEM-640-SHAKE": 1, "FrodoKEM-976-SHAKE": 3,
+             "FrodoKEM-1344-SHAKE": 5}[name]
+    kem = FrodoKEMKeyExchange(security_level=level, backend="tpu",
+                              use_aes=False)
+    p = kem.params
+    ledger = CostLedger()
+    kem.opcache.attach_cost(ledger, "frodo_pk")
+    ops_done = 0
+    ledger.set_handshakes_fn(lambda: max(ops_done, 1))
+
+    verdict = health._check_frodo_kat(kem)
+    short = name.replace("FrodoKEM-", "frodo").replace("-SHAKE", "shake")
+    out: dict = {
+        "metric": f"{short}_encaps_warm_batch{batch}",
+        "unit": "encaps/s",
+        "vs_baseline": None,  # no committed frodo baseline before this round
+        "platform": jax.devices()[0].platform,
+        "batch": batch,
+        "kat_ok": bool(verdict.ok),
+        "kat_detail": verdict.detail,
+        "min_device_served_fraction": FRODO_MIN_DEVICE_SERVED,
+    }
+    rc = 0
+    if not verdict.ok:
+        # every op this run WOULD have done is a bypass: the device path
+        # is not trustworthy, so nothing below is worth timing
+        ledger.bypass_items("frodo.encaps", "kat_failed", batch)
+        out.update({"value": None, "device_served_fraction": 0.0})
+        rc = 1
+    else:
+        rng = np.random.default_rng(640)
+
+        def dev(shape):
+            a = jax.device_put(
+                rng.integers(0, 256, size=shape, dtype=np.uint8))
+            sync(a)
+            return a
+
+        kg, _, dec = frodo.get(p.name)
+        enc_cold, enc_pre = frodo.get_pre(p.name)
+        s, se, z = (dev((batch, p.len_sec)) for _ in range(3))
+        mu = dev((batch, p.len_sec))
+        pk, sk = kg(s, se, z)
+        sync((pk, sk))
+        keygen_s = timeit(lambda: kg(s, se, z))
+        # single-key batch (the handshake shape): cold fills the per-key
+        # operand cache in one dispatch, warm reuses the device-resident
+        # expanded A matrix — the provider's opcache fast path
+        pk0 = jax.device_put(np.asarray(pk)[0])
+        sync(pk0)
+        cold_s = timeit(lambda: enc_cold(pk0, mu))
+        pre, ct, ss = enc_cold(pk0, mu)
+        sync((ct, ss))
+        warm_s = timeit(lambda: enc_pre(pre, mu))
+        skb = jax.device_put(np.broadcast_to(np.asarray(sk)[0],
+                                             (batch, p.sk_len)))
+        sync(skb)
+        decaps_s = timeit(lambda: dec(skb, ct))
+        for op, secs in (("keygen", keygen_s), ("encaps_cold", cold_s),
+                         ("encaps_warm", warm_s), ("decaps", decaps_s)):
+            # full rows, full bucket: raw ops pad nothing — the padding
+            # waste the ledger reports is genuinely the dispatch shape's
+            ledger.flush_occupancy(f"frodo.{op}", "bulk", batch, batch)
+            ledger.device_time(f"frodo.{op}", secs)
+            ops_done += batch
+        # provider surface: one cold + one warm single-key batch so the
+        # opcache accounting (hit rate, device-served story) reflects the
+        # path handshakes actually take
+        pks = np.broadcast_to(np.asarray(pk)[0], (batch, p.pk_len)).copy()
+        for _ in range(2):
+            kem.encapsulate_batch(pks)
+            ledger.flush_occupancy("frodo.encaps_provider", "bulk", batch,
+                                   batch)
+            ops_done += batch
+        served = ledger.device_served_fraction()
+        totals = ledger.totals()
+        out.update({
+            "value": round(batch / warm_s, 1),
+            "keygen_per_s": round(batch / keygen_s, 1),
+            "encaps_cold_per_s": round(batch / cold_s, 1),
+            "encaps_warm_per_s": round(batch / warm_s, 1),
+            "decaps_per_s": round(batch / decaps_s, 1),
+            "warm_vs_cold": round(cold_s / warm_s, 2),
+            "device_served_fraction": served,
+            "device_seconds_per_1k_ops":
+                ledger.device_seconds_per_1k_handshakes(),
+            "padding_waste_fraction": ledger.padding_waste_fraction(),
+            "opcache": kem.opcache.stats(),
+            "cost": totals,
+        })
+        if (served or 0.0) < FRODO_MIN_DEVICE_SERVED:
+            print(f"RAW-OPS FAIL: frodo run only {(served or 0.0):.1%} "
+                  f"device-served (< {FRODO_MIN_DEVICE_SERVED:.0%})",
+                  file=sys.stderr)
+            rc = 1
+    if not out["kat_ok"]:
+        print(f"RAW-OPS FAIL: frodo device KAT failed: {verdict.detail}",
+              file=sys.stderr)
+    line = json.dumps(out)
+    print(line)
+    Path("bench_results").mkdir(exist_ok=True)
+    Path("bench_results/frodo_raw_ops.json").write_text(line + "\n")
+    if out_path:
+        Path(out_path).write_text(line + "\n")
+    return rc
+
+
 def main() -> None:
     from quantum_resistant_p2p_tpu.kem import mlkem
     from quantum_resistant_p2p_tpu.utils.benchmarking import enable_compile_cache, sync, timeit
@@ -865,7 +1010,22 @@ if __name__ == "__main__":
     ap.add_argument("--emulate", type=int, default=0,
                     help="force an N-device virtual CPU platform for "
                          "--multichip (single-accelerator hosts)")
+    ap.add_argument("--raw-ops", action="store_true",
+                    help="raw per-op device throughput for one KEM family "
+                         "(see --family) instead of the handshake modes: "
+                         "keygen / cold + warm (operand-cached) encaps / "
+                         "decaps per second with forced readback, gated on "
+                         "the device KAT and >=90%% device-served")
+    ap.add_argument("--family", default="mlkem",
+                    choices=("mlkem", "frodo"),
+                    help="KEM family for --raw-ops: mlkem routes to the "
+                         "headline benchmark, frodo runs the FrodoKEM "
+                         "device-path probe")
+    ap.add_argument("--batch", type=int, default=FRODO_RAW_BATCH,
+                    help="dispatch rows for --raw-ops --family frodo")
     args = ap.parse_args()
+    if args.raw_ops and args.family == "frodo":
+        raise SystemExit(frodo_raw_ops_main(args.out, args.batch))
     if args.slo:
         raise SystemExit(slo_main(args.out, args.peers, args.warmup))
     if args.storm and args.fleet and args.roll:

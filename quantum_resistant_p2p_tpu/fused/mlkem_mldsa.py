@@ -121,7 +121,12 @@ def get_encaps_verify_sign(kem_name: str, sig_name: str, ct_off: int):
         sigma, done = mldsa.sign_mu(sp, sig_sk, mu, rnd)
         return ok, ct, key, sigma, done
 
-    return jax.jit(run)
+    # sig_in (the peer's signature, dead once verified) is donated: it is
+    # byte-for-byte the same shape/dtype as the sigma output, so XLA writes
+    # the response signature into the incoming one's buffer instead of
+    # allocating — one signature-sized HBM buffer saved per lane.  Callers
+    # must treat the operand as consumed (DONATED_ARGNUMS / donation_twin).
+    return jax.jit(run, donate_argnums=(4,))
 
 
 @functools.cache
@@ -139,4 +144,39 @@ def get_decaps_verify_sign(kem_name: str, sig_name: str):
         sigma, done = mldsa.sign_mu(sp, sig_sk, mu_out, rnd)
         return ok, ss, sigma, done
 
-    return jax.jit(run)
+    # same aliasing as get_encaps_verify_sign: the verified peer signature's
+    # buffer is reused for the confirm signature output
+    return jax.jit(run, donate_argnums=(4,))
+
+
+#: which positional operands each fused program consumes (donate_argnums):
+#: callers must not read those operands after the call.  qrkernel's
+#: read-after-donate rule polices call sites that jit with donation
+#: directly; for the factory-returned programs here, ``donation_twin``
+#: gives tests a CPU-faithful enforcement of the same contract.
+DONATED_ARGNUMS = {
+    "encaps_verify_sign": (4,),  # sig_in -> sigma
+    "decaps_verify_sign": (4,),  # sig_in -> sigma
+}
+
+
+def donation_twin(program, argnums: tuple[int, ...]):
+    """Wrap a donating jitted program so operand reuse raises on EVERY backend.
+
+    On TPU, XLA invalidates a donated operand's buffer — a later read
+    raises.  On CPU, donation is a silent no-op, so a call-site bug that
+    reuses a donated operand passes tests and corrupts data only in
+    production.  This twin restores the TPU semantics: after the call it
+    deletes each donated jax.Array operand, making any subsequent use raise
+    RuntimeError.  Tests run the fused programs through this wrapper
+    (tests/test_fused.py donation-safety regression).
+    """
+
+    def run(*args):
+        out = program(*args)
+        for i in argnums:
+            if isinstance(args[i], jax.Array):
+                args[i].delete()
+        return out
+
+    return run

@@ -117,7 +117,17 @@ def decompress(y: jax.Array, d: int) -> jax.Array:
 
 
 def ntt(f: jax.Array) -> jax.Array:
-    """(..., 256) int32 in [0,q) -> NTT domain, same shape."""
+    """(..., 256) int32 in [0,q) -> NTT domain, same shape.
+
+    On TPU the 7 butterfly layers run register-resident in one Pallas
+    kernel (kem/mlkem_pallas.py:ntt_words) — the jnp formulation below
+    materialises the full batched array between layers, 14 HBM round-trips
+    per transform."""
+    if keccak._use_pallas():
+        from . import mlkem_pallas  # deferred: pallas import
+
+        flat = f.reshape((-1, N))
+        return mlkem_pallas.ntt_words(flat.T).T.reshape(f.shape)
     zetas = jnp.asarray(_ZETAS)
     k = 1
     length = 128
@@ -134,6 +144,11 @@ def ntt(f: jax.Array) -> jax.Array:
 
 
 def ntt_inv(f: jax.Array) -> jax.Array:
+    if keccak._use_pallas():
+        from . import mlkem_pallas  # deferred: pallas import
+
+        flat = f.reshape((-1, N))
+        return mlkem_pallas.ntt_words(flat.T, inverse=True).T.reshape(f.shape)
     zetas = jnp.asarray(_ZETAS)
     k = 127
     length = 2
@@ -240,6 +255,23 @@ def _prf_cbd(s: jax.Array, n_consts: np.ndarray, eta: int) -> jax.Array:
     return sample_poly_cbd(keccak.shake256(seeds, 64 * eta), eta)
 
 
+def _prf_cbd_ntt(s: jax.Array, n_consts: np.ndarray, eta: int) -> jax.Array:
+    """``ntt(_prf_cbd(...))`` — fused into ONE Pallas kernel on TPU.
+
+    The noise polynomials that feed matrix products are consumed only in
+    the NTT domain, so squeezing, CBD-summing, and all 7 butterfly layers
+    run on the same VMEM-resident register tiles; the intermediate CBD
+    polynomial never touches HBM (kem/mlkem_pallas.py:cbd_ntt_words).
+    Bit-identical to the two-step form on every path."""
+    seeds = _prf_seeds(s, n_consts)
+    if keccak._use_pallas():
+        from . import mlkem_pallas  # deferred: pallas import
+
+        ph, plo, batch = keccak.seed_block_words(seeds, 136, 0x1F)
+        return mlkem_pallas.cbd_ntt_words(ph, plo, eta=eta).T.reshape(batch + (N,))
+    return ntt(sample_poly_cbd(keccak.shake256(seeds, 64 * eta), eta))
+
+
 def _expand_matrix(rho: jax.Array, k: int) -> jax.Array:
     """rho (..., 32) -> A_hat (..., k, k, 256) with A[i,j] = SampleNTT(rho||j||i)."""
     ji = np.array([[j, i] for i in range(k) for j in range(k)], dtype=np.uint8)
@@ -263,9 +295,9 @@ def _kpke_keygen(p: MLKEMParams, d: jax.Array):
     g = keccak.sha3_512(kin)
     rho, sigma = g[..., :32], g[..., 32:]
     a_hat = _expand_matrix(rho, k)
-    noise = _prf_cbd(sigma, np.arange(2 * k), p.eta1)
-    s_hat = ntt(noise[..., :k, :])
-    e_hat = ntt(noise[..., k:, :])
+    noise_hat = _prf_cbd_ntt(sigma, np.arange(2 * k), p.eta1)
+    s_hat = noise_hat[..., :k, :]
+    e_hat = noise_hat[..., k:, :]
     t_hat = (
         jnp.sum(multiply_ntts(a_hat, s_hat[..., None, :, :]), axis=-2) + e_hat
     ) % Q
@@ -293,10 +325,9 @@ def _kpke_encrypt_pre(p: MLKEMParams, t_hat: jax.Array, a_hat: jax.Array,
     key's ExpandA across every encaps against that key.
     """
     k = p.k
-    y = _prf_cbd(r, np.arange(k), p.eta1)
     e1 = _prf_cbd(r, np.arange(k, 2 * k), p.eta2)
     e2 = _prf_cbd(r, np.array([2 * k]), p.eta2)[..., 0, :]
-    y_hat = ntt(y)
+    y_hat = _prf_cbd_ntt(r, np.arange(k), p.eta1)
     # u = invNTT(A^T ∘ y_hat) + e1 : contract over row index i of A[i,j]
     u = (
         ntt_inv(jnp.sum(multiply_ntts(a_hat, y_hat[..., :, None, :]), axis=-3) % Q)

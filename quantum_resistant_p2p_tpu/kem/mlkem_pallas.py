@@ -170,3 +170,157 @@ def cbd_words(in_hi: jax.Array, in_lo: jax.Array, *, eta: int,
     """
     return sampler_call(functools.partial(_cbd_kernel, eta=eta),
                         CBD_RATE_WORDS, N_OUT, in_hi, in_lo, interpret=interpret)
+
+
+# --------------------------------------------------------------------------
+# NTT over Z_q[X]/(X^256+1), q = 3329 (FIPS 203 §4.3) — VMEM-resident
+# --------------------------------------------------------------------------
+#
+# Same register-resident recipe as sig/mldsa_pallas.py:ntt_tiles, but the
+# small modulus makes the butterflies cheaper: q^2 = 11_082_241 < 2**31, so
+# a zeta product is ONE int32 multiply + remainder — no limb split.  The
+# jnp formulation (kem/mlkem.py ntt/ntt_inv) materialises the full batched
+# coefficient array between each of the 7 butterfly layers — 14 HBM
+# round-trips per transform, and an encaps runs k NTTs + k+1 invNTTs.
+# Here a poly's 256 coefficients are 256 (8, 128) int32 register tiles
+# spanning 1024 lanes; HBM sees one read + one write per transform, and the
+# fused CBD->NTT kernel below sees NONE (the CBD output never leaves VMEM).
+
+from ..pyref.mlkem_ref import ZETAS as _ZETAS_PY
+
+_N = 256
+_N_INV = pow(128, -1, Q)  # 3303: ML-KEM's NTT has 128 base pairs, not 256 slots
+
+
+def _mul_zeta(a, z: int):
+    """(a * z) % Q for an int32 tile a in [0, q) and STATIC z in [0, q).
+
+    q^2 < 2**31 so the product cannot overflow int32 (unlike ML-DSA's
+    q = 8380417, which needs the Horner limb split) — the bound is
+    machine-checked by qrkernel's interval analysis from the contracts."""
+    # qrkernel: assume a in [0, Q) — FIPS 203 §4.3: butterfly operands are mod-q residues (every caller reduces % Q first)
+    # qrkernel: assume z in [0, Q) — zeta table entries are powers of the 256th root of unity mod q
+    return (a * z) % Q
+
+
+def ntt_tiles(f: list) -> list:
+    """256 int32 tiles in [0, q) -> NTT domain (bit-exact vs mlkem.ntt)."""
+    f = list(f)
+    k = 1
+    length = 128
+    while length >= 2:  # ML-KEM stops at length 2: 128 degree-1 residues
+        groups = _N // (2 * length)
+        for g in range(groups):
+            z = int(_ZETAS_PY[k + g])
+            base = g * 2 * length
+            for j in range(length):
+                i0, i1 = base + j, base + length + j
+                t = _mul_zeta(f[i1], z)
+                f[i0], f[i1] = (f[i0] + t) % Q, (f[i0] - t) % Q
+        k += groups
+        length //= 2
+    return f
+
+
+def ntt_inv_tiles(f: list) -> list:
+    """Inverse transform; bit-exact vs mlkem.ntt_inv."""
+    f = list(f)
+    k = 127
+    length = 2
+    while length <= 128:
+        groups = _N // (2 * length)
+        zs = [int(_ZETAS_PY[k - groups + 1 + i]) for i in range(groups)][::-1]
+        for g in range(groups):
+            base = g * 2 * length
+            for j in range(length):
+                i0, i1 = base + j, base + length + j
+                s = (f[i0] + f[i1]) % Q
+                t = _mul_zeta((f[i1] - f[i0]) % Q, zs[g])
+                f[i0], f[i1] = s, t
+        k -= groups
+        length *= 2
+    return [_mul_zeta(x, _N_INV) for x in f]
+
+
+def _ntt_kernel(in_ref, out_ref, *, inverse: bool):
+    f = [in_ref[i] for i in range(_N)]
+    out = ntt_inv_tiles(f) if inverse else ntt_tiles(f)
+    for i in range(_N):
+        out_ref[i] = out[i]
+
+
+@functools.partial(jax.jit, static_argnames=("inverse", "interpret"))
+def ntt_words(x: jax.Array, *, inverse: bool = False, interpret: bool = False):
+    """Batched (inv)NTT over words layout.
+
+    Args:
+      x: (256, L) int32 coefficients in [0, q), lanes batch-minor (L is
+        padded to the 1024-lane tile internally).
+
+    Returns:
+      (256, L) int32 transformed coefficients.
+    """
+    from jax.experimental import pallas as pl
+
+    from ..core.keccak_pallas import _TL, _TS, BT
+
+    n, l = x.shape
+    assert n == _N
+    lp = -(-l // BT) * BT
+    if lp != l:
+        x = jnp.pad(x, ((0, 0), (0, lp - l)))
+    x = x.reshape(_N, lp // _TL, _TL)
+    out = pl.pallas_call(
+        functools.partial(_ntt_kernel, inverse=inverse),
+        grid=(lp // BT,),
+        in_specs=[pl.BlockSpec((_N, _TS, _TL), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((_N, _TS, _TL), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((_N, lp // _TL, _TL), jnp.int32),
+        interpret=interpret,
+    )(x)
+    return out.reshape(_N, lp)[:, :l]
+
+
+# --------------------------------------------------------------------------
+# Fused PRF + SamplePolyCBD + NTT: SHAKE-256 -> CBD_eta -> NTT, one kernel
+# --------------------------------------------------------------------------
+#
+# The noise polynomials that feed matrix products (s, e at keygen; y at
+# encrypt) are consumed ONLY in the NTT domain, so the separate cbd_words
+# -> HBM -> ntt jnp-layer pipeline pays a full (256, B) round-trip plus 14
+# layer materialisations for data that never needed to exist outside VMEM.
+# This kernel squeezes the sponge, forms the CBD sums, and runs all 7
+# butterfly layers on the register tiles before anything is written back.
+
+
+def _cbd_ntt_tiles(in_hi: list, in_lo: list, eta: int) -> list:
+    """PRF_eta + CBD_eta + NTT over 17 input lane-word tiles.
+
+    Composition of the two tile pipelines above — _cbd_tiles' outputs are
+    already reduced to [0, q), the domain ntt_tiles' contracts require."""
+    return ntt_tiles(_cbd_tiles(in_hi, in_lo, eta))
+
+
+def _cbd_ntt_kernel(in_hi_ref, in_lo_ref, out_ref, *, eta: int):
+    out = _cbd_ntt_tiles(
+        [in_hi_ref[w] for w in range(CBD_RATE_WORDS)],
+        [in_lo_ref[w] for w in range(CBD_RATE_WORDS)],
+        eta,
+    )
+    for i in range(N_OUT):
+        out_ref[i] = out[i]
+
+
+@functools.partial(jax.jit, static_argnames=("eta", "interpret"))
+def cbd_ntt_words(in_hi: jax.Array, in_lo: jax.Array, *, eta: int,
+                  interpret: bool = False):
+    """Batched PRF+CBD+NTT over word-transposed padded seed blocks.
+
+    Same contract as cbd_words but the coefficients come back already in
+    the NTT domain — the intermediate CBD polynomial never touches HBM.
+
+    Returns:
+      (256, B) int32 NTT-domain coefficients in [0, q).
+    """
+    return sampler_call(functools.partial(_cbd_ntt_kernel, eta=eta),
+                        CBD_RATE_WORDS, N_OUT, in_hi, in_lo, interpret=interpret)

@@ -21,6 +21,12 @@ PAPERS.md #5's continuous in-hardware evaluation):
   the headline ``device_seconds_per_1k_handshakes`` derived gauge.
 * **Opcache effectiveness** — sliding-window hit rates per cache (the
   cumulative counters hide regressions; a window shows the CURRENT rate).
+* **Scalar bypasses** — items the device path never saw (oversized AEAD
+  payloads past the bucket caps run scalar and never enqueue:
+  ``provider/batched.py``).  Without this family those items silently
+  vanish from the occupancy denominator and the ledger's "device-served"
+  story overstates coverage; ``device_served_fraction`` derives real /
+  (real + bypassed).
 * **Autotuner decision journal** — every ``decide()`` step with its
   inputs and chosen bucket/window, sequence-numbered and stamped with the
   tuner's (injectable) clock, so a seeded storm's tuning trajectory is
@@ -29,6 +35,7 @@ PAPERS.md #5's continuous in-hardware evaluation):
 Everything lands in the engine's metrics registry as labeled instruments
 (``cost_compile_events{queue,shard,where}``,
 ``cost_flush_items_real{queue,lane}`` / ``…_padded``,
+``cost_bypass_items{queue,reason}``,
 ``cost_device_seconds{op}``, ``opcache_hit_rate{cache}``,
 ``padding_waste_fraction``, ``device_seconds_per_1k_handshakes``) so one
 Prometheus scrape exports the economics, and compile events additionally
@@ -82,6 +89,8 @@ class CostLedger:
         self._clock = clock
         #: (queue, lane) -> [real_items, padded_slots, flushes]
         self._occ: dict[tuple[str, str], list] = {}
+        #: (queue, reason) -> items that ran scalar, never enqueued
+        self._bypass: dict[tuple[str, str], int] = {}
         #: (queue, shard_key, where) -> [events, wall_seconds]
         self._compile_totals: dict[tuple[str, str, str], list] = {}
         self._compile_events: deque[dict[str, Any]] = deque(maxlen=COMPILE_EVENT_CAP)
@@ -96,7 +105,7 @@ class CostLedger:
         self._handshakes_fn: Callable[[], int] | None = None
         # registry instruments (None without a registry: recording-only)
         self._ctr_compile = self._g_compile_s = None
-        self._ctr_real = self._ctr_pad = None
+        self._ctr_real = self._ctr_pad = self._ctr_bypass = None
         self._g_dev = self._g_hit = None
         if registry is not None:
             self._ctr_compile = registry.counter(
@@ -111,6 +120,10 @@ class CostLedger:
             self._ctr_pad = registry.counter(
                 "cost_flush_items_padded",
                 "padded pow2 slots dispatched empty, by queue/lane")
+            self._ctr_bypass = registry.counter(
+                "cost_bypass_items",
+                "items served on the scalar path without enqueueing, "
+                "by queue/reason")
             self._g_dev = registry.gauge(
                 "cost_device_seconds",
                 "cumulative on-worker device-program seconds, by op family")
@@ -162,6 +175,18 @@ class CostLedger:
         if c is not None:
             c.inc(real)
             self._child(self._ctr_pad, queue=queue, lane=lane).inc(padded)
+
+    def bypass_items(self, queue: str, reason: str, n: int = 1) -> None:
+        """``n`` items served on the scalar path WITHOUT enqueueing (e.g.
+        AEAD payloads past the device facade's bucket caps).  Keeps the
+        device-served denominator honest: these items are real traffic the
+        occupancy rows never see."""
+        with self._lock:
+            key = (queue, reason)
+            self._bypass[key] = self._bypass.get(key, 0) + n
+        c = self._child(self._ctr_bypass, queue=queue, reason=reason)
+        if c is not None:
+            c.inc(n)
 
     def compile_event(self, queue: str, bucket: int, seconds: float,
                       where: str, shard: int | None = None) -> None:
@@ -251,6 +276,18 @@ class CostLedger:
         total = real + padded
         return round(padded / total, 6) if total else None
 
+    def device_served_fraction(self, queue: str | None = None) -> float | None:
+        """Real device-flushed items / (those + scalar bypasses) — the
+        truthful "how much traffic the device actually served" gauge
+        (None before any item either way)."""
+        with self._lock:
+            real = sum(row[0] for (q, _lane), row in self._occ.items()
+                       if queue is None or q == queue)
+            bypassed = sum(n for (q, _r), n in self._bypass.items()
+                           if queue is None or q == queue)
+        total = real + bypassed
+        return round(real / total, 6) if total else None
+
     def device_seconds_total(self) -> float:
         with self._lock:
             return sum(self._device_s.values())
@@ -294,16 +331,21 @@ class CostLedger:
         with self._lock:
             real = sum(r[0] for r in self._occ.values())
             padded = sum(r[1] for r in self._occ.values())
+            bypassed = sum(self._bypass.values())
             hits = sum(t[1][0] for t in self._opcache.values())
             misses = sum(t[1][1] for t in self._opcache.values())
             device_s = sum(self._device_s.values())
         total = real + padded
+        served = real + bypassed
         looked = hits + misses
         return {
             "items_real": real,
             "items_padded": padded,
+            "items_bypassed": bypassed,
             "padding_waste_fraction": (round(padded / total, 6)
                                        if total else None),
+            "device_served_fraction": (round(real / served, 6)
+                                       if served else None),
             "compile_events": events,
             "compile_seconds": seconds,
             "device_seconds": round(device_s, 6),
@@ -347,10 +389,16 @@ class CostLedger:
                 }
                 for kind, (win, totals) in sorted(self._opcache.items())
             }
+            bypass = {
+                f"{q}[{reason}]": n
+                for (q, reason), n in sorted(self._bypass.items())
+            }
             journal_tail = list(self._journal)[-SNAPSHOT_TAIL:]
             journal_seq = self._journal_seq
         return {
             "padding_waste_fraction": self.padding_waste_fraction(),
+            "device_served_fraction": self.device_served_fraction(),
+            "bypasses": bypass,
             "device_seconds_total": round(self.device_seconds_total(), 6),
             "device_seconds_per_1k_handshakes":
                 self.device_seconds_per_1k_handshakes(),

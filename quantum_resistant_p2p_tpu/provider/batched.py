@@ -1311,6 +1311,10 @@ class BatchedAEAD:
             # oversized for the device bucket space: scalar path, off-loop
             # (a wheel-less pure-Python seal of a big file must not stall
             # every peer this loop serves)
+            if self.cost is not None:
+                # keep the ledger's device-served story truthful: this item
+                # never enqueues, so the occupancy rows never see it
+                self.cost.bypass_items(f"{self.name}.seal", "oversize")
             return await asyncio.get_running_loop().run_in_executor(
                 None, functools.partial(
                     self.scalar.encrypt, bytes(key), bytes(plaintext),
@@ -1330,6 +1334,8 @@ class BatchedAEAD:
             raise ValueError("ciphertext too short")
         if (len(data) - self.nonce_size - self.tag_size > self.device.max_len
                 or len(ad) > self.device.max_aad_len):
+            if self.cost is not None:
+                self.cost.bypass_items(f"{self.name}.open", "oversize")
             return await asyncio.get_running_loop().run_in_executor(
                 None, functools.partial(
                     self.scalar.decrypt, bytes(key), bytes(data), ad or None))

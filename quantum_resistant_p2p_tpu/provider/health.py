@@ -62,6 +62,19 @@ _MLKEM768_KAT = {
     "ss_hex": "9cddd089ffe70e3996e76f7c8d06746df34d07e8657bc0fcf2bb0e1c3084aea1",
 }
 
+#: pinned FrodoKEM-640-SHAKE KAT, computed from pyref/frodo_ref (keygen
+#: seeds s=00..0f, seedSE=10..1f, z=20..2f; encaps mu=30..3f); the Pallas
+#: matmul + inline-SHAKE device path must reproduce these byte-for-byte
+_FRODO640SHAKE_KAT = {
+    "s": bytes(range(16)),
+    "seed_se": bytes(range(16, 32)),
+    "z": bytes(range(32, 48)),
+    "mu": bytes(range(48, 64)),
+    "pk_sha256": "e1933f44de4f6410af9155c4baa3b7454c6e93ec7701971daee3c7d2be3e03f3",
+    "ct_sha256": "eefd2976cb8656e208526b33babf14eccd8f9a123db06e6032a30c449c1fc211",
+    "ss_hex": "c2cb61ee5b4f5f6679259f09fc6b253b",
+}
+
 
 @dataclasses.dataclass
 class HealthVerdict:
@@ -183,6 +196,42 @@ def _check_mlkem_kat(algo) -> HealthVerdict:
     if bytes(np.asarray(ss2[0], np.uint8)) != ss_b:
         return HealthVerdict(algo.name, False, "decaps KAT mismatch")
     return HealthVerdict(algo.name, True, "FIPS 203 KAT ok (keygen/encaps/decaps)")
+
+
+def _check_frodo_kat(algo) -> HealthVerdict:
+    """Pinned FrodoKEM-640-SHAKE vector through the device (jax) path, batch-1.
+
+    The SHAKE parameter sets share the Pallas matmul + inline-SHAKE kernels
+    (kem/frodo_pallas.py), so one pinned set certifies the whole family's
+    tile math on this environment; the AES sets still go through the
+    generic roundtrip probe.
+    """
+    import numpy as np
+
+    from ..kem import frodo
+
+    kat = _FRODO640SHAKE_KAT
+    kg, enc, dec = frodo.get("FrodoKEM-640-SHAKE")
+    s = np.frombuffer(kat["s"], np.uint8)[None]
+    se = np.frombuffer(kat["seed_se"], np.uint8)[None]
+    z = np.frombuffer(kat["z"], np.uint8)[None]
+    mu = np.frombuffer(kat["mu"], np.uint8)[None]
+    pk, sk = kg(s, se, z)
+    pk_b = bytes(np.asarray(pk[0], np.uint8))
+    if hashlib.sha256(pk_b).hexdigest() != kat["pk_sha256"]:
+        return HealthVerdict(algo.name, False, "keygen KAT mismatch (pk)")
+    ct, ss = enc(pk, mu)
+    ct_b = bytes(np.asarray(ct[0], np.uint8))
+    ss_b = bytes(np.asarray(ss[0], np.uint8))
+    if hashlib.sha256(ct_b).hexdigest() != kat["ct_sha256"]:
+        return HealthVerdict(algo.name, False, "encaps KAT mismatch (ct)")
+    if ss_b.hex() != kat["ss_hex"]:
+        return HealthVerdict(algo.name, False, "encaps KAT mismatch (ss)")
+    ss2 = dec(sk, ct)
+    if bytes(np.asarray(ss2[0], np.uint8)) != ss_b:
+        return HealthVerdict(algo.name, False, "decaps KAT mismatch")
+    return HealthVerdict(algo.name, True,
+                         "FrodoKEM KAT ok (keygen/encaps/decaps, pyref-pinned)")
 
 
 def _check_kem_roundtrip(algo, cpu_twin) -> HealthVerdict:
@@ -318,6 +367,9 @@ def _probe(algo, cpu_twin) -> HealthVerdict:
         # the pinned vector covers keygen/encaps/decaps end to end; the
         # generic roundtrip would add nothing
         return _check_mlkem_kat(algo)
+    if name.startswith("FrodoKEM") and name.endswith("SHAKE"):
+        # certifies the shared Pallas matmul + inline-SHAKE kernel family
+        return _check_frodo_kat(algo)
     if isinstance(algo, KeyExchangeAlgorithm):
         return _check_kem_roundtrip(algo, cpu_twin)
     if isinstance(algo, SignatureAlgorithm):

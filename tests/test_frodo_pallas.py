@@ -1,0 +1,239 @@
+"""FrodoKEM fused Pallas matmul: tile math vs twins, KAT matrix vs pyref.
+
+The kernel BODIES (``_s_times_a_tiles`` / ``_a_times_s_tiles`` /
+``_cdf_tiles``) are pure tile functions, tested eagerly on CPU arrays
+against the scanned-jnp twins — the same discipline as
+tests/test_keccak_pallas.py (interpret mode is orders of magnitude too
+slow for sponge kernels; the bench exercises native Mosaic on-chip).
+The end-to-end keygen/encaps/decaps path is pinned against
+``pyref.frodo_ref`` across all three SHAKE parameter sets and batch
+1/4/256 (the big/slow cells marked slow).
+"""
+
+import numpy as np
+import pytest
+
+from quantum_resistant_p2p_tpu.pyref import frodo_ref as fr
+
+RNG = np.random.default_rng(6408)
+
+SET_640 = "FrodoKEM-640-SHAKE"
+
+
+# --------------------------------------------------------------------------
+# Tile functions vs twin math (eager, CPU)
+# --------------------------------------------------------------------------
+
+
+def _tile_inputs(p, lanes, row0):
+    """Seed-block word tiles + absolute-row tile for an 8-row chunk."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import frodo_pallas as fp
+
+    seed_a = jnp.asarray(
+        RNG.integers(0, 256, size=(lanes, 16), dtype=np.uint8))
+    ph, plo, _ = fp.seed_words(p, seed_a)
+    in_hi = [ph[w] for w in range(fp.RATE_WORDS)]
+    in_lo = [plo[w] for w in range(fp.RATE_WORDS)]
+    row = jnp.broadcast_to(
+        (jnp.arange(8)[:, None] + row0).astype(jnp.uint32), (8, lanes))
+    return seed_a, in_hi, in_lo, row
+
+
+def test_s_times_a_tiles_match_row_twin():
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import frodo_pallas as fp
+
+    p = fr.PARAMS[SET_640]
+    lanes, row0 = 4, 16
+    seed_a, in_hi, in_lo, row = _tile_inputs(p, lanes, row0)
+    sp_full = jnp.asarray(
+        RNG.integers(0, p.q, size=(lanes, fr.NBAR, p.n), dtype=np.int32))
+    # S' columns for the 8 A rows of this tile: (NBAR, 8, lanes)
+    sp_tile = jnp.moveaxis(sp_full[..., row0:row0 + 8], 0, -1)
+    got = fp._s_times_a_tiles(in_hi, in_lo, sp_tile, row,
+                              n=p.n, q_mask=p.q - 1, n_sq=fp.row_blocks(p))
+    a_rows = fp._gen_rows_jnp(p, seed_a, row0, 8)  # (lanes, 8, n)
+    ref = jnp.einsum("lir,lrn->inl", sp_full[..., row0:row0 + 8], a_rows)
+    assert (np.asarray(got) & (p.q - 1) == np.asarray(ref) & (p.q - 1)).all()
+
+
+def test_a_times_s_tiles_match_row_twin():
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import frodo_pallas as fp
+
+    p = fr.PARAMS[SET_640]
+    lanes, row0 = 4, 632  # last row chunk: exercises the ragged squeeze tail
+    seed_a, in_hi, in_lo, row = _tile_inputs(p, lanes, row0)
+    s_full = jnp.asarray(
+        RNG.integers(0, p.q, size=(lanes, p.n, fr.NBAR), dtype=np.int32))
+    got = fp._a_times_s_tiles(in_hi, in_lo, jnp.moveaxis(s_full, 0, -1), row,
+                              n=p.n, q_mask=p.q - 1, n_sq=fp.row_blocks(p))
+    a_rows = fp._gen_rows_jnp(p, seed_a, row0, 8)  # (lanes, 8, n)
+    ref = jnp.einsum("lrn,lnj->rjl", a_rows, s_full)
+    assert (np.asarray(got) & (p.q - 1) == np.asarray(ref) & (p.q - 1)).all()
+
+
+def test_cdf_tiles_match_sample_twin():
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import frodo_pallas as fp
+
+    for name in ("FrodoKEM-640-SHAKE", "FrodoKEM-976-SHAKE",
+                 "FrodoKEM-1344-SHAKE"):
+        p = fr.PARAMS[name]
+        r = jnp.asarray(
+            RNG.integers(0, 1 << 16, size=(8, 128), dtype=np.int32))
+        got = fp._cdf_tiles(r, tuple(p.cdf), p.q - 1)
+        # the spec's inversion sampling, written independently of the tile fn
+        t = np.asarray(r) >> 1
+        e = np.zeros_like(t)
+        for c in p.cdf[:-1]:
+            e += (t > c).astype(np.int32)
+        ref = np.where((np.asarray(r) & 1) == 1, -e, e) & (p.q - 1)
+        assert (np.asarray(got) == ref).all()
+
+
+def test_cdf_launcher_interpret_matches_tiles():
+    """The one launcher cheap enough for interpret mode (no sponge)."""
+    import jax.numpy as jnp
+
+    from quantum_resistant_p2p_tpu.kem import frodo_pallas as fp
+
+    p = fr.PARAMS[SET_640]
+    r = jnp.asarray(RNG.integers(0, 1 << 16, size=(300,), dtype=np.int32))
+    got = fp.cdf_sample_words(r, cdf=tuple(p.cdf), q_mask=p.q - 1,
+                              interpret=True)
+    ref = fp._cdf_tiles(r, tuple(p.cdf), p.q - 1)
+    assert (np.asarray(got) == np.asarray(ref)).all()
+
+
+# --------------------------------------------------------------------------
+# Device(-twin) path vs pyref oracle: 3 SHAKE sets x batch 1/4/256
+# --------------------------------------------------------------------------
+
+_slow = pytest.mark.slow
+
+KAT_MATRIX = [
+    ("FrodoKEM-640-SHAKE", 1, 1, []),
+    ("FrodoKEM-640-SHAKE", 4, 2, []),
+    ("FrodoKEM-640-SHAKE", 256, 2, [_slow]),
+    ("FrodoKEM-976-SHAKE", 1, 1, [_slow]),
+    ("FrodoKEM-976-SHAKE", 4, 1, [_slow]),
+    ("FrodoKEM-976-SHAKE", 256, 1, [_slow]),
+    ("FrodoKEM-1344-SHAKE", 1, 1, [_slow]),
+    ("FrodoKEM-1344-SHAKE", 4, 1, [_slow]),
+    ("FrodoKEM-1344-SHAKE", 256, 1, [_slow]),
+]
+
+
+@pytest.mark.parametrize(
+    "name,batch,oracle_lanes",
+    [pytest.param(n, b, o, marks=m, id=f"{n}-b{b}") for n, b, o, m in KAT_MATRIX])
+def test_kat_matrix_vs_pyref(name, batch, oracle_lanes):
+    """keygen/encaps/decaps byte-exact vs pyref for the first
+    ``oracle_lanes`` lanes (pyref is pure Python — seconds per lane at 976+),
+    decaps self-consistency + implicit-rejection across the whole batch."""
+    from quantum_resistant_p2p_tpu.kem import frodo as jfr
+
+    p = fr.PARAMS[name]
+    kg, enc, dec = jfr.get(name)
+    sec = p.len_sec
+    s = RNG.integers(0, 256, size=(batch, sec), dtype=np.uint8)
+    se = RNG.integers(0, 256, size=(batch, sec), dtype=np.uint8)
+    z = RNG.integers(0, 256, size=(batch, sec), dtype=np.uint8)
+    mu = RNG.integers(0, 256, size=(batch, sec), dtype=np.uint8)
+    pk, sk = kg(s, se, z)
+    pk, sk = np.asarray(pk), np.asarray(sk)
+    ct, ss = enc(pk, mu)
+    ct, ss = np.asarray(ct), np.asarray(ss)
+    ss_dec = np.asarray(dec(sk, ct))
+    assert (ss_dec == ss).all()
+    for i in range(oracle_lanes):
+        rpk, rsk = fr.keygen(p, s[i].tobytes(), se[i].tobytes(), z[i].tobytes())
+        assert bytes(pk[i]) == rpk
+        assert bytes(sk[i]) == rsk
+        rct, rss = fr.encaps(p, rpk, mu[i].tobytes())
+        assert bytes(ct[i]) == rct
+        assert bytes(ss[i]) == rss
+    # implicit rejection: tampered ct must not reproduce the shared secret
+    bad = ct.copy()
+    bad[:, 3] ^= 0xFF
+    assert not (np.asarray(dec(sk, bad)) == ss).all(axis=-1).any()
+
+
+# --------------------------------------------------------------------------
+# Per-key precompute (opcache seam) + provider path + health gate
+# --------------------------------------------------------------------------
+
+
+def test_encaps_pre_bit_identical_to_plain():
+    from quantum_resistant_p2p_tpu.kem import frodo as jfr
+
+    p = fr.PARAMS[SET_640]
+    kg, enc, _ = jfr.get(SET_640)
+    enc_cold, enc_pre = jfr.get_pre(SET_640)
+    sec = p.len_sec
+    seeds = RNG.integers(0, 256, size=(4, 1, sec), dtype=np.uint8)
+    pk, _ = kg(seeds[0], seeds[1], seeds[2])
+    mu = RNG.integers(0, 256, size=(3, sec), dtype=np.uint8)
+    pk3 = np.broadcast_to(np.asarray(pk)[0], (3, p.pk_len))
+    ct0, ss0 = enc(pk3, mu)
+    pre, ct1, ss1 = enc_cold(np.asarray(pk)[0], mu)
+    ct2, ss2 = enc_pre(pre, mu)
+    assert (np.asarray(ct0) == np.asarray(ct1)).all()
+    assert (np.asarray(ss0) == np.asarray(ss1)).all()
+    assert (np.asarray(ct1) == np.asarray(ct2)).all()
+    assert (np.asarray(ss1) == np.asarray(ss2)).all()
+
+
+def test_provider_opcache_single_key_path():
+    from quantum_resistant_p2p_tpu.provider.kem_providers import (
+        FrodoKEMKeyExchange,
+    )
+
+    kem = FrodoKEMKeyExchange(security_level=1, backend="tpu", use_aes=False)
+    assert kem.opcache is not None
+    pk, sk = kem.generate_keypair()
+    # cold miss fills the cache, warm hit serves from it — both roundtrip
+    ct1, ss1 = kem.encapsulate(pk)
+    assert kem.opcache.misses == 1 and kem.opcache.hits == 0
+    ct2, ss2 = kem.encapsulate(pk)
+    assert kem.opcache.hits == 1
+    assert kem.decapsulate(sk, ct1) == ss1
+    assert kem.decapsulate(sk, ct2) == ss2
+    # mixed-key batch bypasses the single-key opcache path
+    pk2, sk2 = kem.generate_keypair()
+    pks = np.stack([np.frombuffer(pk, np.uint8), np.frombuffer(pk2, np.uint8)])
+    hits_before = kem.opcache.hits
+    ct, ss = kem.encapsulate_batch(pks)
+    assert kem.opcache.hits == hits_before
+    assert kem.decapsulate(sk2, bytes(ct[1])) == bytes(ss[1])
+
+
+def test_opcache_disabled_by_size_zero():
+    from quantum_resistant_p2p_tpu.provider.kem_providers import (
+        FrodoKEMKeyExchange,
+    )
+
+    kem = FrodoKEMKeyExchange(security_level=1, backend="tpu", use_aes=False,
+                              opcache_size=0)
+    assert kem.opcache is None
+    pk, sk = kem.generate_keypair()
+    ct, ss = kem.encapsulate(pk)
+    assert kem.decapsulate(sk, ct) == ss
+
+
+def test_health_frodo_kat_probe():
+    from quantum_resistant_p2p_tpu.provider import health
+    from quantum_resistant_p2p_tpu.provider.kem_providers import (
+        FrodoKEMKeyExchange,
+    )
+
+    kem = FrodoKEMKeyExchange(security_level=1, backend="tpu", use_aes=False)
+    verdict = health._check_frodo_kat(kem)
+    assert verdict.ok, verdict.detail
+    assert "KAT ok" in verdict.detail

@@ -243,3 +243,78 @@ def test_sliced_dispatch_double_buffered_matches_naive():
     # single-output fn, exact multiple of step
     out = sliced_dispatch(lambda v: v - 1, 5, np.arange(10).reshape(10, 1))
     assert (out == np.arange(10).reshape(10, 1) - 1).all()
+
+
+# ------------------------------------------------------------- donation safety
+
+
+def test_donated_sig_in_reuse_raises_in_twin(pair):
+    """Operand reuse after a donating fused call must raise in the test twin.
+
+    The verify+sign programs donate argnum 4 (the peer's verified signature,
+    whose buffer is reused for the response sigma).  On TPU a later read of
+    the donated buffer raises; on CPU donation is a silent no-op, so
+    ``donation_twin`` restores the TPU semantics by deleting the donated
+    jax.Array after the call — a call site that still reads it fails HERE
+    instead of corrupting data on the accelerator.
+    """
+    import jax.numpy as jnp
+
+    kem, sig, fused = pair
+    n = 2
+    pk_off, ct_off = init_pk_offset(kem.name, AEAD), resp_ct_offset()
+    spk, ssk = sig.generate_keypair()
+    sks = np.stack([np.frombuffer(ssk, np.uint8)] * n)
+    spks = np.stack([np.frombuffer(spk, np.uint8)] * n)
+    rnd = [bytes([i] * 32) for i in range(n)]
+    m = [bytes([0x40 | i] * 32) for i in range(n)]
+    tmpl = _init_template(kem)
+    eks, dks, sigs = fused.keygen_sign_batch(sks, [tmpl] * n, pk_off, rnd=rnd)
+    rendered = [
+        tmpl[:pk_off] + bytes(ek).hex().encode()
+        + tmpl[pk_off + 2 * kem.public_key_len:]
+        for ek in eks
+    ]
+    mus_in = fused._mus_from_peer_pks(spks, rendered)
+    # the donated operand must be a jax.Array: numpy operands have no device
+    # buffer to donate, so the twin (like XLA) leaves them untouched
+    sig_arr = jnp.asarray(
+        np.stack([np.frombuffer(bytes(s), np.uint8) for s in sigs]))
+    rtmpl = _resp_template(kem)
+    tmpl_arr = np.stack(
+        [np.frombuffer(rtmpl.ljust(fused.resp_template_len, b"\0"), np.uint8)] * n)
+    lens = np.full((n,), len(rtmpl), np.int32)
+    program = fused_ops.get_encaps_verify_sign(kem.name, sig.name, ct_off)
+    twin = fused_ops.donation_twin(
+        program, fused_ops.DONATED_ARGNUMS["encaps_verify_sign"])
+    ok, ct, key, sigma, done = twin(
+        np.asarray(eks), np.stack([np.frombuffer(x, np.uint8) for x in m]),
+        spks, mus_in, sig_arr, sks,
+        np.stack([np.frombuffer(r, np.uint8) for r in rnd]), tmpl_arr, lens)
+    assert np.asarray(ok).all() and np.asarray(done).all()
+    # the outputs are live and correct...
+    assert sig.verify(
+        spk,
+        rtmpl[:ct_off] + bytes(np.asarray(ct)[0]).hex().encode()
+        + rtmpl[ct_off + 2 * kem.ciphertext_len:],
+        bytes(np.asarray(sigma)[0]))
+    # ...but the donated operand is consumed: ANY later read must raise
+    with pytest.raises(RuntimeError):
+        np.asarray(sig_arr)
+
+
+def test_fused_providers_pass_fresh_operands_through_twin(pair):
+    """The shipping call sites never reuse a donated operand: the whole
+    provider roundtrip still passes when every donating program is replaced
+    by its deleting twin."""
+    kem, sig, fused = pair
+    real_enc, real_dec = fused._enc_vfy_sign, fused._dec_vfy_sign
+    try:
+        fused._enc_vfy_sign = lambda off: fused_ops.donation_twin(
+            real_enc(off), fused_ops.DONATED_ARGNUMS["encaps_verify_sign"])
+        fused._dec_vfy_sign = lambda: fused_ops.donation_twin(
+            real_dec(), fused_ops.DONATED_ARGNUMS["decaps_verify_sign"])
+        _roundtrip(pair, 2)
+    finally:
+        fused._enc_vfy_sign = real_enc
+        fused._dec_vfy_sign = real_dec

@@ -553,7 +553,9 @@ def test_live_tree_proof_ledger():
     mldsa = PACKAGE / "sig" / "mldsa_pallas.py"
     keccak = PACKAGE / "core" / "keccak_pallas.py"
     st = site_status(str(mlkem), mlkem.read_text(encoding="utf-8"))
-    assert sorted(st.values()).count("proved") == 2
+    # byte-assembly shifts + the NTT single-multiply (q^2 < 2^31, so
+    # _mul_zeta needs no Horner limb split — proved from its contracts)
+    assert sorted(st.values()).count("proved") == 3
     st = site_status(str(mldsa), mldsa.read_text(encoding="utf-8"))
     assert sorted(st.values()).count("proved") >= 4  # candidate + 3 limb lines
     st = site_status(str(keccak), keccak.read_text(encoding="utf-8"))
@@ -750,3 +752,66 @@ def test_live_run_is_fast_enough_for_ci():
     dt = time.perf_counter() - t0
     assert dt < 30.0, f"kernel abstract interpretation took {dt:.1f}s"
     assert analysis.interp.summaries  # the summary cache is actually in use
+
+
+def test_accum_dtype_sees_augassign_accumulation():
+    """The revisited-accumulation store shape (frodo_pallas's
+    ``out_ref[...] += contrib``): same-kind integer promotion keeps the
+    accumulated value's dtype across the AugAssign read-modify-write, so a
+    narrower out ref still triggers; a matching int32 out ref is clean."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def lwe_kernel(a_ref, s_ref, out_ref):
+            a = a_ref[...].astype(jnp.int32) & 0xFFFF
+            s = s_ref[...].astype(jnp.int32) & 0xFFFF
+            contrib = a * s  # qrkernel: wrapping — int32 LWE product wraps mod 2^32; q | 2^32 so the masked result is exact
+            out_ref[...] += contrib
+
+        def launch(a, s):
+            return pl.pallas_call(
+                lwe_kernel, grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                          pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((8, 128), "{dt}"),
+            )(a, s)
+        """
+    assert rule_ids(src.format(dt="int16")) == ["kernel-accum-dtype"]
+    assert rule_ids(src.format(dt="int32")) == []
+
+
+def test_read_after_donate_factory_vs_assigned_program():
+    """The fused-program donation shapes: a module-level assigned donating
+    program whose donated operand is read after the call triggers; the
+    factory-return shape (fused/mlkem_mldsa.py — the jitted program never
+    escapes into a module binding) is outside the static rule's reach and
+    stays clean — its contract is enforced at runtime by ``donation_twin``
+    (tests/test_fused.py donation-safety regression)."""
+    assigned = """
+        import jax
+
+        def run(a, b, sig_in):
+            return a + b, sig_in * 2
+
+        prog = jax.jit(run, donate_argnums=(2,))
+
+        def drive(a, b, sig):
+            out, sigma = prog(a, b, sig)
+            return out + sig
+        """
+    assert rule_ids(assigned) == ["kernel-read-after-donate"]
+    # consuming only the outputs: clean
+    assert rule_ids(assigned.replace("return out + sig", "return out + sigma")) == []
+    factory = """
+        import jax
+
+        def get_program():
+            def run(a, b, sig_in):
+                return a + b, sig_in * 2
+            # sig_in's buffer is aliased to the second output
+            return jax.jit(run, donate_argnums=(2,))
+        """
+    assert rule_ids(factory) == []

@@ -42,7 +42,8 @@ import re
 from pathlib import Path
 from typing import Any, Callable
 
-from .absdom import (DEFAULT_CHECK_DTYPE, FLOAT_DTYPES, INT_DTYPES, Dim, IVal,
+from .absdom import (DEFAULT_CHECK_DTYPE, DTYPE_WIDTH, FLOAT_DTYPES,
+                     INT_DTYPES, Dim, IVal,
                      add, bitand, bitor, bitxor, compare, dim_of, floordiv,
                      invert, join_all, lshift, mod, mul, neg, rshift, sub)
 
@@ -1168,7 +1169,17 @@ class Interp:
     @staticmethod
     def _result_dtype(a: IVal, b: IVal) -> str | None:
         if a.dtype and b.dtype:
-            return a.dtype if a.dtype == b.dtype else None
+            if a.dtype == b.dtype:
+                return a.dtype
+            # same-kind integer promotion widens to the bigger operand (jax
+            # semantics) — this is what makes `out_ref[...] += wide` stores
+            # visible to the accum-dtype hook (the read of the narrow out
+            # ref would otherwise erase the accumulated value's dtype)
+            if (a.dtype in INT_DTYPES and b.dtype in INT_DTYPES
+                    and a.dtype[0] == b.dtype[0] and "bool" not in (a.dtype, b.dtype)):
+                wa, wb = DTYPE_WIDTH[a.dtype], DTYPE_WIDTH[b.dtype]
+                return a.dtype if wa >= wb else b.dtype
+            return None
         if a.dtype and b.dtype is None and not b.tile:
             return a.dtype  # array op host scalar keeps the array dtype
         if b.dtype and a.dtype is None and not a.tile:

@@ -69,6 +69,35 @@ echo "resume-mix smoke ok (1-RTT ticket resumes, 0 failures)"
 python bench.py --storm --fleet 2 --roll --sessions 40 >/dev/null
 echo "drain smoke ok (rolling restart survived: 0 lost sessions, >=1 ticket resume)"
 
+# FrodoKEM device-path smoke (docs/dispatch_budget.md "Kernel matrix"):
+# a 2-batch keygen/encaps/decaps roundtrip through the tpu-backend
+# provider must match the pure-Python reference byte-for-byte AND the
+# pinned health KAT must pass — a minimal image whose Frodo kernel path
+# silently regressed to an inconsistent fallback fails here, before any
+# bench ever reports its numbers.
+python - <<'EOF'
+import numpy as np
+
+from quantum_resistant_p2p_tpu.provider import health
+from quantum_resistant_p2p_tpu.provider.kem_providers import FrodoKEMKeyExchange
+from quantum_resistant_p2p_tpu.pyref import frodo_ref
+
+kem = FrodoKEMKeyExchange(security_level=1, backend="tpu", use_aes=False)
+verdict = health._check_frodo_kat(kem)
+assert verdict.ok, verdict.detail
+
+p = frodo_ref.PARAMS[kem.name]
+pks, sks = kem.generate_keypair_batch(2)
+cts, sss = kem.encapsulate_batch(pks)
+got = kem.decapsulate_batch(sks, cts)
+sss, cts, sks = (np.asarray(a) for a in (sss, cts, sks))
+assert np.array_equal(np.asarray(got), sss), "decaps != encaps ss"
+for i in range(2):
+    ref_ss = frodo_ref.decaps(p, bytes(sks[i]), bytes(cts[i]))
+    assert bytes(sss[i]) == ref_ss, f"lane {i}: device ss != pyref"
+print("frodo device KAT smoke ok (2-batch roundtrip, pyref-pinned)")
+EOF
+
 # Telemetry scrape smoke (docs/observability.md "Live endpoints"): an
 # engine with telemetry_port=0 (ephemeral) must serve /healthz and a
 # Prometheus /metrics exposing the cost ledger's padding-waste gauge and
