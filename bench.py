@@ -698,6 +698,114 @@ def fleet_roll_main(out_path: str | None = None,
     return rc
 
 
+ROUTER_ROLL_ROUTERS = 2
+ROUTER_KILL_TICK = 8
+MIN_POST_FAILOVER_RESUME_RATE = 0.9
+
+
+def router_roll_main(out_path: str | None = None,
+                     sessions: int = STORM_SESSIONS,
+                     gateways: int = FLEET_GATEWAYS,
+                     routers: int = ROUTER_ROLL_ROUTERS,
+                     spawn: str = "process") -> int:
+    """Router-roll chaos ratchet (``--storm --fleet N --router-roll``):
+    the control plane is ``routers`` replicated router processes behind a
+    leader lease (fleet/router.py), and the chaos targets THEM — the
+    seeded fault plan SIGKILLs the leader replica mid-storm, then a
+    rolling restart cycles every router while the sessions run.  Writes
+    ``bench_results/router_roll_r0N.json`` and gates on:
+
+    * **zero lost established sessions** and **zero plaintext sends** —
+      router death moves routing + STEK authority, never the data plane;
+    * >= ``MIN_POST_FAILOVER_RESUME_RATE`` of post-failover reconnects
+      resumed VIA TICKET — tickets minted under the dead leader's STEK
+      still redeem after the lease moves (the replicated dual-key
+      window, docs/fleet.md "HA control plane");
+    * the seeded leader kill fired and the rolling restart completed.
+    """
+    import asyncio
+    import sys
+    from pathlib import Path
+
+    from quantum_resistant_p2p_tpu.fleet.storm import (
+        default_router_kill_rules, run_router_storm)
+    from tools.swarm_bench import write_obs_artifacts
+
+    smoke = sessions < 500
+    hb_interval = 0.1 if smoke else 0.25
+    roll_delay = 1.2 if smoke else ROLL_DELAY_S
+    arrival = min(STORM_ARRIVAL_RATE, sessions / 3.0) if smoke \
+        else STORM_ARRIVAL_RATE
+    # rt0 (rank 0) claims first by construction, so the kill rule names
+    # the replica that IS the leader when the storm opens
+    rules = default_router_kill_rules("rt0", ROUTER_KILL_TICK)
+    out = asyncio.run(run_router_storm(
+        sessions, gateways=gateways, routers=routers, seed=STORM_SEED,
+        arrival_rate=arrival, concurrency=STORM_CONCURRENCY,
+        msgs_per_session=8, spawn=spawn, fault_rules=rules,
+        hb_interval=hb_interval, roll=True, roll_delay_s=roll_delay,
+        session_attempts=8, msg_interval_s=0.1 if smoke else 0.05,
+        lease_ttl_s=0.8 if smoke else 1.0,
+    ))
+    out.update({
+        "metric": (f"router_roll_{sessions}x{gateways}gw{routers}rt"
+                   "_lost_established"),
+        "value": out["lost_established_sessions"],
+        "unit": "sessions",
+        "vs_baseline": None,
+    })
+    rc = 0
+    if out["lost_established_sessions"]:
+        print(f"ROUTER ROLL FAIL: {out['lost_established_sessions']} "
+              "established session(s) lost", file=sys.stderr)
+        rc = 1
+    if out["plaintext_sends"]:
+        print(f"ROUTER ROLL FAIL: {out['plaintext_sends']} plaintext "
+              "send(s)", file=sys.stderr)
+        rc = 1
+    if not out.get("chaos", {}).get("injected"):
+        print("ROUTER ROLL FAIL: the seeded leader SIGKILL never fired",
+              file=sys.stderr)
+        rc = 1
+    if not (out.get("roll") or {}).get("ok"):
+        print("ROUTER ROLL FAIL: the router rolling restart did not "
+              "complete (a replica never came back)", file=sys.stderr)
+        rc = 1
+    post = (out.get("post_failover_resumed") or 0) + (
+        out.get("post_failover_full") or 0)
+    rate = out.get("post_failover_resume_rate")
+    if smoke:
+        # smoke gate: at least one reconnect AFTER the failover must have
+        # redeemed a ticket minted before it
+        if not out.get("post_failover_resumed"):
+            print("ROUTER ROLL FAIL: no post-failover ticket resume "
+                  "observed", file=sys.stderr)
+            rc = 1
+    elif not post:
+        print("ROUTER ROLL FAIL: no reconnects landed after the "
+              "failover — the storm proves nothing", file=sys.stderr)
+        rc = 1
+    elif (rate or 0.0) < MIN_POST_FAILOVER_RESUME_RATE:
+        print(f"ROUTER ROLL FAIL: post-failover ticket-resume rate "
+              f"{rate:.1%} < {MIN_POST_FAILOVER_RESUME_RATE:.0%} "
+              f"({out['post_failover_resumed']}/{post})", file=sys.stderr)
+        rc = 1
+    out["ok"] = rc == 0
+    line = json.dumps(out)
+    print(line)
+    if not smoke:
+        write_obs_artifacts(out, "bench_results", stem="router_roll")
+        Path("bench_results").mkdir(exist_ok=True)
+        n = 1
+        while Path(f"bench_results/router_roll_r{n:02d}.json").exists():
+            n += 1
+        Path(f"bench_results/router_roll_r{n:02d}.json").write_text(
+            line + "\n")
+    if out_path:
+        Path(out_path).write_text(line + "\n")
+    return rc
+
+
 def multichip_main(out_path: str | None, shards: str, hs_peers: int,
                    emulate: int) -> int:
     """1→N-chip scaling probe (tools/swarm_bench.run_multichip): batch-4096
@@ -984,6 +1092,15 @@ if __name__ == "__main__":
                          "and respawned mid-storm (+ one SIGKILL), gated "
                          "on 0 lost sessions and a >=90%% post-restart "
                          "ticket-resume rate (docs/robustness.md)")
+    ap.add_argument("--router-roll", action="store_true",
+                    help="with --storm --fleet: run the ROUTER-roll chaos "
+                         "ratchet — N replicated routers behind a leader "
+                         "lease, seeded mid-storm SIGKILL of the leader "
+                         "plus a rolling restart of every router, gated "
+                         "on 0 lost sessions and a >=90%% post-failover "
+                         "ticket-resume rate (docs/fleet.md)")
+    ap.add_argument("--routers", type=int, default=ROUTER_ROLL_ROUTERS,
+                    help="router replica count for --router-roll")
     ap.add_argument("--bulk-mix", action="store_true",
                     help="with --storm: run the BULK-heavy data-plane "
                          "ratchet instead — one seeded bulk-mix trace on "
@@ -1028,6 +1145,10 @@ if __name__ == "__main__":
         raise SystemExit(frodo_raw_ops_main(args.out, args.batch))
     if args.slo:
         raise SystemExit(slo_main(args.out, args.peers, args.warmup))
+    if args.storm and args.fleet and args.router_roll:
+        raise SystemExit(router_roll_main(args.out, args.sessions,
+                                          args.fleet, args.routers,
+                                          args.spawn))
     if args.storm and args.fleet and args.roll:
         raise SystemExit(fleet_roll_main(args.out, args.sessions,
                                          args.fleet, args.spawn))

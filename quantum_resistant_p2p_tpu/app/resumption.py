@@ -216,9 +216,22 @@ class STEKRing:
         self._keys = dict([(new_epoch, stek_key)] + keep)
         return new_epoch
 
-    def install(self, keys: "list[tuple[str, bytes]]") -> None:
+    def install(self, keys: "list[tuple[str, bytes]]", *,
+                guard: bool = False) -> bool:
         """Replace the ring with a distributed key set (newest first) —
-        the gateway side of the fleet's STEK push."""
+        the gateway side of the fleet's STEK push.
+
+        ``guard=True`` refuses a set that would REGRESS the accept
+        window: with a replicated control plane, a rotation push and a
+        renewal-time re-replication ride separate short-lived
+        connections, so a pre-rotation frame can land after the rotation
+        it predates.  Epochs are random (unordered), but a regression is
+        still detectable structurally — the incoming CURRENT key is one
+        we already demoted to the accept-only slot.  Installing it would
+        re-mint under a key the rest of the fleet is about to drop.
+        Returns True when the set was installed, False when the guard
+        skipped it (callers flight-record the skip).
+        """
         cleaned: list[tuple[str, bytes]] = []
         for epoch, stek_key in keys[: self.WINDOW]:
             epoch = str(epoch)
@@ -228,7 +241,13 @@ class STEKRing:
             cleaned.append((epoch, stek_key))
         if not cleaned:
             raise ValueError("empty STEK set")
+        if guard and self._keys:
+            incoming_current = cleaned[0][0]
+            if (incoming_current != self.current_epoch
+                    and incoming_current in self._keys):
+                return False
         self._keys = dict(cleaned)
+        return True
 
     def export(self) -> list[list[str]]:
         """The distributable form (newest first): ``[[epoch, key_hex]]``

@@ -56,8 +56,13 @@ ACTIONS = {
     # SIGSTOP, a dropped control link).  ``drain_gateway`` runs the
     # graceful-drain protocol mid-storm — composed with a kill rule on the
     # next tick it is the drain-interrupt scenario.
+    # ``kill_router``/``pause_router`` target CONTROL-PLANE replicas: the
+    # RouterFleet driver (fleet/router.py) polls router_control(router)
+    # once per router per tick, same deterministic-stream discipline —
+    # killing the LEADER mid-storm is the failover scenario the lease
+    # machinery exists for.
     "process": ("kill_gateway", "pause_gateway", "partition",
-                "drain_gateway"),
+                "drain_gateway", "kill_router", "pause_router"),
     # ticket-scope faults (app/messaging.py ticket-resume validation): each
     # action forces exactly one typed reject verdict on the responder —
     # "corrupt" flips a byte of the presented blob (MAC failure),
@@ -284,6 +289,25 @@ class FaultPlan:
             out.append(entry)
         return out
 
+    def router_control(self, router: str) -> list[dict[str, Any]]:
+        """-> the process-scope actions firing on this ROUTER-tick event.
+
+        The RouterFleet driver (fleet/router.py) polls this once per
+        router per tick in sorted router order; a rule matching
+        ``{"router": router}`` fires here and never on the gateway
+        stream (matches() requires the key to be present), so one plan
+        can choreograph both tiers from one seed.  ``kill_router`` ->
+        SIGKILL the replica, ``pause_router`` -> SIGSTOP for ``delay_s``
+        then SIGCONT.  Every fired entry is recorded to ``injected``.
+        """
+        out: list[dict[str, Any]] = []
+        for _i, rule, entry in self._fire("process", {"router": router}):
+            if rule.action == "pause_router":
+                entry["delay_s"] = rule.delay_s
+            self._record(entry)
+            out.append(entry)
+        return out
+
 
 def _corrupt_payload(payload: dict[str, Any], rng: random.Random,
                      field_name: str | None) -> dict[str, Any]:
@@ -418,6 +442,16 @@ def process_control(gateway: str) -> list:
     if plan is None:
         return []
     return plan.process_control(gateway)
+
+
+def router_control(router: str) -> list:
+    """Process-scope control-plane hook (fleet/router.py chaos tick): the
+    fired kill_router/pause_router entries for this router's tick, []
+    without a plan."""
+    plan = _ACTIVE
+    if plan is None:
+        return []
+    return plan.router_control(router)
 
 
 # -- provider scalar-op instrumentation ---------------------------------------

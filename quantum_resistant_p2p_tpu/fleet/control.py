@@ -43,6 +43,26 @@ collide with application message types):
 * ``__gw_stop__``      router -> gateway: drain and exit; the gateway
                        writes its per-node ``slo_report.json`` first.
 * ``__gw_bye__``       gateway -> router: final stats before exit.
+* ``__rt_lease__``     router -> router: leader-lease claim/renewal
+                       (holder id, monotonic lease epoch, RELATIVE ttl —
+                       each replica arms the deadline on its OWN clock,
+                       so bounded skew shifts the window but never
+                       inverts it).  Epochs only move forward; a frame
+                       below the receiver's epoch is fenced as stale.
+* ``__rt_sync__``      leader router -> follower routers: full authority
+                       state replication on every change — the STEK ring
+                       export (current + previous key, same dual-key
+                       window the gateways hold), membership roster, and
+                       the lease epoch that authorizes the frame.  This
+                       is what lets ANY follower assume the lease without
+                       losing the ticket accept window.  Router links are
+                       the same trusted channel as the gateway control
+                       link (localhost/pod-internal by construction).
+* ``__rt_reject__``    router -> router: stale-lease fence.  Reply to an
+                       authority frame whose epoch is below the
+                       receiver's: carries the receiver's epoch so the
+                       stale sender has PROOF a newer lease exists and
+                       demotes loudly instead of split-braining.
 * ``__route__``        client -> router: "which gateway serves peer X"
                        (``exclude`` lists gateways the client just
                        watched die — the router may already know).
@@ -70,6 +90,9 @@ GW_TICKET_KEYS = "__gw_stek__"
 GW_DRAIN = "__gw_drain__"
 GW_STOP = "__gw_stop__"
 GW_BYE = "__gw_bye__"
+RT_LEASE = "__rt_lease__"
+RT_SYNC = "__rt_sync__"
+RT_REJECT = "__rt_reject__"
 ROUTE = "__route__"
 ROUTE_OK = "__route_ok__"
 ROUTE_DONE = "__route_done__"

@@ -27,6 +27,17 @@ Lifecycle:
 Abrupt death (SIGKILL from the chaos plan, or task cancellation) skips
 4-5 by construction — peers see a dropped TCP session, the router sees
 missed heartbeats, and the fleet handoff machinery takes over.
+
+HA control plane (docs/fleet.md): when the config carries a ``routers``
+list instead of the single ``router_host``/``router_port`` pair, the
+gateway maintains ONE control link PER router replica — hello +
+heartbeats to all of them, with a seeded-jitter reconnect loop per link
+so a rolled router's respawn sees a staggered redial wave, not a
+thundering herd.  Authority frames (``__gw_stek__`` / ``__gw_drain__``)
+carry the sender's lease epoch; the gateway honors the highest epoch it
+has seen and drops anything older (the gateway-side half of stale-lease
+fencing — a demoted router's pushes are rejected and flight-recorded,
+never installed).
 """
 
 from __future__ import annotations
@@ -38,11 +49,12 @@ import os
 import signal
 import sys
 from pathlib import Path
-from typing import Any
+from typing import Any, Awaitable, Callable
 
+from ..obs import flight as obs_flight
 from . import control
 from .stormlib import (StormAEAD, prewarm_facades, register_storm_providers,
-                       storm_env)
+                       seeded_jitter_rng, storm_env)
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +64,11 @@ DEFAULTS: dict[str, Any] = {
     "router_host": "127.0.0.1",
     "bind_host": "127.0.0.1",
     "router_port": 0,
+    #: HA mode: a list of ``{"router", "host", "port"}`` replica
+    #: endpoints.  None/empty = the classic single-router link above.
+    "routers": None,
+    #: seeds the per-link reconnect jitter (the storm passes its seed)
+    "seed": 0,
     "providers": "stdlib",
     "max_peers": 0,
     "handshake_budget": 0,
@@ -105,6 +122,72 @@ def _engine_stats(engine, received: int) -> dict[str, Any]:
     gw["ops"] = total
     gw["fallback_ops"] = fb
     return gw
+
+
+async def _dispatch(msg: dict, send: Callable[[dict], Awaitable[None]],
+                    engine, gid: str, state: dict[str, Any]) -> str:
+    """Handle one router control frame (shared by the single-router loop
+    and every HA link).  Returns ``"ok"`` / ``"drain"`` / ``"stop"``;
+    transport errors from the probe reply propagate to the caller (its
+    link is dead).
+
+    ``state["lease_epoch"]`` is the highest lease epoch this gateway has
+    honored: authority frames (STEK pushes, drains) below it come from a
+    router that provably LOST the lease — dropped and flight-recorded,
+    the gateway-side half of stale-lease fencing.  Frames without an
+    epoch (a standalone router) carry 0 and the gate stays inert."""
+    mtype = msg.get("type")
+    if mtype == control.GW_PROBE:
+        await send({
+            "type": control.GW_PROBE_OK, "gateway": gid,
+            "n": msg.get("n"),
+        })
+    elif mtype == control.GW_TICKET_KEYS:
+        epoch = int(msg.get("lease_epoch") or 0)
+        if epoch < state["lease_epoch"]:
+            state["stale_authority_rejects"] += 1
+            obs_flight.record("stale_authority_rejected", gateway=gid,
+                              frame="stek", lease_epoch=epoch,
+                              honored=state["lease_epoch"])
+            logger.warning("gateway %s: STEK push at stale lease epoch %d "
+                           "(honoring %d) rejected", gid, epoch,
+                           state["lease_epoch"])
+            return "ok"
+        state["lease_epoch"] = epoch
+        # the fleet's ticket-sealing keys (current + previous): replace
+        # the engine's private ring so tickets minted ANYWHERE in the
+        # fleet resume here
+        try:
+            installed = engine.tickets.install([
+                (str(ep), bytes.fromhex(str(key_hex)))
+                for ep, key_hex in (msg.get("keys") or [])
+            ], guard=True)
+        except (ValueError, TypeError):
+            logger.warning("gateway %s: malformed STEK push ignored", gid)
+        else:
+            if not installed:
+                # same-lease-epoch ordering race (STEKRing.install guard):
+                # a pre-rotation push arriving after the rotation must not
+                # re-mint under the key the fleet is dropping
+                state["stale_authority_rejects"] += 1
+                obs_flight.record("stale_stek_push_skipped", gateway=gid)
+    elif mtype == control.GW_DRAIN:
+        epoch = int(msg.get("lease_epoch") or 0)
+        if epoch < state["lease_epoch"]:
+            state["stale_authority_rejects"] += 1
+            obs_flight.record("stale_authority_rejected", gateway=gid,
+                              frame="drain", lease_epoch=epoch,
+                              honored=state["lease_epoch"])
+            logger.warning("gateway %s: drain at stale lease epoch %d "
+                           "(honoring %d) rejected", gid, epoch,
+                           state["lease_epoch"])
+            return "ok"
+        state["lease_epoch"] = epoch or state["lease_epoch"]
+        state["drain_reason"] = "router"
+        return "drain"
+    elif mtype == control.GW_STOP:
+        return "stop"
+    return "ok"
 
 
 async def run_gateway(cfg: dict[str, Any]) -> None:
@@ -166,50 +249,163 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
                 (engine._bkem, engine._bsig, engine._bfused),
                 min(int(cfg["max_batch"]), cap))
 
-        reader, writer = await asyncio.open_connection(
-            str(cfg["router_host"]), int(cfg["router_port"]))
-        await control.send_ctrl(writer, {
-            "type": control.GW_HELLO, "gateway": gid,
-            "p2p_port": node.port, "pid": os.getpid(),
-            "max_peers": int(cfg["max_peers"]),
-            # announce the scrape surface: the router's /fleet view and
-            # tools/qrtop.py find each gateway's endpoints through this
-            "telemetry_port": engine.telemetry_port,
-        })
-
+        # -- control links -------------------------------------------------
+        # multi=False is the classic single-router lifecycle (one link,
+        # loss = exit); multi=True is the HA control plane: one link per
+        # router replica, each with its own reconnect loop
+        router_list = cfg.get("routers")
+        multi = bool(router_list)
+        if not multi:
+            router_list = [{"router": "router",
+                            "host": cfg["router_host"],
+                            "port": cfg["router_port"]}]
         stop_ev = asyncio.Event()
-        # one writer, two senders (heartbeat task + the read loop's probe
-        # replies): serialize sends — two coroutines suspended in the same
-        # drain() while the router back-pressures the transport trip
-        # asyncio's single-waiter assert and kill the heartbeat task
-        send_lock = asyncio.Lock()
+        # graceful drain triggers: a router's __gw_drain__ verb OR a
+        # SIGTERM (a rolling restart / orchestrator shutdown delivers
+        # SIGTERM — a PLANNED restart must not look like a crash)
+        drain_ev = asyncio.Event()
+        #: cross-link shared state: the highest lease epoch honored (the
+        #: gateway-side fencing gate) + the drain reason for the report
+        state: dict[str, Any] = {"lease_epoch": 0,
+                                 "stale_authority_rejects": 0,
+                                 "drain_reason": None}
+        #: live per-router send closures (a link registers on hello,
+        #: deregisters on loss) — the bye fan-out at exit walks these
+        senders: dict[str, Callable[[dict], Awaitable[None]]] = {}
+        writers: dict[str, asyncio.StreamWriter] = {}
 
-        async def send(frame: dict) -> None:
-            async with send_lock:
-                await control.send_ctrl(writer, frame)
+        def hello_frame() -> dict:
+            return {
+                "type": control.GW_HELLO, "gateway": gid,
+                "p2p_port": node.port, "pid": os.getpid(),
+                "max_peers": int(cfg["max_peers"]),
+                # announce the scrape surface: the router's /fleet view
+                # and tools/qrtop.py find each gateway's endpoints here
+                "telemetry_port": engine.telemetry_port,
+            }
 
-        async def heartbeat() -> None:
+        def hb_frame() -> dict:
+            stats = _engine_stats(engine, received)
+            # the lease surface rides the heartbeat: which authority
+            # epoch this gateway honors, over how many router links
+            stats["lease_epoch"] = state["lease_epoch"]
+            stats["router_links"] = len(senders)
+            stats["stale_authority_rejects"] = state["stale_authority_rejects"]
+            return {
+                "type": control.GW_HEARTBEAT, "gateway": gid,
+                "stats": stats,
+                "slo_totals": {
+                    k: list(v)
+                    for k, v in engine.slo.probe_totals().items()
+                },
+            }
+
+        async def heartbeat(send: Callable[[dict], Awaitable[None]]) -> None:
             while not stop_ev.is_set():
                 await asyncio.sleep(float(cfg["hb_interval"]))
                 try:
-                    await send({
-                        "type": control.GW_HEARTBEAT, "gateway": gid,
-                        "stats": _engine_stats(engine, received),
-                        "slo_totals": {
-                            k: list(v)
-                            for k, v in engine.slo.probe_totals().items()
-                        },
-                    })
+                    await send(hb_frame())
                 except (ConnectionError, OSError):
-                    stop_ev.set()
+                    if not multi:
+                        stop_ev.set()
                     return
 
-        hb_task = asyncio.create_task(heartbeat())
-        # graceful drain triggers: the router's __gw_drain__ verb OR a
-        # SIGTERM (a rolling restart / orchestrator shutdown delivers
-        # SIGTERM — a PLANNED restart must not look like a crash).  The
-        # event is select()ed against the control read below.
-        drain_ev = asyncio.Event()
+        async def link(rt: dict[str, Any]) -> None:
+            """One router replica's control-link lifecycle: dial, hello,
+            heartbeat, dispatch — redialing with seeded-jitter backoff in
+            HA mode so a rolled router's respawn sees a staggered wave."""
+            rid = str(rt.get("router") or "router")
+            # deterministic per-(gateway, router) jitter stream
+            rng = seeded_jitter_rng(int(cfg["seed"]), gid, rid)
+            backoff = 0.05
+            while not (stop_ev.is_set() or drain_ev.is_set()):
+                try:
+                    reader, writer = await asyncio.open_connection(
+                        str(rt["host"]), int(rt["port"]))
+                except OSError:
+                    if not multi:
+                        return  # classic mode: no router, no gateway
+                    await asyncio.sleep(backoff * (0.5 + rng.random()))
+                    backoff = min(backoff * 2.0, 2.0)
+                    continue
+                backoff = 0.05
+                # one writer, two senders (heartbeat task + the dispatch
+                # loop's probe replies): serialize sends — two coroutines
+                # suspended in the same drain() while the router
+                # back-pressures the transport trip asyncio's
+                # single-waiter assert and kill the heartbeat task
+                send_lock = asyncio.Lock()
+
+                async def send(frame: dict, _w=writer,
+                               _lock=send_lock) -> None:
+                    async with _lock:
+                        await control.send_ctrl(_w, frame)
+
+                hb_task: asyncio.Task | None = None
+                lost = False
+                try:
+                    await send(hello_frame())
+                    senders[rid] = send
+                    writers[rid] = writer
+                    hb_task = asyncio.create_task(heartbeat(send))
+                    while True:
+                        read_t = asyncio.ensure_future(
+                            control.read_ctrl(reader))
+                        drain_t = asyncio.ensure_future(drain_ev.wait())
+                        stop_t = asyncio.ensure_future(stop_ev.wait())
+                        try:
+                            await asyncio.wait(
+                                {read_t, drain_t, stop_t},
+                                return_when=asyncio.FIRST_COMPLETED)
+                        except asyncio.CancelledError:
+                            # the whole link task is being torn down while
+                            # we were blocked in the select: the read task
+                            # would otherwise outlive us and log its EOF
+                            # as an unretrieved exception
+                            read_t.cancel()
+                            read_t.add_done_callback(
+                                lambda t: None if t.cancelled()
+                                else t.exception())
+                            raise
+                        finally:
+                            drain_t.cancel()
+                            stop_t.cancel()
+                        if not read_t.done():
+                            # drain/stop fired: leave the link OPEN — the
+                            # epilogue still owes this router a bye frame.
+                            # The cancel is a no-op when an EOF raced in
+                            # just now, so consume the task's outcome
+                            # either way or it surfaces much later as an
+                            # unretrieved-exception warning
+                            read_t.cancel()
+                            read_t.add_done_callback(
+                                lambda t: None if t.cancelled()
+                                else t.exception())
+                            return
+                        msg = read_t.result()
+                        verdict = await _dispatch(msg, send, engine, gid,
+                                                  state)
+                        if verdict == "drain":
+                            drain_ev.set()
+                            return
+                        if verdict == "stop":
+                            stop_ev.set()
+                            return
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        OSError):
+                    lost = True
+                finally:
+                    if hb_task is not None:
+                        hb_task.cancel()
+                    if lost:
+                        senders.pop(rid, None)
+                        writers.pop(rid, None)
+                        writer.close()
+                if not multi:
+                    return  # classic mode: link loss = exit, no redial
+                await asyncio.sleep(backoff * (0.5 + rng.random()))
+
+        link_tasks = [asyncio.create_task(link(rt)) for rt in router_list]
         loop = asyncio.get_running_loop()
         sigterm_armed = False
         if cfg.get("own_process"):
@@ -221,56 +417,26 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
             except (NotImplementedError, ValueError, RuntimeError):
                 pass  # non-main thread / platform without signal support
         try:
-            drained = False
-            while not stop_ev.is_set():
-                read_t = asyncio.ensure_future(control.read_ctrl(reader))
-                drain_t = asyncio.ensure_future(drain_ev.wait())
-                try:
-                    await asyncio.wait({read_t, drain_t},
-                                       return_when=asyncio.FIRST_COMPLETED)
-                finally:
-                    drain_t.cancel()
-                if not read_t.done():
-                    read_t.cancel()
-                    drained = True
-                    break
-                try:
-                    msg = read_t.result()
-                except (asyncio.IncompleteReadError, ConnectionError, OSError):
-                    break  # router gone: drain and exit
-                mtype = msg.get("type")
-                if mtype == control.GW_PROBE:
-                    try:
-                        await send({
-                            "type": control.GW_PROBE_OK, "gateway": gid,
-                            "n": msg.get("n"),
-                        })
-                    except (ConnectionError, OSError):
-                        break  # router gone mid-probe: drain and exit
-                elif mtype == control.GW_TICKET_KEYS:
-                    # the fleet's ticket-sealing keys (current + previous):
-                    # replace the engine's private ring so tickets minted
-                    # ANYWHERE in the fleet resume here
-                    try:
-                        engine.tickets.install([
-                            (str(epoch), bytes.fromhex(str(key_hex)))
-                            for epoch, key_hex in (msg.get("keys") or [])
-                        ])
-                    except (ValueError, TypeError):
-                        logger.warning("gateway %s: malformed STEK push "
-                                       "ignored", gid)
-                elif mtype == control.GW_DRAIN:
-                    drained = True
-                    break
-                elif mtype == control.GW_STOP:
-                    break
-            if drained or drain_ev.is_set():
+            drain_t = asyncio.ensure_future(drain_ev.wait())
+            stop_t = asyncio.ensure_future(stop_ev.wait())
+            waits: set[asyncio.Future] = {drain_t, stop_t}
+            if not multi:
+                # classic mode additionally exits when its ONLY link ends
+                # (router gone); HA links redial forever instead
+                waits |= set(link_tasks)
+            try:
+                await asyncio.wait(waits,
+                                   return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                drain_t.cancel()
+                stop_t.cancel()
+            if drain_ev.is_set() and not stop_ev.is_set():
                 # the graceful-drain protocol (app/messaging.py): stop
                 # admitting (/readyz -> 503 draining), flush outboxes,
                 # nudge every peer to resume — via ticket — on its ring
                 # successor; then fall through to the report/bye path
                 await engine.drain(
-                    reason="sigterm" if drain_ev.is_set() else "router")
+                    reason=state.get("drain_reason") or "sigterm")
             # per-node SLO report first (the fleet merge input), then the
             # final stats frame
             stop_ev.set()
@@ -285,26 +451,29 @@ async def run_gateway(cfg: dict[str, Any]) -> None:
                 except OSError:
                     logger.exception("gateway %s: slo report write failed",
                                      gid)
-            try:
-                await send({
-                    "type": control.GW_BYE, "gateway": gid,
-                    "stats": _engine_stats(engine, received),
-                })
-            except (ConnectionError, OSError):
-                pass
+            for _rid, send in sorted(senders.items()):
+                try:
+                    await send({
+                        "type": control.GW_BYE, "gateway": gid,
+                        "stats": _engine_stats(engine, received),
+                    })
+                except (ConnectionError, OSError):
+                    pass
         finally:
             # runs on the graceful path AND on task cancellation (the
             # in-process abrupt-death mode): close every transport so
             # peers see the drop immediately
             stop_ev.set()
-            hb_task.cancel()
+            for t in link_tasks:
+                t.cancel()
             if sigterm_armed:
                 try:
                     loop.remove_signal_handler(signal.SIGTERM)
                 except (NotImplementedError, ValueError, RuntimeError):
                     pass
             engine.stop_telemetry()
-            writer.close()
+            for w in writers.values():
+                w.close()
             await node.stop()
 
 

@@ -38,6 +38,22 @@ fleet sums them per spec and evaluates ONE :class:`obs.slo.SLOEngine`
 over the sums — the per-node ``slo_report.json`` files the gateways write
 on shutdown are the offline twin (``tools/slo_merge.py``).
 
+HA control plane (docs/fleet.md "HA control plane"): the router itself
+is no longer a load-bearing singleton.  A fleet constructed with
+``router_peers`` runs as ONE REPLICA of a replicated control plane — a
+:class:`fleet.lease.LeaderLease` (monotonic epochs, relative TTLs,
+rank-staggered claims on the injectable clock) decides which replica
+holds STEK-rotation and admission authority; the leader replicates the
+full authority state (STEK ring export + membership roster) to followers
+on every change over the same length-framed control link
+(``__rt_lease__`` / ``__rt_sync__``), so ANY follower can assume the
+lease without losing the ticket accept window.  Authority frames carry
+the lease epoch; a follower fences stale epochs with ``__rt_reject__``
+and the stale sender demotes loudly instead of split-braining.  Replicas
+run in ``attach`` mode: gateways are spawned by the driver, dial every
+router, and register via hello — members materialize on registration
+instead of at spawn.
+
 Everything here runs on the event loop (the breakers' own locks cover
 their cross-thread surface); the clock is injectable so handoff/heal
 tests drive deterministic timelines.
@@ -63,6 +79,7 @@ from ..obs.metrics import Registry
 from ..provider.batched import Breaker
 from ..provider.scheduler import select_slot
 from . import control
+from .lease import LeaderLease
 from .ring import HashRing
 
 logger = logging.getLogger(__name__)
@@ -122,6 +139,15 @@ class GatewayMember:
         self.proc: Any = None  # asyncio subprocess (spawn="process")
         self.task: asyncio.Task | None = None  # spawn="task"
         self.writer: asyncio.StreamWriter | None = None
+        #: control-connection generation: bumped on every accepted hello.
+        #: A member may be re-dialed (reconnect after a transient drop, a
+        #: gateway heartbeating a respawned router) while the OLD read
+        #: loop is still draining — without the generation gate the stale
+        #: loop's heartbeats would double-shift the inflight reconcile
+        #: windows and its EOF would tear down the LIVE registration
+        self.conn_gen = 0
+        #: frames dropped from superseded connections (bug evidence)
+        self.superseded_frames = 0
         self.last_hb: float | None = None
         self.hb_count = 0
         #: latest heartbeat stats / cumulative SLO probe totals
@@ -223,6 +249,13 @@ class GatewayFleet:
         register_timeout: float = 60.0,
         telemetry_port: int | None = None,
         ticket_key_rotation_s: float = 0.0,
+        attach: bool = False,
+        ctrl_port: int | None = None,
+        router_id: str = "rt0",
+        router_rank: int = 0,
+        router_peers: list[dict[str, Any]] | None = None,
+        lease_ttl_s: float | None = None,
+        lease_stagger_s: float | None = None,
     ):
         if spawn not in ("process", "task"):
             raise ValueError(f"spawn must be 'process' or 'task', got {spawn!r}")
@@ -237,12 +270,38 @@ class GatewayFleet:
         self.report_dir = Path(report_dir) if report_dir is not None else None
         self.host = host
         self._clock = clock
+        #: attach mode (HA replicas): this router spawns NOTHING — the
+        #: driver owns the gateway processes, which dial every router and
+        #: materialize as members on their hello
+        self.attach = attach
+        self._requested_ctrl_port = ctrl_port
+        self._cooloffs = (cooloff_s, cooloff_max_s)
+        # -- replicated control plane (None = the classic standalone) ------
+        self.router_id = router_id
+        self.router_peers = list(router_peers or [])
+        self.lease: LeaderLease | None = None
+        if router_peers is not None:
+            lease_kw: dict[str, Any] = {"clock": clock}
+            if lease_ttl_s is not None:
+                lease_kw["ttl_s"] = lease_ttl_s
+            if lease_stagger_s is not None:
+                lease_kw["claim_stagger_s"] = lease_stagger_s
+            self.lease = LeaderLease(router_id, router_rank, **lease_kw)
+        #: ``__rt_reject__`` fences this replica RECEIVED (each one is
+        #: proof a peer holds a fresher lease than a frame we sent)
+        self.lease_rejects = 0
+        #: stale peer authority frames this replica fenced
+        self.lease_fenced = 0
+        #: RT_SYNC state replications applied from the leader
+        self.syncs_applied = 0
         #: fleet birth on the injected clock: the availability SLO measures
         #: gateway-seconds SINCE START — the raw monotonic value is time
         #: since boot, which would dilute any outage into un-alertable noise
         self._t0 = clock()
         self._register_timeout = register_timeout
-        ids = [f"gw{i}" for i in range(gateways)]
+        # attach mode: members materialize on hello (the driver spawns the
+        # gateway processes; ``gateways`` is only the expected head count)
+        ids = [] if attach else [f"gw{i}" for i in range(gateways)]
         self.members: dict[str, GatewayMember] = {
             gid: GatewayMember(gid, i, cooloff_s, cooloff_max_s, clock)
             for i, gid in enumerate(ids)
@@ -307,8 +366,12 @@ class GatewayFleet:
 
     async def start(self) -> None:
         """Start the control/route server, spawn every gateway, and wait
-        until all of them registered (hello received)."""
-        self._server = await asyncio.start_server(self._on_ctrl, self.host, 0)
+        until all of them registered (hello received).  Attach mode binds
+        the REQUESTED control port (a respawned replica must come back
+        where the gateways' reconnect loops are dialing), spawns nothing,
+        and waits for nobody — registration arrives when it arrives."""
+        self._server = await asyncio.start_server(
+            self._on_ctrl, self.host, self._requested_ctrl_port or 0)
         self.ctrl_port = self._server.sockets[0].getsockname()[1]
         self._running = True
         if self._telemetry_port is not None:
@@ -328,6 +391,8 @@ class GatewayFleet:
                     "/slo": json_route(self.slo_status),
                     "/healthz": json_route(lambda: {
                         "ok": True, "role": "fleet-router",
+                        "router": self.router_id,
+                        "lease": self.lease_view(),
                         "gateways": len(self.members),
                     }),
                 }, host=self.host, port=self._telemetry_port).start()
@@ -343,20 +408,21 @@ class GatewayFleet:
             # leaving its stale twin behind to impersonate it)
             for stale in self.report_dir.glob("*_slo_report.json"):
                 stale.unlink()
-        for member in self._members_sorted():
-            await self._spawn_member(member)
-        try:
-            await asyncio.wait_for(self._registered_ev.wait(),
-                                   self._register_timeout)
-        except asyncio.TimeoutError:
-            missing = [m.gateway_id for m in self.members.values()
-                       if not m.registered]
-            await self.stop()
-            raise RuntimeError(
-                f"fleet start: gateways never registered: {missing}")
+        if not self.attach:
+            for member in self._members_sorted():
+                await self._spawn_member(member)
+            try:
+                await asyncio.wait_for(self._registered_ev.wait(),
+                                       self._register_timeout)
+            except asyncio.TimeoutError:
+                missing = [m.gateway_id for m in self.members.values()
+                           if not m.registered]
+                await self.stop()
+                raise RuntimeError(
+                    f"fleet start: gateways never registered: {missing}")
         self._health_task = asyncio.create_task(self._health_loop())
-        logger.info("fleet up: %d gateways on router port %s",
-                    len(self.members), self.ctrl_port)
+        logger.info("fleet up: %d gateways on router port %s (router %s)",
+                    len(self.members), self.ctrl_port, self.router_id)
 
     def _members_sorted(self) -> list[GatewayMember]:
         return [self.members[g] for g in sorted(self.members)]
@@ -412,13 +478,32 @@ class GatewayFleet:
 
     async def stop(self) -> None:
         """Graceful drain: ask every live gateway to write its per-node
-        SLO report and exit; SIGKILL/cancel whatever does not comply."""
+        SLO report and exit; SIGKILL/cancel whatever does not comply.
+
+        An ATTACH-mode replica owns no gateway processes and must not
+        reach for them: a router being rolled mid-storm that sent
+        ``__gw_stop__`` on its way out would take the entire (healthy,
+        serving) data plane down with it — it just closes its own
+        listener and lets the gateways' reconnect loops find the respawn.
+        """
         self._running = False
         if self.telemetry is not None:
             srv, self.telemetry = self.telemetry, None
             srv.stop()
         if self._health_task is not None:
             self._health_task.cancel()
+        if self.attach:
+            for member in self._members_sorted():
+                if member.writer is not None:
+                    member.writer.close()
+                    member.writer = None
+            for t in list(self._bg):
+                t.cancel()
+            if self._server is not None:
+                self._server.close()
+                await self._server.wait_closed()
+                self._server = None
+            return
         for member in self._members_sorted():
             member.stopped = True
             if member.proc is not None and member.pid is not None:
@@ -533,6 +618,12 @@ class GatewayFleet:
         elif mtype == control.ROUTE_DONE:
             self.session_done(str(msg.get("gateway", "")))
             writer.close()
+        elif mtype == control.RT_LEASE:
+            await self._on_rt_lease(msg, writer)
+            writer.close()
+        elif mtype == control.RT_SYNC:
+            await self._on_rt_sync(msg, writer)
+            writer.close()
         else:
             writer.close()
 
@@ -541,9 +632,32 @@ class GatewayFleet:
         gid = str(hello.get("gateway", ""))
         member = self.members.get(gid)
         if member is None:
-            logger.warning("hello from unknown gateway %r", gid)
-            writer.close()
-            return
+            if not self.attach:
+                logger.warning("hello from unknown gateway %r", gid)
+                writer.close()
+                return
+            # attach mode: gateways are spawned by the driver and register
+            # themselves — membership (and the ring arc) materializes here
+            member = GatewayMember(gid, len(self.members), *self._cooloffs,
+                                   clock=self._clock)
+            self.members[gid] = member
+            self.ring.add(gid)
+            if self.lease is not None and self.lease.is_leader:
+                self._spawn(self._replicate_state(), f"member sync:{gid}")
+        if member.writer is not None and member.writer is not writer:
+            # a SECOND control connection for a registered member (a
+            # reconnect landing before the old loop saw its EOF): the new
+            # hello supersedes.  Without this, both read loops would feed
+            # _on_heartbeat — every heartbeat double-shifts the inflight
+            # reconcile windows, halving the reconcile slack — and the
+            # old loop's eventual EOF would null the LIVE writer, leaving
+            # a serving gateway unreachable for probes and STEK pushes
+            # until ITS next reconnect
+            old = member.writer
+            member.writer = None
+            old.close()
+        member.conn_gen += 1
+        gen = member.conn_gen
         member.host = self.host
         member.port = int(hello.get("p2p_port", 0))
         member.pid = int(hello.get("pid") or 0) or member.pid
@@ -575,6 +689,7 @@ class GatewayFleet:
             await control.send_ctrl(writer, {
                 "type": control.GW_TICKET_KEYS,
                 "keys": self.ticket_keys.export(),
+                "lease_epoch": self._lease_epoch(),
             })
         except (ConnectionError, OSError):
             # the gateway died between hello and the push: undo the
@@ -583,7 +698,8 @@ class GatewayFleet:
             # restart_member's registered check, and would stall
             # start()'s all-registered event
             member.port = None
-            member.writer = None
+            if member.writer is writer:
+                member.writer = None
             member.last_hb = None
             writer.close()
             return
@@ -593,6 +709,13 @@ class GatewayFleet:
         try:
             while True:
                 msg = await control.read_ctrl(reader)
+                if member.conn_gen != gen:
+                    # this loop's connection was superseded by a fresh
+                    # hello: its frames are the DEAD incarnation's — a
+                    # heartbeat here must not touch liveness or shift the
+                    # reconcile windows the live connection now owns
+                    member.superseded_frames += 1
+                    break
                 mtype = msg.get("type")
                 sender = str(msg.get("gateway", gid) or gid)
                 if sender != gid:
@@ -656,6 +779,234 @@ class GatewayFleet:
         if fut is not None and not fut.done() and msg.get("n") == member._probe_n:
             fut.set_result(True)
 
+    # -- replicated control plane (leader lease) ------------------------------
+
+    @property
+    def has_authority(self) -> bool:
+        """May this replica rotate STEKs / own admission policy NOW?
+        Standalone fleets (no lease) always do — the classic single-router
+        behavior is the degenerate one-replica case."""
+        return self.lease is None or self.lease.is_leader
+
+    def lease_view(self) -> dict[str, Any]:
+        if self.lease is None:
+            # a standalone router IS the (only possible) authority holder
+            return {"role": "leader", "epoch": 0, "holder": self.router_id,
+                    "standalone": True}
+        return self.lease.view()
+
+    def _lease_epoch(self) -> int:
+        return 0 if self.lease is None else self.lease.epoch
+
+    def _observe_lease(self, holder: str, epoch: int,
+                       ttl_s: float | None) -> bool:
+        """Fold a peer claim/renew in; demotions surface LOUDLY (flight
+        record + event), never as a silent role flip.  False = stale."""
+        assert self.lease is not None
+        was = self.lease.role
+        ok = self.lease.observe(holder, int(epoch), ttl_s)
+        if self.lease.role != was and self.lease.role == "demoted":
+            logger.error("router %s DEMOTED: lease epoch %s is held by %s",
+                         self.router_id, epoch, holder)
+            obs_flight.trigger("router_demoted", router=self.router_id,
+                               epoch=int(epoch), holder=holder)
+            self._fire("lease_demoted", self.router_id)
+        return ok
+
+    async def _on_rt_lease(self, msg: dict, writer) -> None:
+        """A peer's lease claim/renewal.  Stale epochs are fenced with a
+        typed ``__rt_reject__`` reply carrying OUR epoch — the proof the
+        stale sender needs to demote instead of split-braining."""
+        if self.lease is None:
+            return
+        holder = str(msg.get("holder", ""))
+        ttl_s = msg.get("ttl_s")
+        if not self._observe_lease(holder, int(msg.get("epoch") or 0),
+                                   float(ttl_s) if ttl_s is not None else None):
+            self.lease_fenced += 1
+            obs_flight.record("stale_lease_fenced", router=self.router_id,
+                              sender=holder, at_epoch=self.lease.epoch)
+            try:
+                await control.send_ctrl(writer, {
+                    "type": control.RT_REJECT,
+                    "router": self.router_id,
+                    "epoch": self.lease.epoch,
+                })
+            except (ConnectionError, OSError):
+                pass
+
+    async def _on_rt_sync(self, msg: dict, writer) -> None:
+        """Leader → follower authority-state replication: the STEK ring
+        export (current + previous — the full accept window), the
+        rotation count, and the membership roster, fenced on the lease
+        epoch exactly like the lease frames themselves."""
+        if self.lease is None:
+            return
+        holder = str(msg.get("holder", ""))
+        epoch = int(msg.get("epoch") or 0)
+        if not self._observe_lease(holder, epoch, None):
+            self.lease_fenced += 1
+            obs_flight.record("stale_sync_fenced", router=self.router_id,
+                              sender=holder, at_epoch=self.lease.epoch)
+            try:
+                await control.send_ctrl(writer, {
+                    "type": control.RT_REJECT,
+                    "router": self.router_id,
+                    "epoch": self.lease.epoch,
+                })
+            except (ConnectionError, OSError):
+                pass
+            return
+        keys = msg.get("keys")
+        if keys:
+            try:
+                installed = self.ticket_keys.install(
+                    [(str(ep), bytes.fromhex(str(key_hex)))
+                     for ep, key_hex in keys], guard=True)
+            except (ValueError, TypeError):
+                logger.warning("router %s: malformed STEK sync from %s "
+                               "ignored", self.router_id, holder)
+                return
+            if not installed:
+                # structural regression guard (STEKRing.install): a
+                # pre-rotation replicate frame landed after the rotation
+                # it predates — same lease epoch, separate connections
+                obs_flight.record("stale_stek_sync_skipped",
+                                  router=self.router_id, sender=holder)
+                return
+        self.key_rotations = max(self.key_rotations,
+                                 int(msg.get("rotations") or 0))
+        for gid in (msg.get("members") or ()):
+            gid = str(gid)
+            if gid not in self.members:
+                # roster adoption: a replica that (re)started after a
+                # gateway registered elsewhere still places it on the ring;
+                # liveness stays the gateway's own hello/heartbeat business
+                self.members[gid] = GatewayMember(
+                    gid, len(self.members), *self._cooloffs,
+                    clock=self._clock)
+                self.ring.add(gid)
+        self.syncs_applied += 1
+
+    def _lease_tick(self) -> None:
+        """The lease half of the health tick: claim when the lease (plus
+        our rank stagger) expired, renew at ttl/3 cadence while leading.
+        Claims and renewals broadcast to every peer; a claim also
+        replicates the full authority state and re-pushes the STEK ring
+        to our connected gateways, so the accept window survives the
+        failover (tickets minted under the dead leader still redeem)."""
+        assert self.lease is not None
+        if self.lease.claim_due():
+            body = self.lease.claim()
+            logger.warning("router %s claimed the lease (epoch %s)",
+                           self.router_id, body["epoch"])
+            obs_flight.record("lease_claimed", router=self.router_id,
+                              epoch=body["epoch"])
+            self._fire("lease_claimed", self.router_id)
+            self._spawn(self._announce_lease(body, sync=True),
+                        f"lease claim:{self.router_id}")
+        elif self.lease.renew_due():
+            body = self.lease.renew()
+            self._spawn(self._announce_lease(body, sync=False),
+                        f"lease renew:{self.router_id}")
+
+    async def _announce_lease(self, body: dict[str, Any],
+                              sync: bool) -> None:
+        frame = {"type": control.RT_LEASE, "holder": body["holder"],
+                 "epoch": body["epoch"], "ttl_s": body["ttl_s"]}
+        for peer in self.router_peers:
+            await self._peer_send(peer, frame)
+        if self.lease is not None and self.lease.is_leader:
+            # EVERY renewal re-replicates the authority state, not just
+            # the claim: a follower that restarted since the last change
+            # (a mid-roll respawn) converges within one renew interval
+            # instead of holding a private random STEK ring until the
+            # next rotation — which is exactly the window a failover
+            # would lose the accept window in
+            await self._replicate_state()
+            if sync:
+                await self._push_stek_to_gateways()
+
+    def _sync_frame(self) -> dict[str, Any]:
+        return {"type": control.RT_SYNC, "holder": self.router_id,
+                "epoch": self._lease_epoch(),
+                "keys": self.ticket_keys.export(),
+                "rotations": self.key_rotations,
+                "members": sorted(self.members)}
+
+    async def _replicate_state(self) -> None:
+        """Leader → every follower: full authority state, on every change
+        (claim, STEK rotation, membership growth)."""
+        frame = self._sync_frame()
+        for peer in self.router_peers:
+            await self._peer_send(peer, frame)
+
+    async def _peer_send(self, peer: dict[str, Any],
+                         frame: dict[str, Any]) -> None:
+        """One frame to one peer replica, short-lived connection (the
+        route_query discipline).  The receiver replies ONLY to fence a
+        stale frame; an accepted frame is acked by the close.  A reject
+        reply is proof a fresher lease exists: count it, demote loudly."""
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(str(peer.get("host") or self.host),
+                                        int(peer["port"])), 2.0)
+        except (OSError, asyncio.TimeoutError, ValueError, KeyError):
+            return  # a dead peer misses this round; reconvergence is cheap
+        try:
+            await control.send_ctrl(writer, frame)
+            reply = asyncio.ensure_future(control.read_ctrl(reader))
+            # consume the reply task's outcome even when WE get cancelled
+            # mid-wait (fleet stop, chaos kill): an EOF landing in the
+            # same tick as the cancellation would otherwise surface as an
+            # unretrieved-exception warning after the fact
+            reply.add_done_callback(
+                lambda t: None if t.cancelled() else t.exception())
+            try:
+                msg = await asyncio.wait_for(reply, 2.0)
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                    ConnectionError, OSError, ValueError):
+                return  # closed without a reply = accepted
+            mtype = msg.get("type")
+            if mtype == control.RT_REJECT:
+                # stale-lease fence bounced back at us: a peer holds proof
+                # of a fresher lease — never keep claiming over it
+                self.lease_rejects += 1
+                peer_id = str(msg.get("router", ""))
+                peer_epoch = int(msg.get("epoch") or 0)
+                if self.lease is not None:
+                    was = self.lease.role
+                    if self.lease.observe_reject(peer_epoch):
+                        logger.error(
+                            "router %s DEMOTED: %s fenced our frame at "
+                            "epoch %s", self.router_id, peer_id, peer_epoch)
+                        obs_flight.trigger("router_demoted",
+                                           router=self.router_id,
+                                           epoch=peer_epoch, holder=peer_id)
+                        if self.lease.role != was:
+                            self._fire("lease_demoted", self.router_id)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    async def _push_stek_to_gateways(self) -> None:
+        """Re-push the (replicated) STEK ring to every gateway connected
+        to THIS replica — the new leader's first act, so a ticket minted
+        under the dead leader's key redeems on the very next resume."""
+        for member in self._members_sorted():
+            if member.writer is None or member.stopped:
+                continue
+            try:
+                await control.send_ctrl(member.writer, {
+                    "type": control.GW_TICKET_KEYS,
+                    "keys": self.ticket_keys.export(),
+                    "lease_epoch": self._lease_epoch(),
+                })
+            except (ConnectionError, OSError, RuntimeError):
+                logger.warning("STEK re-push to %s failed",
+                               member.gateway_id)
+
     # -- health loop / handoff ------------------------------------------------
 
     async def _health_loop(self) -> None:
@@ -674,9 +1025,14 @@ class GatewayFleet:
                 continue
             for entry in _faults.process_control(member.gateway_id):
                 self._apply_chaos(member, entry)
+        # the lease half: claim/renew/demote decisions on this same tick
+        if self.lease is not None:
+            self._lease_tick()
         # automatic STEK rotation (dual-key window: the demoted key still
-        # opens tickets minted just before the rotation)
-        if (self.ticket_key_rotation_s
+        # opens tickets minted just before the rotation) — LEADER-ONLY in
+        # a replicated control plane: a follower rotating would fork the
+        # accept window and orphan every in-flight ticket
+        if (self.ticket_key_rotation_s and self.has_authority
                 and now - self._last_key_rotation_t
                 >= self.ticket_key_rotation_s):
             self._last_key_rotation_t = now
@@ -891,6 +1247,13 @@ class GatewayFleet:
         the accept window) and push the new ring to every live gateway.
         Returns the new epoch.  Tickets minted before the PREVIOUS
         rotation stop resuming — the documented forward-secrecy bound."""
+        if not self.has_authority:
+            # a follower/demoted replica asked to rotate (operator error,
+            # split-brain remnant): refusing here is the local half of the
+            # fencing — the wire half is followers rejecting the stale push
+            raise RuntimeError(
+                f"router {self.router_id} ({self.lease_view()['role']}) "
+                "does not hold the lease: STEK rotation refused")
         epoch = self.ticket_keys.rotate()
         self.key_rotations += 1
         obs_flight.record("stek_rotated", epoch=epoch,
@@ -904,11 +1267,16 @@ class GatewayFleet:
                 await control.send_ctrl(member.writer, {
                     "type": control.GW_TICKET_KEYS,
                     "keys": self.ticket_keys.export(),
+                    "lease_epoch": self._lease_epoch(),
                 })
             except (ConnectionError, OSError, RuntimeError):
                 # a dying gateway misses the push; re-registration (or the
                 # respawn after its restart) re-sends the current ring
                 logger.warning("STEK push to %s failed", member.gateway_id)
+        if self.lease is not None:
+            # every rotation replicates: ANY follower must be able to
+            # assume the lease without losing the accept window
+            await self._replicate_state()
         return epoch
 
     async def drain(self, gateway_id: str) -> None:
@@ -923,8 +1291,10 @@ class GatewayFleet:
         logger.warning("draining gateway %s (routing excluded)", gateway_id)
         if member.writer is not None:
             try:
-                await control.send_ctrl(member.writer,
-                                        {"type": control.GW_DRAIN})
+                await control.send_ctrl(member.writer, {
+                    "type": control.GW_DRAIN,
+                    "lease_epoch": self._lease_epoch(),
+                })
             except (ConnectionError, OSError, RuntimeError):
                 pass  # already dying; the exit path is the same
 
@@ -1134,6 +1504,11 @@ class GatewayFleet:
     def stats(self) -> dict[str, Any]:
         return {
             "gateways": len(self.members),
+            "router_id": self.router_id,
+            "lease": self.lease_view(),
+            "lease_rejects": self.lease_rejects,
+            "lease_fenced": self.lease_fenced,
+            "syncs_applied": self.syncs_applied,
             "spawn": self.spawn,
             "seed": self.seed,
             "ring_vnodes": self.ring.vnodes,

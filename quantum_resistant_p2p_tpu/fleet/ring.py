@@ -18,6 +18,13 @@ The ring tracks MEMBERSHIP only.  Liveness lives one level up
 healthy, so a dead gateway's arc drains to its ring successors and —
 because membership never changed — snaps back the moment its breaker
 closes again.
+
+Members are plain string ids, so the SAME machinery places every tier:
+peer→gateway assignment is the original use, and the replicated control
+plane (docs/fleet.md "HA control plane") puts ROUTERS on a ring too —
+clients and ``tools/qrtop.py`` walk ``successors(key)`` over router ids
+to pick which replica to ask first and the deterministic failover order
+when it is dead, exactly the discipline the data plane already uses.
 """
 
 from __future__ import annotations

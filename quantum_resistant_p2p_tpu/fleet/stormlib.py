@@ -35,6 +35,19 @@ import os
 from typing import Iterator
 
 
+def seeded_jitter_rng(seed: int, *labels: str) -> "random.Random":
+    """A deterministic per-entity jitter stream: the run's seed XOR a
+    digest of the entity labels (e.g. ``gateway_id, router_id`` for one
+    control link).  Every backoff/jitter site in the fleet derives its
+    RNG here so a seeded storm replays byte-identically — and NEVER via
+    ``hash()``, whose per-process salt would silently defeat the seeding
+    across gateway subprocesses."""
+    import random
+
+    tag = hashlib.sha256(":".join(labels).encode()).digest()[:4]
+    return random.Random(int(seed) ^ int.from_bytes(tag, "big"))
+
+
 def raise_fd_limit(need: int) -> None:
     """A 10k-session storm needs ~2 fds per session in one process: lift
     the soft RLIMIT_NOFILE to the hard cap (best-effort)."""

@@ -1,0 +1,400 @@
+"""Replicated router control plane (fleet/lease.py, fleet/router.py) —
+the ISSUE 18 HA acceptance suite.
+
+Covers the robustness satellite:
+
+* seeded determinism of the lease state machine: the same injectable
+  clocks + the same observed frames yield byte-identical transition
+  logs, and rank-staggered claims make the failover winner a function
+  of the seed, not the scheduler,
+* the tied-claim race (two replicas claiming the same epoch, frames
+  crossing): exactly one leader survives, broken on holder id with no
+  third arbiter,
+* stale-lease fencing in BOTH directions: a follower fences stale
+  ``__rt_lease__``/``__rt_sync__`` frames with a typed ``__rt_reject__``
+  and flight-records the event; a leader that receives such a reject
+  demotes loudly instead of split-braining (live TCP, two replicas),
+* STEK-rotation-during-failover: a ticket minted under the dead
+  leader's current key still redeems at the new leader within the
+  dual-key accept window, and the replication install guard refuses a
+  pre-rotation frame that would regress the window,
+* leader kill mid-storm (task-mode router fleet over real TCP): every
+  established session finishes — clients fail over across the router
+  ring on typed transport errors — and at least one reconnect AFTER
+  the failover resumes via a ticket minted before it,
+* the double-hello conn_gen supersede: a gateway reconnecting to a
+  router before the old control loop saw its EOF must not double-count
+  heartbeats or null the live writer (the N-router heartbeat dedupe
+  bugfix).
+
+Everything runs on minimal images: stdlib toy crypto, fake clocks for
+the lease timelines, ``spawn="task"`` fleets for the live cases.
+"""
+
+import asyncio
+
+import pytest
+
+from quantum_resistant_p2p_tpu.app.resumption import STEKRing
+from quantum_resistant_p2p_tpu.fleet import control as fleet_control
+from quantum_resistant_p2p_tpu.fleet.lease import (DEMOTED, FOLLOWER, LEADER,
+                                                   LeaderLease)
+from quantum_resistant_p2p_tpu.fleet.manager import GatewayFleet
+from quantum_resistant_p2p_tpu.obs import flight as obs_flight
+
+
+@pytest.fixture
+def run():
+    loop = asyncio.new_event_loop()
+    yield loop.run_until_complete
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    loop.close()
+
+
+@pytest.fixture
+def recorder(monkeypatch):
+    """A fresh process-wide flight recorder: the fencing assertions must
+    see THIS test's events, not a prior storm's ring."""
+    rec = obs_flight.FlightRecorder()
+    monkeypatch.setattr(obs_flight, "RECORDER", rec)
+    return rec
+
+
+def _kinds(rec):
+    return [ev["kind"] for ev in rec.snapshot()]
+
+
+# -- lease state machine: seeded determinism ----------------------------------
+
+
+def _scripted_failover():
+    """One fixed failover timeline on fake clocks: rt0 claims, renews
+    once, dies; rt1 takes over after its rank stagger; rt0 respawns and
+    follows.  Returns both transition logs (the determinism pin)."""
+    now = [0.0]
+    clock = lambda: now[0]  # noqa: E731 — shared fake clock
+    rt0 = LeaderLease("rt0", 0, ttl_s=1.0, claim_stagger_s=0.25, clock=clock)
+    rt1 = LeaderLease("rt1", 1, ttl_s=1.0, claim_stagger_s=0.25, clock=clock)
+
+    # birth grace: neither claims before a full TTL of silence
+    assert not rt0.claim_due() and not rt1.claim_due()
+    now[0] = 1.0
+    # rank stagger: rt0 is due at expiry, rt1 only a stagger later
+    assert rt0.claim_due() and not rt1.claim_due()
+    body = rt0.claim()
+    assert body["epoch"] == 1 and rt0.is_leader
+    assert rt1.observe(body["holder"], body["epoch"], body["ttl_s"])
+
+    now[0] = 1.5  # ttl/3 cadence: renew well before followers see expiry
+    assert rt0.renew_due()
+    body = rt0.renew()
+    assert rt1.observe(body["holder"], body["epoch"], body["ttl_s"])
+
+    # rt0 dies (no more frames).  rt1's lease view expires at 2.5; its
+    # rank-1 stagger holds the claim until 2.75.
+    now[0] = 2.6
+    assert not rt1.claim_due()
+    now[0] = 2.8
+    assert rt1.claim_due()
+    body = rt1.claim()
+    assert body["epoch"] == 2 and rt1.is_leader
+
+    # rt0 respawns with a FRESH lease: the birth grace keeps it quiet,
+    # and the first observed renewal folds it in as a follower
+    rt0b = LeaderLease("rt0", 0, ttl_s=1.0, claim_stagger_s=0.25, clock=clock)
+    assert not rt0b.claim_due()
+    assert rt0b.observe(body["holder"], body["epoch"], body["ttl_s"])
+    assert rt0b.role == FOLLOWER and rt0b.holder == "rt1"
+    return rt0.transitions + rt0b.transitions, rt1.transitions
+
+
+def test_lease_failover_is_deterministic_on_injected_clocks():
+    """Same clocks + same frames ⇒ byte-identical transition logs: the
+    failover winner is a function of rank and timing, never of scheduler
+    interleaving (the seeded-chaos replay contract, control-plane tier)."""
+    a0, a1 = _scripted_failover()
+    b0, b1 = _scripted_failover()
+    assert repr(a0) == repr(b0)
+    assert repr(a1) == repr(b1)
+    assert [t[1:3] for t in a1] == [(FOLLOWER, LEADER)]
+    assert a1[0][3] == 2  # rt1 took over at epoch 2 (monotonic, not reused)
+
+
+def test_tied_claim_race_converges_without_arbiter():
+    """Two replicas claim the same epoch before seeing each other (the
+    crossed-frames race): holder-id order picks exactly one leader, and
+    the loser demotes loudly — no third party, no silent dual-leader."""
+    now = [10.0]
+    a = LeaderLease("rt0", 0, ttl_s=1.0, clock=lambda: now[0])
+    b = LeaderLease("rt1", 0, ttl_s=1.0, clock=lambda: now[0])
+    assert a.claim()["epoch"] == 1
+    assert b.claim()["epoch"] == 1
+    # frames cross: rt0 fences rt1's tied claim (rt0 < rt1) ...
+    assert a.observe("rt1", 1, 1.0) is False
+    assert a.is_leader and a.stale_rejects == 1
+    # ... and rt1 accepts rt0's, demoting itself out of the split brain
+    assert b.observe("rt0", 1, 1.0) is True
+    assert b.role == DEMOTED
+    assert any(reason == "superseded_by=rt0"
+               for *_ignored, reason in b.transitions)
+
+
+def test_demotion_is_sticky_until_rejoin():
+    """A fenced leader demotes, stops claiming entirely (sticky — a
+    flapping ex-leader must not oscillate), and re-enters only via the
+    explicit rejoin path."""
+    now = [0.0]
+    lease = LeaderLease("rt0", 0, ttl_s=1.0, clock=lambda: now[0])
+    now[0] = 1.0
+    lease.claim()
+    assert lease.observe_reject(7) is True  # proof a fresher lease exists
+    assert lease.role == DEMOTED and lease.max_seen_epoch == 7
+    now[0] = 100.0  # far past any expiry: still never claims
+    assert not lease.claim_due()
+    assert any(reason == "fenced_by_peer"
+               for *_ignored, reason in lease.transitions)
+    lease.rejoin()
+    assert lease.role == FOLLOWER
+    assert lease.claim_due()  # a follower past expiry claims again
+
+
+# -- stale-lease fencing over the control link --------------------------------
+
+
+class _CaptureWriter:
+    """Just enough StreamWriter for ``send_ctrl``: buffers the frames a
+    handler replies with so the test can decode them."""
+
+    def __init__(self):
+        self.buf = b""
+        self.closed = False
+
+    def write(self, data):
+        self.buf += data
+
+    async def drain(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+async def _decode_frames(buf: bytes) -> list[dict]:
+    reader = asyncio.StreamReader()
+    reader.feed_data(buf)
+    reader.feed_eof()
+    frames = []
+    while True:
+        try:
+            frames.append(await fleet_control.read_ctrl(reader))
+        except asyncio.IncompleteReadError:
+            return frames
+
+
+def _replica(router_id: str, rank: int, peers=None) -> GatewayFleet:
+    return GatewayFleet(0, attach=True, spawn="task", router_id=router_id,
+                        router_rank=rank, router_peers=list(peers or []),
+                        lease_ttl_s=1.0, lease_stagger_s=0.25)
+
+
+def test_stale_authority_frames_are_fenced_and_flight_recorded(run, recorder):
+    """A replica tracking lease epoch 5 fences an epoch-3 claim AND an
+    epoch-2 sync with typed ``__rt_reject__`` replies carrying ITS epoch,
+    flight-records both, and lets neither touch the STEK ring or the
+    membership roster."""
+    fleet = _replica("rtA", 0)
+    assert fleet.lease.observe("rtB", 5, 60.0)
+
+    w = _CaptureWriter()
+    run(fleet._on_rt_lease(
+        {"type": fleet_control.RT_LEASE, "holder": "rtC", "epoch": 3,
+         "ttl_s": 1.0}, w))
+    (reject,) = run(_decode_frames(w.buf))
+    assert reject == {"type": fleet_control.RT_REJECT, "router": "rtA",
+                      "epoch": 5}
+    assert fleet.lease_fenced == 1
+    assert "stale_lease_fenced" in _kinds(recorder)
+
+    ring_before = fleet.ticket_keys.export()
+    w2 = _CaptureWriter()
+    run(fleet._on_rt_sync(
+        {"type": fleet_control.RT_SYNC, "holder": "rtC", "epoch": 2,
+         "keys": [["eeee", "00" * 32]], "rotations": 9,
+         "members": ["gwZ"]}, w2))
+    (reject2,) = run(_decode_frames(w2.buf))
+    assert reject2["type"] == fleet_control.RT_REJECT
+    assert reject2["epoch"] == 5
+    assert fleet.ticket_keys.export() == ring_before  # authority untouched
+    assert "gwZ" not in fleet.members
+    assert fleet.lease_fenced == 2
+    assert "stale_sync_fenced" in _kinds(recorder)
+
+
+def test_stale_leader_demotes_on_reject_reply(run, recorder):
+    """Split-brain, live over TCP: a replica that claims epoch 1 while a
+    peer already tracks epoch 5 gets its announcement fenced — and the
+    bounced ``__rt_reject__`` demotes it loudly (flight trigger), never
+    leaving two writers of STEK authority."""
+
+    async def scenario():
+        peer = _replica("rtB", 1)
+        await peer.start()
+        try:
+            assert peer.lease.observe("rtX", 5, 60.0)
+            stale = _replica(
+                "rtA", 0,
+                peers=[{"router": "rtB", "host": "127.0.0.1",
+                        "port": peer.ctrl_port}])
+            body = stale.lease.claim()
+            assert body["epoch"] == 1 and stale.lease.is_leader
+            await stale._announce_lease(body, sync=False)
+            assert stale.lease.role == DEMOTED
+            assert stale.lease_rejects >= 1
+            assert peer.lease_fenced >= 1
+            kinds = _kinds(obs_flight.RECORDER)
+            assert "router_demoted" in kinds
+            assert "stale_lease_fenced" in kinds
+        finally:
+            await peer.stop()
+
+    run(scenario())
+
+
+# -- STEK replication: the accept window survives failover --------------------
+
+
+def _import_export(ring_export):
+    return [(ep, bytes.fromhex(key_hex)) for ep, key_hex in ring_export]
+
+
+def test_ticket_minted_under_dead_leader_redeems_after_failover():
+    """The failover currency: a ticket sealed under the leader's CURRENT
+    key — then demoted to previous by one more rotation — still opens at
+    the follower that replicated both frames, because the dual-key accept
+    window travels with the ``__rt_sync__`` export.  The install guard
+    refuses the pre-rotation frame that would regress the window."""
+    leader = STEKRing()
+    follower = STEKRing()
+    assert follower.install(_import_export(leader.export()), guard=True)
+
+    secret = bytes(range(32))
+    ticket = leader.seal_ticket({"sid": "s1", "secret": secret.hex()})
+    pre_rotation = leader.export()
+    leader.rotate()
+    assert follower.install(_import_export(leader.export()), guard=True)
+    # leader dies here; the follower IS the accept window now
+    fields, stek = follower.open_ticket(ticket)
+    assert fields == {"sid": "s1"} and stek == secret
+    # a new ticket mints under the replicated CURRENT key
+    fields2, _stek2 = follower.open_ticket(
+        follower.seal_ticket({"sid": "s2", "secret": secret.hex()}))
+    assert fields2 == {"sid": "s2"}
+
+    # structural regression guard: a delayed pre-rotation replicate frame
+    # (same lease epoch, slower connection) must not roll the window back
+    assert follower.install(_import_export(pre_rotation), guard=True) is False
+    fields3, _stek3 = follower.open_ticket(ticket)  # window unchanged
+    assert fields3 == {"sid": "s1"}
+
+
+# -- leader kill mid-storm (live task-mode router fleet) ----------------------
+
+
+def test_router_storm_survives_seeded_leader_kill(run):
+    """The HA chaos acceptance shape in miniature (CI runs it at 1000
+    sessions via ``bench.py --storm --fleet 3 --router-roll``): a seeded
+    mid-storm kill of the initial leader, every established session
+    finishes — clients fail over across the router ring on typed
+    transport errors — 0 plaintext, and reconnects landing after the
+    kill still resume via tickets minted before it."""
+    from quantum_resistant_p2p_tpu.fleet.storm import (
+        default_router_kill_rules, run_router_storm)
+
+    out = run(run_router_storm(
+        sessions=12, gateways=2, routers=2, spawn="task", concurrency=12,
+        msgs_per_session=6, msg_interval_s=0.1, hb_interval=0.1,
+        ke_timeout=30.0, session_attempts=8, seed=3,
+        lease_ttl_s=0.5, lease_stagger_s=0.1, roll=False,
+        fault_rules=default_router_kill_rules("rt0", 4)))
+    assert out["completed_sessions"] == 12
+    assert out["lost_established_sessions"] == 0
+    assert out["plaintext_sends"] == 0
+    assert out["router_kills"] >= 1
+    assert out["chaos"]["injected"] >= 1
+    # the dead leader's clients walked the router ring (typed transport
+    # failure -> next replica), they did not stall out
+    assert out["router_failovers"] >= 1
+    # ≥1 post-failover reconnect redeemed a pre-failover ticket: the
+    # accept window provably survived the leader
+    assert out["post_failover_resumed"] >= 1
+    roles = {row["router"]: (row["lease"] or {}).get("role")
+             for row in out["router_fleet"]["routers"]}
+    assert roles.get("rt1") == LEADER  # rt1 took over after the kill
+    assert out["initial_leader"] == "rt0"  # ...from the seeded victim
+
+
+# -- conn_gen supersede (the N-router heartbeat dedupe fix) -------------------
+
+
+def test_second_hello_supersedes_stale_control_connection(run):
+    """A gateway's reconnect can land before the router's old control
+    loop saw its EOF (with N routers this happens constantly).  The new
+    hello must supersede: the old loop's frames stop counting (no
+    double-shifted reconcile windows) and its eventual EOF must NOT null
+    the LIVE connection's state."""
+
+    async def gw_conn(port, hello):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await fleet_control.send_ctrl(writer, hello)
+        push = await fleet_control.read_ctrl(reader)  # STEK push = registered
+        assert push["type"] == fleet_control.GW_TICKET_KEYS
+        return reader, writer
+
+    async def scenario():
+        fleet = GatewayFleet(0, attach=True, spawn="task", hb_interval=0.5)
+        await fleet.start()
+        try:
+            hello = {"type": fleet_control.GW_HELLO, "gateway": "gwX",
+                     "p2p_port": 41001, "pid": 1}
+            r1, w1 = await gw_conn(fleet.ctrl_port, hello)
+            member = fleet.members["gwX"]
+            assert member.conn_gen == 1 and member.port == 41001
+            live_writer = member.writer
+
+            # the reconnect: same gateway, fresh connection, new port —
+            # the new hello supersedes (gen bump) and the router CLOSES
+            # the stale server-side writer at once, so the dead
+            # incarnation's frames can no longer count against gwX
+            _r2, w2 = await gw_conn(
+                fleet.ctrl_port, dict(hello, p2p_port=41002, pid=2))
+            assert member.conn_gen == 2
+            assert member.port == 41002
+            assert member.writer is not live_writer
+            data = await r1.read()  # the stale connection really is dead
+            assert data == b""
+
+            # the stale loop's EOF must NOT null the live writer or the
+            # registration (pre-fix, this left a serving gateway
+            # unreachable for probes and STEK pushes)
+            w1.close()
+            await asyncio.sleep(0.1)
+            assert member.port == 41002
+            assert member.writer is not None
+            assert member.registered
+
+            # the live connection heartbeats normally
+            hb_count = member.hb_count
+            await fleet_control.send_ctrl(w2, {
+                "type": fleet_control.GW_HEARTBEAT, "gateway": "gwX",
+                "stats": {"connections": 0}})
+            for _ in range(40):
+                if member.hb_count > hb_count:
+                    break
+                await asyncio.sleep(0.02)
+            assert member.hb_count == hb_count + 1
+            assert member.breaker.state == "closed"
+            w2.close()
+        finally:
+            await fleet.stop()
+
+    run(scenario())

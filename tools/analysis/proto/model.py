@@ -133,7 +133,7 @@ class StateRef:
 
 def role_of(path: str) -> str:
     p = path.replace("\\", "/")
-    if p.endswith("fleet/manager.py"):
+    if p.endswith(("fleet/manager.py", "fleet/router.py", "fleet/lease.py")):
         return "router"
     if p.endswith("fleet/gateway.py"):
         return "gateway"

@@ -69,6 +69,15 @@ echo "resume-mix smoke ok (1-RTT ticket resumes, 0 failures)"
 python bench.py --storm --fleet 2 --roll --sessions 40 >/dev/null
 echo "drain smoke ok (rolling restart survived: 0 lost sessions, >=1 ticket resume)"
 
+# HA control-plane smoke (docs/fleet.md "HA control plane"): 2 router
+# replicas, 2 gateway processes, a seeded mid-storm SIGKILL of the
+# leader plus a rolling restart of every router — 0 lost established
+# sessions, clients failing over across the router ring, and at least
+# one post-failover reconnect resuming via a ticket minted under the
+# dead leader's STEK (the replicated accept window really survived).
+python bench.py --storm --fleet 2 --router-roll --routers 2 --sessions 40 >/dev/null
+echo "router-roll smoke ok (leader SIGKILL + router roll survived: 0 lost sessions, post-failover ticket resume)"
+
 # FrodoKEM device-path smoke (docs/dispatch_budget.md "Kernel matrix"):
 # a 2-batch keygen/encaps/decaps roundtrip through the tpu-backend
 # provider must match the pure-Python reference byte-for-byte AND the

@@ -10,7 +10,12 @@ top(1)-style loop instead of a post-hoc artifact.
 Endpoints come from the command line (``host:port`` or
 ``name=host:port``) or are discovered from a fleet router's aggregated
 ``/fleet`` view (``--fleet host:port`` — fleet/manager.py announces each
-gateway's telemetry port from its hello/heartbeats).
+gateway's telemetry port from its hello/heartbeats).  With a REPLICATED
+control plane (fleet/router.py), pass ``--fleet`` once per router:
+discovery falls back across the replicas (any one reachable is enough),
+and each router renders as its own row with a ROLE column
+(leader/follower/demoted — the live lease view, docs/fleet.md "HA
+control plane").
 
 ``--snapshot`` takes ONE poll and emits the JSON document instead of
 rendering — the CI artifact mode (``bench.py --storm --fleet N`` runs
@@ -24,6 +29,7 @@ Usage::
     python tools/qrtop.py 127.0.0.1:9100 gw1=127.0.0.1:9101
     python tools/qrtop.py --fleet 127.0.0.1:9000 --interval 2
     python tools/qrtop.py --fleet 127.0.0.1:9000 --snapshot --out snap.json
+    python tools/qrtop.py --fleet 127.0.0.1:9000 --fleet 127.0.0.1:9001
 """
 
 from __future__ import annotations
@@ -126,30 +132,83 @@ def scrape_gateway(name: str, base: str) -> dict[str, Any]:
     }
 
 
-def snapshot_endpoints(endpoints: dict[str, str]) -> dict[str, Any]:
+def scrape_router(name: str, base: str) -> dict[str, Any]:
+    """One ROUTER replica's dashboard row, from its ``/fleet`` view: the
+    live lease role (leader/follower/demoted), epoch/holder, and the
+    control-plane counters — a demoted or dead replica is a visible row,
+    the whole point of watching a failover live."""
+    doc = fetch_json(base, "/fleet")
+    router = (doc or {}).get("router") or {}
+    if not router:
+        return {"router": name, "endpoint": base, "reachable": False}
+    lease = router.get("lease") or {}
+    return {
+        "router": str(router.get("router_id") or name),
+        "endpoint": base,
+        "reachable": True,
+        "role": lease.get("role"),
+        "epoch": lease.get("epoch"),
+        "holder": lease.get("holder"),
+        "standalone": bool(lease.get("standalone")),
+        "gateways": router.get("gateways"),
+        "routes_ok": router.get("routes_ok"),
+        "route_sheds": router.get("route_sheds"),
+        "stek_rotations": router.get("stek_rotations"),
+        "lease_rejects": router.get("lease_rejects"),
+        "lease_fenced": router.get("lease_fenced"),
+        "syncs_applied": router.get("syncs_applied"),
+    }
+
+
+def snapshot_endpoints(endpoints: dict[str, str],
+                       routers: dict[str, str] | None = None
+                       ) -> dict[str, Any]:
     """One-shot scrape of every endpoint — the ``--snapshot`` document
     (also called in-harness by ``fleet/storm.py`` while the gateways are
     live, which is how the committed CI artifact is produced)."""
-    return {
+    doc: dict[str, Any] = {
         "tool": "qrtop --snapshot",
         "endpoints": dict(endpoints),
         "gateways": {name: scrape_gateway(name, base)
                      for name, base in sorted(endpoints.items())},
     }
+    if routers:
+        doc["routers"] = {name: scrape_router(name, base)
+                          for name, base in sorted(routers.items())}
+    return doc
 
 
-def discover_fleet(router: str) -> dict[str, str]:
-    """Gateway telemetry endpoints from a router's ``/fleet`` view."""
-    doc = fetch_json(router, "/fleet")
-    if doc is None:
-        raise SystemExit(f"qrtop: no /fleet view at http://{router}")
-    host = router.rsplit(":", 1)[0]
-    out: dict[str, str] = {}
-    for member in ((doc.get("router") or {}).get("members") or []):
-        port = member.get("telemetry_port")
-        if port:
-            out[str(member.get("gateway"))] = f"{host}:{port}"
-    return out
+def discover_fleet(routers: list[str]) -> tuple[dict[str, str],
+                                                dict[str, str]]:
+    """Gateway + router telemetry endpoints from the replicas' ``/fleet``
+    views, falling back across ``routers`` — with a replicated control
+    plane any ONE reachable replica can describe the whole fleet, so a
+    dead leader must not blind the dashboard.  Returns
+    ``(gateway_endpoints, router_endpoints)``; raises only when every
+    replica is unreachable."""
+    gw_eps: dict[str, str] = {}
+    rt_eps: dict[str, str] = {}
+    any_reachable = False
+    for i, router in enumerate(routers):
+        doc = fetch_json(router, "/fleet")
+        host = router.rsplit(":", 1)[0]
+        if doc is None:
+            rt_eps.setdefault(f"rt?{i}", router)
+            continue
+        any_reachable = True
+        rview = doc.get("router") or {}
+        rt_eps[str(rview.get("router_id") or f"rt{i}")] = router
+        for member in (rview.get("members") or []):
+            port = member.get("telemetry_port")
+            if port:
+                # first reachable replica wins per gateway (they all
+                # describe the same announced ports)
+                gw_eps.setdefault(str(member.get("gateway")),
+                                  f"{host}:{port}")
+    if not any_reachable:
+        raise SystemExit("qrtop: no /fleet view at any of "
+                         + ", ".join(f"http://{r}" for r in routers))
+    return gw_eps, rt_eps
 
 
 # -- live rendering ------------------------------------------------------------
@@ -163,6 +222,29 @@ def _fmt(v: Any, pct: bool = False) -> str:
     if isinstance(v, float):
         return f"{v:.2f}"
     return str(v)
+
+
+def render_routers(rows: list[dict[str, Any]]) -> str:
+    """The control-plane header block: one line per router replica with
+    its live lease ROLE — a failover reads as the leader row going
+    unreachable and a follower row flipping to leader; a split-brain
+    averted reads as a demoted row."""
+    cols = ("ROUTER", "ROLE", "EPOCH", "HOLDER", "GWS", "ROUTES", "SHED",
+            "SYNCS", "FENCED")
+    lines = ["  ".join(f"{c:<10}" for c in cols)]
+    for row in rows:
+        name = row["router"]
+        if not row.get("reachable"):
+            lines.append(f"{name:<10}  [unreachable: {row['endpoint']}]")
+            continue
+        role = ("standalone" if row.get("standalone")
+                else row.get("role") or "-")
+        vals = (name, role, _fmt(row.get("epoch")),
+                row.get("holder") or "-", _fmt(row.get("gateways")),
+                _fmt(row.get("routes_ok")), _fmt(row.get("route_sheds")),
+                _fmt(row.get("syncs_applied")), _fmt(row.get("lease_fenced")))
+        lines.append("  ".join(f"{v:<10}" for v in vals))
+    return "\n".join(lines)
 
 
 def render(rows: list[dict[str, Any]], prev: dict[str, dict[str, Any]],
@@ -207,11 +289,14 @@ def render(rows: list[dict[str, Any]], prev: dict[str, dict[str, Any]],
 
 
 def live_loop(endpoints: dict[str, str], interval: float,
-              iterations: int | None = None, out=sys.stdout) -> None:
+              iterations: int | None = None, out=sys.stdout,
+              routers: dict[str, str] | None = None) -> None:
     prev: dict[str, dict[str, Any]] = {}
     prev_t: float | None = None
     n = 0
     while iterations is None or n < iterations:
+        router_rows = [scrape_router(name, base)
+                       for name, base in sorted((routers or {}).items())]
         rows = [scrape_gateway(name, base)
                 for name, base in sorted(endpoints.items())]
         # rates divide by the REAL elapsed time since the last frame, not
@@ -223,6 +308,8 @@ def live_loop(endpoints: dict[str, str], interval: float,
         elapsed = (now - prev_t) if prev_t is not None else 0.0
         prev_t = now
         frame = render(rows, prev, elapsed)
+        if router_rows:
+            frame = render_routers(router_rows) + "\n\n" + frame
         # ANSI home+clear keeps it a flicker-free top(1)-style refresh
         out.write("\x1b[H\x1b[2J" if out.isatty() else "")
         out.write(time.strftime("qrtop  %H:%M:%S") + f"  ({len(rows)} "
@@ -240,9 +327,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("endpoints", nargs="*",
                     help="gateway telemetry endpoints: host:port or "
                          "name=host:port")
-    ap.add_argument("--fleet", default=None,
+    ap.add_argument("--fleet", action="append", default=None,
                     help="router telemetry host:port — discover gateway "
-                         "endpoints from its /fleet view")
+                         "endpoints from its /fleet view; repeat once per "
+                         "replica (HA control plane): discovery falls "
+                         "back across them and each renders a ROLE row")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="poll interval (seconds) in live mode")
     ap.add_argument("--iterations", type=int, default=None,
@@ -255,8 +344,10 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     endpoints: dict[str, str] = {}
+    routers: dict[str, str] = {}
     if args.fleet:
-        endpoints.update(discover_fleet(args.fleet))
+        gw_eps, routers = discover_fleet(list(args.fleet))
+        endpoints.update(gw_eps)
     for i, spec in enumerate(args.endpoints):
         name, _, base = spec.rpartition("=")
         endpoints[name or f"gw{i}"] = base
@@ -264,7 +355,7 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("no endpoints (pass host:port args or --fleet)")
 
     if args.snapshot:
-        doc = snapshot_endpoints(endpoints)
+        doc = snapshot_endpoints(endpoints, routers=routers or None)
         line = json.dumps(doc, indent=2, sort_keys=True)
         print(line)
         if args.out:
@@ -275,7 +366,8 @@ def main(argv: list[str] | None = None) -> int:
         return 1 if len(unreachable) == len(doc["gateways"]) else 0
 
     try:
-        live_loop(endpoints, args.interval, args.iterations)
+        live_loop(endpoints, args.interval, args.iterations,
+                  routers=routers or None)
     except KeyboardInterrupt:
         pass
     return 0
