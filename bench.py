@@ -1140,7 +1140,13 @@ if __name__ == "__main__":
                          "device-path probe")
     ap.add_argument("--batch", type=int, default=FRODO_RAW_BATCH,
                     help="dispatch rows for --raw-ops --family frodo")
+    ap.add_argument("--full-snapshots", action="store_true",
+                    help="write RAW per-registry metrics snapshots "
+                         "(~MBs for a storm) instead of the compact "
+                         "committed digests")
     args = ap.parse_args()
+    from tools.swarm_bench import set_full_snapshots
+    set_full_snapshots(args.full_snapshots)
     if args.raw_ops and args.family == "frodo":
         raise SystemExit(frodo_raw_ops_main(args.out, args.batch))
     if args.slo:

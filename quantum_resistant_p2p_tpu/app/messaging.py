@@ -1007,7 +1007,7 @@ class SecureMessaging:
                 pk, sk = await self._kem_keygen(lane)
             except Exception:
                 logger.exception("ephemeral keygen failed")
-                return RejectReason.KEYGEN_ERROR.value
+                return RejectReason.KEYGEN_ERROR.value  # qrlife: disable=life-wipe-gap — sk is None on this path: the fused branch failed or was skipped (pk None guard) and this keygen raised before binding one
             ke_data["public_key"] = pk.hex()
             sig = await self._sign(_canonical(ke_data), lane)
         else:
@@ -1753,6 +1753,7 @@ class SecureMessaging:
             logger.exception("fused encaps_verify_sign failed; per-op fallback")
             return False
         if not ok:
+            _wipe(secret)  # encapsulated for a peer whose signature failed
             await self._reject(peer_id, message_id, RejectReason.INVALID_SIGNATURE)
             return True
         resp["ciphertext"] = ct.hex()
@@ -1890,6 +1891,7 @@ class SecureMessaging:
             logger.exception("fused decaps_verify_sign failed; per-op fallback")
             return None
         if not ok:
+            _wipe(secret)  # decapsulated under a signature that failed
             self._fail_pending(message_id, RejectReason.INVALID_SIGNATURE.value)
             self._drop_ephemeral(message_id)
             return _HANDLED
@@ -2063,6 +2065,7 @@ class SecureMessaging:
         obs_flight.record("ticket_minted", peer=peer_id[:8],
                           epoch=self.tickets.current_epoch,
                           expires_at=round(expires_at, 3))
+        _wipe(rsec)  # sealed into the ticket; the local copy is done
         return blob, expires_at
 
     def _accept_ticket(self, peer_id: str, msg: dict, secret: bytes) -> None:
@@ -2189,52 +2192,59 @@ class SecureMessaging:
             fields, rsec = self.tickets.open_ticket(blob)
         except TicketError as e:
             return e.reason
-        expires_at = float(fields.get("expires_at") or 0.0)
-        nonce = str(fields.get("nonce") or "")
-        if not nonce:
-            return "malformed_ticket"
-        if "expire" in forced or expires_at <= time.time():
-            return "expired_ticket"
-        if fields.get("holder") != peer_id:
-            return "holder_mismatch"
-        if (fields.get("kem"), fields.get("aead"), fields.get("sig")) != (
-                self.kem.name, self.symmetric.name, self.signature.name):
-            return "suite_mismatch"
-        want = resume_binder(rsec, _canonical(data), blob)
-        if not hmac.compare_digest(want, str(msg.get("binder", ""))):
-            return "bad_binder"
-        if "replay" in forced or self._replay.seen(nonce, expires_at,
-                                                   time.time()):
-            return "replayed_ticket"
-        # accepted: derive, install, re-mint (single-use), confirm — the
-        # whole exchange is host-side HKDF/HMAC, ~0 device-seconds (the
-        # cost ledger's resume probe pins that claim in the storm bench)
-        server_nonce = os.urandom(16).hex()
-        key = derive_resumed_key(rsec, client_nonce, server_nonce,
-                                 self.symmetric.name)
-        next_secret = ratchet_resumption_secret(rsec, client_nonce,
-                                                server_nonce)
-        fresh_expires = time.time() + RESUME_TICKET_TTL_S
-        fresh = self.tickets.seal_ticket(mint_fields(
-            peer_id, self.node_id, next_secret, self.kem.name,
-            self.symmetric.name, self.signature.name, fresh_expires))
-        self._adopt_secret(peer_id, rsec)
-        self.shared_keys[peer_id] = key
-        self.ke_state[peer_id] = KeyExchangeState.ESTABLISHED
-        self._ctr_resumes_ok.inc()
-        self._ctr_tickets_minted.inc()
-        obs_flight.record("ticket_resumed", peer=peer_id[:8],
-                          role="responder")
-        self._log("key_exchange", peer=peer_id, success=True,
-                  algorithm="ticket_resume", role="responder")
-        await self.node.send_message(
-            peer_id, "ke_resume_ok", message_id=message_id,
-            server_nonce=server_nonce,
-            confirm=resume_confirm_tag(key, message_id, client_nonce,
-                                       server_nonce),
-            ticket=fresh, expires_at=fresh_expires,
-        )
-        return None
+        # every exit below — typed reject or success — drops the opened
+        # resumption secret (the success path adopts a bytearray COPY)
+        next_secret = b""
+        try:
+            expires_at = float(fields.get("expires_at") or 0.0)
+            nonce = str(fields.get("nonce") or "")
+            if not nonce:
+                return "malformed_ticket"
+            if "expire" in forced or expires_at <= time.time():
+                return "expired_ticket"
+            if fields.get("holder") != peer_id:
+                return "holder_mismatch"
+            if (fields.get("kem"), fields.get("aead"), fields.get("sig")) != (
+                    self.kem.name, self.symmetric.name, self.signature.name):
+                return "suite_mismatch"
+            want = resume_binder(rsec, _canonical(data), blob)
+            if not hmac.compare_digest(want, str(msg.get("binder", ""))):
+                return "bad_binder"
+            if "replay" in forced or self._replay.seen(nonce, expires_at,
+                                                       time.time()):
+                return "replayed_ticket"
+            # accepted: derive, install, re-mint (single-use), confirm — the
+            # whole exchange is host-side HKDF/HMAC, ~0 device-seconds (the
+            # cost ledger's resume probe pins that claim in the storm bench)
+            server_nonce = os.urandom(16).hex()
+            key = derive_resumed_key(rsec, client_nonce, server_nonce,
+                                     self.symmetric.name)
+            next_secret = ratchet_resumption_secret(rsec, client_nonce,
+                                                    server_nonce)
+            fresh_expires = time.time() + RESUME_TICKET_TTL_S
+            fresh = self.tickets.seal_ticket(mint_fields(
+                peer_id, self.node_id, next_secret, self.kem.name,
+                self.symmetric.name, self.signature.name, fresh_expires))
+            self._adopt_secret(peer_id, rsec)
+            self.shared_keys[peer_id] = key
+            self.ke_state[peer_id] = KeyExchangeState.ESTABLISHED
+            self._ctr_resumes_ok.inc()
+            self._ctr_tickets_minted.inc()
+            obs_flight.record("ticket_resumed", peer=peer_id[:8],
+                              role="responder")
+            self._log("key_exchange", peer=peer_id, success=True,
+                      algorithm="ticket_resume", role="responder")
+            await self.node.send_message(
+                peer_id, "ke_resume_ok", message_id=message_id,
+                server_nonce=server_nonce,
+                confirm=resume_confirm_tag(key, message_id, client_nonce,
+                                           server_nonce),
+                ticket=fresh, expires_at=fresh_expires,
+            )
+            return None
+        finally:
+            _wipe(rsec)
+            _wipe(next_secret)
 
     async def _handle_ke_resume_ok(self, peer_id: str, msg: dict) -> None:
         """Initiator: verify the responder's proof-of-secret, install the
@@ -2256,14 +2266,16 @@ class SecureMessaging:
             _wipe(ctx["secret"])
             self._fail_pending(message_id, "bad_confirm")
             return
-        next_secret = ratchet_resumption_secret(rsec, ctx["client_nonce"],
-                                                server_nonce)
         self._adopt_secret(peer_id, rsec)
         _wipe(ctx["secret"])
         self.shared_keys[peer_id] = key
         self.ke_state[peer_id] = KeyExchangeState.ESTABLISHED
         fresh = bytes(msg.get("ticket") or b"")
         if fresh:
+            # ratchet only when there is a ticket to bind it to — no
+            # fresh ticket means no stored secret to account for
+            next_secret = ratchet_resumption_secret(rsec, ctx["client_nonce"],
+                                                    server_nonce)
             self._store_ticket(peer_id, fresh,
                                float(msg.get("expires_at") or 0.0),
                                next_secret)

@@ -40,6 +40,7 @@ from pathlib import Path
 from typing import Any
 
 from ..faults import FaultPlan
+from ..native import wipe
 from ..obs import slo as obs_slo
 from . import control
 from .manager import GatewayFleet
@@ -530,6 +531,7 @@ async def run_fleet_storm(
         out["telemetry"] = telemetry_info
     if cost_snapshot is not None:
         out["cost_snapshot"] = cost_snapshot
+    wipe(kp_sks)  # every session adopted its own copy at construction
     if plan is not None:
         out["chaos"] = {
             "seed": plan.seed,
@@ -980,6 +982,7 @@ async def run_router_storm(
         "fleet_slo_merged": merged,
         "client_cost": client_cost,
     }
+    wipe(kp_sks)  # every session adopted its own copy at construction
     if plan is not None:
         out["chaos"] = {
             "seed": plan.seed,

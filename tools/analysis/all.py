@@ -1,9 +1,9 @@
 """Unified analysis driver — ``python -m tools.analysis.all <targets>``.
 
-Runs all four ratchets in order (qrlint → qrflow → qrkernel → qrproto)
-over the same targets, emits ONE merged SARIF document (one ``runs[]``
-entry per analyzer) and returns ONE exit code, so CI needs a single step
-instead of four.  Also asserts the **suppression budget**
+Runs all five ratchets in order (qrlint → qrflow → qrkernel → qrproto →
+qrlife) over the same targets, emits ONE merged SARIF document (one
+``runs[]`` entry per analyzer) and returns ONE exit code, so CI needs a
+single step instead of five.  Also asserts the **suppression budget**
 (``tools/analysis/suppression_budget.json``): per-analyzer counts of
 inline suppressions may only go DOWN — a PR that adds an unbudgeted
 suppression fails loudly with the exact locations, and a PR that removes
@@ -13,7 +13,7 @@ Exit status: 0 all analyzers clean and within budget, 1 any error-severity
 finding or budget overrun, 2 usage errors.
 
 ```
-python -m tools.analysis.all quantum_resistant_p2p_tpu           # all four
+python -m tools.analysis.all quantum_resistant_p2p_tpu           # all five
 qr-analysis quantum_resistant_p2p_tpu --sarif-out merged.sarif   # CI step
 qr-analysis quantum_resistant_p2p_tpu --update-budget            # re-pin
 ```
@@ -31,6 +31,7 @@ from .engine import Engine, Finding, resolve_target
 from .flow import flow_rules
 from .flow.sarif import to_sarif
 from .kernel import kernel_rules
+from .life import life_rules
 from .proto import proto_rules
 
 BUDGET_PATH = Path(__file__).resolve().parent / "suppression_budget.json"
@@ -41,6 +42,7 @@ ANALYZERS = (
     ("qrflow", flow_rules),
     ("qrkernel", kernel_rules),
     ("qrproto", proto_rules),
+    ("qrlife", life_rules),
 )
 
 
@@ -105,8 +107,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="qr-analysis",
         description=("unified static-analysis driver: qrlint + qrflow + "
-                     "qrkernel + qrproto, one exit code, one merged SARIF "
-                     "(docs/static_analysis.md)"),
+                     "qrkernel + qrproto + qrlife, one exit code, one "
+                     "merged SARIF (docs/static_analysis.md)"),
     )
     ap.add_argument("targets", nargs="*", default=["quantum_resistant_p2p_tpu"],
                     help="files, directories, or package names (default: the package)")

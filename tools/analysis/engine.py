@@ -32,7 +32,7 @@ SEVERITIES = ("error", "warning")
 # `# qrproto: disable=…` (qrproto ids) — rule ids never collide across the
 # analyzers, so a shared parser is unambiguous
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:qrlint|qrkernel|qrproto):\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[\w.,\- ]+)")
+    r"#\s*(?:qrlint|qrkernel|qrproto|qrlife):\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[\w.,\- ]+)")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -57,7 +57,7 @@ CT_ALL = False
 # `# qrproto: disable=…` too, so a flow rule suppressed through THOSE
 # spellings must be policed all the same
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:qrlint|qrkernel|qrproto):\s*disable(?:-file)?\s*=\s*"
+    r"#\s*(?:qrlint|qrkernel|qrproto|qrlife):\s*disable(?:-file)?\s*=\s*"
     r"(?P<rules>[\w.,\- ]+)(?P<rest>.*)$")
 
 

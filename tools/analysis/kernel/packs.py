@@ -52,7 +52,7 @@ from . import dataflow
 from .interp import _ASSUME_RE, _WRAPPING_RE, Interp
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:qrlint|qrkernel):\s*disable(?:-file)?\s*=\s*"
+    r"#\s*(?:qrlint|qrkernel|qrproto|qrlife):\s*disable(?:-file)?\s*=\s*"
     r"(?P<rules>[\w.,\- ]+)(?P<rest>.*)$")
 
 

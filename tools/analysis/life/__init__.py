@@ -1,0 +1,22 @@
+"""qrlife — lock-discipline & resource-lifetime verifier.
+
+The fifth analyzer of the qr-analysis ratchet (qrlint → qrflow →
+qrkernel → qrproto → qrlife).  Pure AST on the qrlint engine, reusing
+qrflow's call graph and ownership domains: builds the project-wide
+lock-acquisition order graph, proves acquire/release pairing for the
+resources the fleet actually leaks (subprocess spawns, StreamWriters,
+executors, telemetry servers, tempdirs, tasks), and checks that every
+SECRET-taint local reaches a wipe on every explicit exit path.
+``python -m tools.analysis.life.run`` or the ``qrlife`` console script.
+"""
+
+from __future__ import annotations
+
+from ..engine import Rule
+from .packs import LIFE_RULES
+
+
+def life_rules() -> list[Rule]:
+    """Fresh instances of every qrlife rule (the all.py driver and the
+    CLI both construct per-run rule objects, mirroring flow/kernel/proto)."""
+    return [cls() for cls in LIFE_RULES]

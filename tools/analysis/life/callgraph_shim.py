@@ -1,0 +1,20 @@
+"""qrlife's import surface over qrflow's call graph.
+
+qrlife deliberately reuses qrflow's interprocedural machinery instead of
+growing a second call-graph implementation; this shim pins exactly which
+pieces the lifetime analyses depend on (and re-exports the two private
+walkers so the dependency is explicit rather than scattered
+``from ..flow.callgraph import _x`` lines).
+"""
+
+from __future__ import annotations
+
+from ..flow.callgraph import (CallGraph, CallSite, ClassInfo, FunctionInfo,
+                              ModuleInfo, build_callgraph)
+from ..flow.callgraph import _own_statements as own_statements
+from ..flow.callgraph import _walk_functions as walk_functions
+
+__all__ = [
+    "CallGraph", "CallSite", "ClassInfo", "FunctionInfo", "ModuleInfo",
+    "build_callgraph", "own_statements", "walk_functions",
+]

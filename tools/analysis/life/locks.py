@@ -1,0 +1,592 @@
+"""Lock-discipline analysis: registry, order graph, hold-site rules.
+
+Three questions, answered project-wide over qrflow's call graph:
+
+1. **Ordering** — every time a lock is acquired while another is held,
+   that is an edge in the project lock-order graph.  Interprocedural:
+   a call made under a held lock contributes edges to every lock the
+   callee may transitively acquire (``call``/``await`` edges only —
+   ``thread``/``task``/``executor`` edges run in a context that does
+   NOT inherit the caller's held set).  A cycle in the graph is a
+   potential deadlock (``life-lock-cycle``).
+2. **Hold hygiene** — an ``await`` (or a known blocking call in a
+   loop-domain function) while a *threading* lock is held stalls every
+   other thread contending for it for an unbounded suspension
+   (``life-await-under-lock``).  asyncio locks are await-shaped by
+   design and are exempt.
+3. **Release pairing** — a bare ``.acquire()`` whose ``.release()`` is
+   not guaranteed on exception paths (``finally`` is the proof; the
+   ``with`` statement is the better fix) is ``life-unreleased-lock``.
+   ``__enter__``/``__exit__`` pairs and acquire/release wrapper methods
+   are exempt — they ARE the context-manager implementation.
+
+Lock identity is ``(owner, attribute)`` resolved through the call
+graph's type machinery: ``self._lock`` keys as ``Owner._lock``,
+``shard._lock`` resolves ``shard``'s inferred class, module-level locks
+key as ``module.py::NAME``, function-local locks as the defining
+function's qualname.  Unresolvable receivers are *skipped*, never
+guessed — ``_lock`` is owned by many classes and a wrong guess would
+invent cycles that do not exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from ..engine import FileContext, dotted_name, last_attr
+from .callgraph_shim import CallGraph, FunctionInfo, ModuleInfo, walk_functions
+
+#: constructor leaf -> lock kind (threading flavours block the OS thread;
+#: asyncio flavours suspend the task and are await-safe)
+_THREADING_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+                    "Semaphore": "semaphore", "BoundedSemaphore": "semaphore"}
+_ASYNC_CTORS = {"Lock": "async-lock", "Condition": "async-condition",
+                "Semaphore": "async-semaphore",
+                "BoundedSemaphore": "async-semaphore"}
+
+#: kinds whose holders block an OS thread (await/blocking-call hazard)
+THREADING_KINDS = frozenset({"lock", "rlock", "condition", "semaphore"})
+
+#: kinds that participate in the order graph (semaphores are counters —
+#: ordering between them is a throughput question, not a deadlock one)
+ORDERED_KINDS = frozenset({"lock", "rlock", "condition",
+                           "async-lock", "async-condition"})
+
+#: calls that block the calling thread: flagged under a threading lock in
+#: async/loop-domain code alongside ``await`` itself
+_BLOCKING_DOTTED = {"time.sleep"}
+_BLOCKING_LEAVES = {"recv", "recv_into", "accept", "connect", "sendall"}
+
+#: method names that exempt a function from release-pairing checks — the
+#: function IS the lock wrapper / context-manager implementation
+_WRAPPER_NAMES = ("__enter__", "__exit__", "__aenter__", "__aexit__")
+
+
+@dataclasses.dataclass
+class LockDef:
+    key: str            # stable identity: Owner.attr | mod.py::NAME | qualname::name
+    kind: str           # lock | rlock | condition | semaphore | async-*
+    ctx: FileContext
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class LockRef:
+    """One resolved use of a lock at an acquisition site."""
+    key: str
+    kind: str
+    via_self: bool      # acquired through ``self.<attr>``
+    owner_class: str | None = None
+
+
+@dataclasses.dataclass
+class OrderEdge:
+    src: str
+    dst: str
+    node: ast.AST       # the inner acquisition (or the call that reaches it)
+    fn: FunctionInfo
+    via: str = ""       # callee qualname for interprocedural edges
+    src_self: bool = False
+    dst_self: bool = False
+
+
+@dataclasses.dataclass
+class Hazard:
+    rule: str
+    fn: FunctionInfo
+    node: ast.AST
+    message: str
+
+
+def _ctor_kind(call: ast.Call, mod: ModuleInfo) -> str | None:
+    dotted = dotted_name(call.func) or ""
+    leaf = last_attr(call.func) or ""
+    if dotted.startswith("asyncio."):
+        return _ASYNC_CTORS.get(leaf)
+    if dotted.startswith(("threading.", "multiprocessing.")):
+        return _THREADING_CTORS.get(leaf)
+    if leaf in _THREADING_CTORS and leaf == dotted:  # bare name: check imports
+        suffix, _orig = mod.imports.get(leaf, ("", None))
+        if suffix == "asyncio":
+            return _ASYNC_CTORS.get(leaf)
+        return _THREADING_CTORS.get(leaf)
+    return None
+
+
+def _field_factory_kind(call: ast.Call, mod: ModuleInfo) -> str | None:
+    """``field(default_factory=threading.Lock)`` in a dataclass body."""
+    if (last_attr(call.func) or "") != "field":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "default_factory":
+            fake = ast.Call(func=kw.value, args=[], keywords=[])
+            return _ctor_kind(fake, mod)
+    return None
+
+
+class LockRegistry:
+    """Every lock the project constructs, keyed by stable identity."""
+
+    def __init__(self) -> None:
+        self.defs: dict[str, LockDef] = {}
+        self.class_attrs: dict[tuple[str, str], str] = {}   # (cls, attr) -> key
+        self.module_level: dict[tuple[str, str], str] = {}  # (path, name) -> key
+        self.fn_locals: dict[tuple[str, str], str] = {}     # (fid, name) -> key
+
+    def _add(self, key: str, kind: str, ctx: FileContext, node: ast.AST) -> None:
+        self.defs.setdefault(key, LockDef(key, kind, ctx, node))
+
+    def build(self, cg: CallGraph) -> None:
+        for mod in cg.modules.values():
+            short = mod.path.rsplit("/", 1)[-1]
+            for stmt in mod.ctx.tree.body:
+                targets, value = _assign_parts(stmt)
+                if value is None or not isinstance(value, ast.Call):
+                    continue
+                kind = _ctor_kind(value, mod)
+                if kind is None:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        key = f"{short}::{t.id}"
+                        self._add(key, kind, mod.ctx, stmt)
+                        self.module_level[(mod.path, t.id)] = key
+            for cls in mod.classes.values():
+                for stmt in cls.node.body:
+                    targets, value = _assign_parts(stmt)
+                    if value is None or not isinstance(value, ast.Call):
+                        continue
+                    kind = _ctor_kind(value, mod) or _field_factory_kind(value, mod)
+                    if kind is None:
+                        continue
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            key = f"{cls.name}.{t.id}"
+                            self._add(key, kind, mod.ctx, stmt)
+                            self.class_attrs[(cls.name, t.id)] = key
+            for fn in walk_functions(mod):
+                for stmt in _own_statements(fn):
+                    targets, value = _assign_parts(stmt)
+                    if value is None or not isinstance(value, ast.Call):
+                        continue
+                    kind = _ctor_kind(value, mod)
+                    if kind is None:
+                        continue
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self" and fn.class_name):
+                            key = f"{fn.class_name}.{t.attr}"
+                            self._add(key, kind, mod.ctx, stmt)
+                            self.class_attrs[(fn.class_name, t.attr)] = key
+                        elif isinstance(t, ast.Name):
+                            key = f"{fn.qualname}::{t.id}"
+                            self._add(key, kind, mod.ctx, stmt)
+                            self.fn_locals[(fn.fid, t.id)] = key
+
+    # -- use-site resolution ------------------------------------------------
+
+    def _class_attr_key(self, cg: CallGraph, cls: str, attr: str) -> str | None:
+        seen: set[str] = set()
+        queue = [cls]
+        while queue:
+            name = queue.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            key = self.class_attrs.get((name, attr))
+            if key is not None:
+                return key
+            info = cg.classes.get(name)
+            if info is not None:
+                queue.extend(info.bases)
+        return None
+
+    def resolve(self, expr: ast.AST, fn: FunctionInfo, cg: CallGraph,
+                local_types: dict[str, set[str]]) -> list[LockRef]:
+        """Resolve a lock expression to registry identities (maybe several
+        when the receiver's inferred type set is ambiguous; empty when the
+        receiver cannot be typed — never guessed)."""
+        if isinstance(expr, ast.Name):
+            scope: FunctionInfo | None = fn
+            while scope is not None:
+                key = self.fn_locals.get((scope.fid, expr.id))
+                if key is not None:
+                    return [_ref(self.defs[key])]
+                scope = scope.parent
+            key = self.module_level.get((fn.path, expr.id))
+            if key is not None:
+                return [_ref(self.defs[key])]
+            return []
+        if not isinstance(expr, ast.Attribute):
+            return []
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and fn.class_name:
+            key = self._class_attr_key(cg, fn.class_name, expr.attr)
+            if key is not None:
+                return [_ref(self.defs[key], via_self=True,
+                             owner_class=fn.class_name)]
+            return []
+        if isinstance(recv, ast.Name):
+            types = set(cg._lookup_types(recv.id, fn, local_types))
+            if not types:
+                types = _annotated_types(recv.id, fn, cg)
+            out = []
+            for cls in sorted(types):
+                key = self._class_attr_key(cg, cls, expr.attr)
+                if key is not None:
+                    out.append(_ref(self.defs[key]))
+            return out
+        if (isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and fn.class_name):
+            types = cg.class_attr_types.get(fn.class_name, {}).get(recv.attr, set())
+            out = []
+            for cls in sorted(types):
+                key = self._class_attr_key(cg, cls, expr.attr)
+                if key is not None:
+                    out.append(_ref(self.defs[key]))
+            return out
+        return []
+
+
+def _ref(d: LockDef, via_self: bool = False,
+         owner_class: str | None = None) -> LockRef:
+    return LockRef(d.key, d.kind, via_self, owner_class)
+
+
+def _annotated_types(name: str, fn: FunctionInfo, cg: CallGraph) -> set[str]:
+    """Parameter / AnnAssign annotations naming a known class — the one
+    typing source the flow-insensitive local inference does not read."""
+    def ann_leaf(ann: ast.AST) -> str | None:
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            # string annotation: take the trailing identifier
+            tail = ann.value.strip().strip('"\'').split("|")[0].strip()
+            return tail.split("[")[0].split(".")[-1] or None
+        if isinstance(ann, ast.Subscript):   # Optional[X] / list[X]: unwrap
+            return ann_leaf(ann.slice)
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return ann_leaf(ann.left)        # X | None
+        return last_attr(ann)
+
+    args = getattr(fn.node, "args", None)
+    out: set[str] = set()
+    if args is not None:
+        for arg in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+            if arg.arg == name and arg.annotation is not None:
+                leaf = ann_leaf(arg.annotation)
+                if leaf and leaf in cg.classes:
+                    out.add(leaf)
+    for stmt in _own_statements(fn):
+        if (isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name)
+                and stmt.target.id == name and stmt.annotation is not None):
+            leaf = ann_leaf(stmt.annotation)
+            if leaf and leaf in cg.classes:
+                out.add(leaf)
+    return out
+
+
+def _assign_parts(stmt: ast.stmt) -> tuple[list[ast.AST], ast.AST | None]:
+    if isinstance(stmt, ast.Assign):
+        return list(stmt.targets), stmt.value
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        return [stmt.target], stmt.value
+    return [], None
+
+
+def _own_statements(fn: FunctionInfo):
+    def walk(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield stmt
+            for field in ("body", "orelse", "finalbody"):
+                yield from walk(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from walk(handler.body)
+    yield from walk(getattr(fn.node, "body", []))
+
+
+def _stmt_can_raise(stmt: ast.stmt) -> bool:
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Call, ast.Await, ast.Raise, ast.Assert)):
+            return True
+    return False
+
+
+class LockAnalysis:
+    """Per-function walks + interprocedural order graph + hazards."""
+
+    def __init__(self, cg: CallGraph, domains: dict[str, set[str]]):
+        self.cg = cg
+        self.domains = domains
+        self.registry = LockRegistry()
+        self.registry.build(cg)
+        self.edges: list[OrderEdge] = []
+        self.hazards: list[Hazard] = []
+        #: fid -> keys the function acquires directly (for interprocedural
+        #: may-acquire propagation), with via-self class tags
+        self.direct: dict[str, set[tuple[str, str | None]]] = {}
+        #: (call node id) -> held refs at that call
+        self._calls_under: list[tuple[ast.Call | ast.Await, tuple[LockRef, ...],
+                                      FunctionInfo]] = []
+        for mod in cg.modules.values():
+            for fn in walk_functions(mod):
+                self._walk_fn(fn, mod)
+        self._interprocedural()
+
+    # -- per-function -------------------------------------------------------
+
+    def _walk_fn(self, fn: FunctionInfo, mod: ModuleInfo) -> None:
+        local_types = self.cg._local_types_of(fn, mod)
+        acquired = self.direct.setdefault(fn.fid, set())
+        on_loop = fn.is_async or "loop" in self.domains.get(fn.fid, set())
+
+        def resolve(expr: ast.AST) -> list[LockRef]:
+            return self.registry.resolve(expr, fn, self.cg, local_types)
+
+        def note_acquire(refs: list[LockRef], node: ast.AST,
+                         held: tuple[LockRef, ...]) -> None:
+            for ref in refs:
+                acquired.add((ref.key, ref.owner_class if ref.via_self else None))
+                for h in held:
+                    if h.key == ref.key and not (h.via_self and ref.via_self
+                                                 and h.kind == "lock"):
+                        continue  # self-edge only for non-reentrant self locks
+                    if (h.kind in ORDERED_KINDS and ref.kind in ORDERED_KINDS):
+                        self.edges.append(OrderEdge(
+                            h.key, ref.key, node, fn,
+                            src_self=h.via_self, dst_self=ref.via_self))
+
+        def visit_block(stmts: list[ast.stmt], held: tuple[LockRef, ...]) -> None:
+            for stmt in stmts:
+                visit_stmt(stmt, held)
+
+        def visit_stmt(stmt: ast.stmt, held: tuple[LockRef, ...]) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # nested defs run later, not under this held set
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new = list(held)
+                for item in stmt.items:
+                    ce = item.context_expr
+                    expr = ce
+                    if isinstance(ce, ast.Call) and (last_attr(ce.func) or "") in (
+                            "acquire", "acquire_lock"):
+                        expr = ce.func.value if isinstance(ce.func, ast.Attribute) else ce
+                    refs = resolve(expr)
+                    if refs:
+                        note_acquire(refs, stmt, tuple(new))
+                        new.extend(refs)
+                    else:
+                        self._scan_expr(ce, fn, tuple(new), on_loop)
+                visit_block(stmt.body, tuple(new))
+                return
+            for expr in _stmt_exprs(stmt):
+                self._scan_expr(expr, fn, held, on_loop)
+            if isinstance(stmt, ast.Expr) and _is_acquire_call(stmt.value):
+                call = _strip_await(stmt.value)
+                recv = call.func.value  # type: ignore[union-attr]
+                refs = resolve(recv)
+                if refs:
+                    note_acquire(refs, stmt, held)
+                    held = tuple([*held, *refs])
+            for field in ("body", "orelse", "finalbody"):
+                visit_block(getattr(stmt, field, []) or [], held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                visit_block(handler.body, held)
+
+        visit_block(getattr(fn.node, "body", []), ())
+        self._check_release_pairing(fn, resolve)
+
+    def _scan_expr(self, expr: ast.AST, fn: FunctionInfo,
+                   held: tuple[LockRef, ...], on_loop: bool) -> None:
+        """Record await/blocking hazards and calls made under held locks."""
+        threading_held = [h for h in held if h.kind in THREADING_KINDS]
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Await):
+                if threading_held:
+                    self.hazards.append(Hazard(
+                        "life-await-under-lock", fn, node,
+                        f"await while holding threading lock "
+                        f"{threading_held[0].key}: every thread contending "
+                        "for it blocks for the whole suspension — release "
+                        "before awaiting, or use asyncio.Lock"))
+                if held:
+                    self._calls_under.append((node, held, fn))
+            elif isinstance(node, ast.Call):
+                dotted = dotted_name(node.func) or ""
+                leaf = last_attr(node.func) or ""
+                if threading_held and on_loop and (
+                        dotted in _BLOCKING_DOTTED
+                        or (leaf in _BLOCKING_LEAVES
+                            and isinstance(node.func, ast.Attribute))):
+                    self.hazards.append(Hazard(
+                        "life-await-under-lock", fn, node,
+                        f"blocking call {dotted or leaf}() while holding "
+                        f"threading lock {threading_held[0].key} in "
+                        "event-loop code — the loop and every lock waiter "
+                        "stall together"))
+                if held:
+                    self._calls_under.append((node, held, fn))
+
+    # -- release pairing ----------------------------------------------------
+
+    def _check_release_pairing(self, fn: FunctionInfo, resolve) -> None:
+        name = fn.name
+        if name in _WRAPPER_NAMES or any(
+                w in name for w in ("acquire", "release", "lock", "unlock")):
+            return
+
+        def releases_in(stmts: list[ast.stmt], key: str) -> bool:
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ("release", "release_lock")):
+                        for ref in resolve(node.func.value):
+                            if ref.key == key:
+                                return True
+            return False
+
+        def enclosing_finally_releases(stack: list[ast.stmt], key: str) -> bool:
+            return any(isinstance(s, ast.Try) and releases_in(s.finalbody, key)
+                       for s in stack)
+
+        def visit(stmts: list[ast.stmt], stack: list[ast.stmt]) -> None:
+            for i, stmt in enumerate(stmts):
+                if isinstance(stmt, ast.Expr) and _is_acquire_call(stmt.value):
+                    call = _strip_await(stmt.value)
+                    recv = call.func.value  # type: ignore[union-attr]
+                    for ref in resolve(recv):
+                        if ref.kind not in THREADING_KINDS | {
+                                "async-lock", "async-semaphore",
+                                "async-condition"}:
+                            continue
+                        if enclosing_finally_releases(stack, ref.key):
+                            continue
+                        nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                        if isinstance(nxt, ast.Try) and releases_in(
+                                nxt.finalbody, ref.key):
+                            continue
+                        rest = stmts[i + 1:]
+                        released_later = releases_in(rest, ref.key)
+                        risky = any(_stmt_can_raise(s) for s in rest
+                                    if not releases_in([s], ref.key))
+                        if released_later and not risky:
+                            continue
+                        if released_later:
+                            msg = (f"{ref.key}.acquire() is released later in "
+                                   "this block, but an exception in between "
+                                   "skips the release — move release() into "
+                                   "a finally, or use `with`")
+                        else:
+                            msg = (f"{ref.key}.acquire() has no matching "
+                                   "release() on this function's exception "
+                                   "paths — use `with` or try/finally")
+                        self.hazards.append(Hazard(
+                            "life-unreleased-lock", fn, stmt, msg))
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, []) or []
+                    if sub:
+                        visit(sub, stack + [stmt])
+                for handler in getattr(stmt, "handlers", []) or []:
+                    visit(handler.body, stack + [stmt])
+
+        visit(getattr(fn.node, "body", []), [])
+
+    # -- interprocedural ----------------------------------------------------
+
+    def _interprocedural(self) -> None:
+        may: dict[str, set[tuple[str, str | None]]] = {
+            fid: set(keys) for fid, keys in self.direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for site in self.cg.edges:
+                if site.kind not in ("call", "await"):
+                    continue
+                src = may.setdefault(site.caller.fid, set())
+                add = {(k, None) for (k, _cls) in may.get(site.callee.fid, ())}
+                if not add <= src:
+                    src |= add
+                    changed = True
+        for node, held, fn in self._calls_under:
+            for site in self.cg.edges_at.get(id(node), []):
+                if site.kind not in ("call", "await"):
+                    continue
+                callee = site.callee
+                for (key, _cls) in may.get(callee.fid, ()):
+                    kind = self.registry.defs[key].kind
+                    if kind not in ORDERED_KINDS:
+                        continue
+                    for h in held:
+                        if h.kind not in ORDERED_KINDS:
+                            continue
+                        if h.key == key:
+                            # interprocedural self-deadlock: only claimed for
+                            # a non-reentrant lock reached via a direct
+                            # self-call within the same class
+                            direct = (key, fn.class_name) in self.direct.get(
+                                callee.fid, set())
+                            if not (kind == "lock" and h.via_self and direct
+                                    and callee.class_name == fn.class_name):
+                                continue
+                        self.edges.append(OrderEdge(
+                            h.key, key, node, fn, via=callee.qualname,
+                            src_self=h.via_self))
+
+    # -- cycles -------------------------------------------------------------
+
+    def cycles(self) -> list[list[OrderEdge]]:
+        adj: dict[str, dict[str, OrderEdge]] = {}
+        for e in self.edges:
+            adj.setdefault(e.src, {}).setdefault(e.dst, e)
+        out: list[list[OrderEdge]] = []
+        seen_cycles: set[tuple[str, ...]] = set()
+
+        def dfs(start: str, node: str, path: list[OrderEdge],
+                on_path: set[str]) -> None:
+            for dst in sorted(adj.get(node, ())):
+                edge = adj[node][dst]
+                if dst == start:
+                    cyc = path + [edge]
+                    keys = [e.src for e in cyc]
+                    canon = tuple(sorted(keys))
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        out.append(cyc)
+                elif dst not in on_path and dst > start:
+                    # only walk "later" nodes so each cycle is found once,
+                    # rooted at its smallest key
+                    dfs(start, dst, path + [edge], on_path | {dst})
+
+        for start in sorted(adj):
+            dfs(start, start, [], {start})
+        return out
+
+
+def _stmt_exprs(stmt: ast.stmt):
+    """Expressions evaluated by this statement itself (not child blocks)."""
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+
+
+def _strip_await(expr: ast.AST) -> ast.AST:
+    return expr.value if isinstance(expr, ast.Await) else expr
+
+
+def _is_acquire_call(expr: ast.AST) -> bool:
+    call = _strip_await(expr)
+    return (isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("acquire", "acquire_lock"))

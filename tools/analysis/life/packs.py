@@ -1,0 +1,224 @@
+"""qrlife analysis packs, exposed as qrlint ``Rule`` objects.
+
+One :class:`LifeAnalysis` is computed per project run (call graph ->
+lock registry/order graph -> resource path scan -> wipe-completeness
+walk) and cached on the ``Project``; the thin rule classes below each
+publish their own finding id from it, so ``--select``/``--ignore`` and
+the inline ``# qrlife: disable=`` suppression machinery work unchanged.
+
+Rule ids:
+
+=========================  ================================================
+life-lock-cycle            cycle in the project lock-acquisition order
+                           graph (potential deadlock)
+life-await-under-lock      threading lock held across an ``await`` or a
+                           blocking call in event-loop code
+life-unreleased-lock       bare ``acquire()`` whose release an exception
+                           path can skip
+life-leak-on-raise         resource acquisition (subprocess, socket/
+                           StreamWriter, executor, telemetry server,
+                           tempdir, task) whose release is not proven on
+                           exception edges
+life-double-release        the same release verb on the same receiver
+                           twice, unconditionally, in one block
+life-wipe-gap              a SECRET-source local misses _wipe()/zeroize()
+                           on an explicit exit path
+life-unjustified-suppression  a qrlife suppression with no justification
+=========================  ================================================
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..engine import FileContext, Project, Rule
+from ..flow.domains import infer_domains
+from .callgraph_shim import build_callgraph
+from .locks import LockAnalysis
+from .resources import run_resources
+from .wipes import run_wipes
+
+# every prefix: the engine accepts `# qrlint: disable=…` (and the other
+# analyzers' spellings) too, so a life rule suppressed through THOSE
+# prefixes must be policed all the same
+_SUPPRESS_RE = re.compile(
+    r"#\s*(?:qrlint|qrkernel|qrproto|qrlife):\s*disable(?:-file)?\s*=\s*"
+    r"(?P<rules>[\w.,\- ]+)(?P<rest>.*)$")
+
+
+class LifeAnalysis:
+    """All qrlife findings for one project, computed once and cached."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.cg = build_callgraph(project)
+        self.domains = infer_domains(self.cg)
+        self.findings: list[tuple[str, FileContext, object, str]] = []
+        self._run_locks()
+        self._run_resources()
+        self._run_wipes()
+
+    @classmethod
+    def of(cls, project: Project) -> "LifeAnalysis":
+        cached = getattr(project, "_qrlife_analysis", None)
+        if cached is None:
+            cached = cls(project)
+            project._qrlife_analysis = cached  # type: ignore[attr-defined]
+        return cached
+
+    def _add(self, rule_id: str, ctx: FileContext, node, message: str) -> None:
+        self.findings.append((rule_id, ctx, node, message))
+
+    def _run_locks(self) -> None:
+        locks = LockAnalysis(self.cg, self.domains)
+        self.locks = locks
+        for cyc in locks.cycles():
+            rep = min(cyc, key=lambda e: (e.fn.path, getattr(e.node, "lineno", 0)))
+            parts = [cyc[0].src]
+            for e in cyc:
+                parts.append(
+                    f"{e.dst} ({e.fn.qualname}"
+                    f"{' via ' + e.via if e.via else ''})")
+            path = " -> ".join(parts)
+            self._add(
+                "life-lock-cycle", rep.fn.ctx, rep.node,
+                f"lock-order cycle (potential deadlock): {path}; pick one "
+                "global acquisition order and release before crossing it")
+        seen: set[tuple[str, str, int]] = set()
+        for hz in locks.hazards:
+            key = (hz.rule, hz.fn.path, getattr(hz.node, "lineno", 0))
+            if key in seen:
+                continue
+            seen.add(key)
+            self._add(hz.rule, hz.fn.ctx, hz.node,
+                      f"{hz.message} [in {hz.fn.qualname}]")
+
+    def _run_resources(self) -> None:
+        seen: set[tuple[str, str, int]] = set()
+        for leak in run_resources(self.cg):
+            key = (leak.rule, leak.fn.path, getattr(leak.node, "lineno", 0))
+            if key in seen:
+                continue
+            seen.add(key)
+            self._add(leak.rule, leak.fn.ctx, leak.node,
+                      f"{leak.message} [in {leak.fn.qualname}]")
+
+    def _run_wipes(self) -> None:
+        seen: set[tuple[str, int]] = set()
+        for gap in run_wipes(self.cg):
+            key = (gap.fn.path, getattr(gap.node, "lineno", 0))
+            if key in seen:
+                continue
+            seen.add(key)
+            self._add("life-wipe-gap", gap.fn.ctx, gap.node, gap.message)
+
+
+class _LifeRule(Rule):
+    """Base: publish one finding id out of the shared analysis."""
+
+    severity = "error"
+
+    def check_project(self, project: Project) -> None:
+        analysis = LifeAnalysis.of(project)
+        for rule_id, ctx, node, message in analysis.findings:
+            if rule_id == self.id:
+                project.report(self, ctx, node, message)
+
+
+class LockCycleRule(_LifeRule):
+    id = "life-lock-cycle"
+    description = ("cycle in the project-wide lock-acquisition order graph "
+                   "(interprocedural, via the qrflow call graph) — a "
+                   "potential deadlock between two execution contexts")
+
+
+class AwaitUnderLockRule(_LifeRule):
+    id = "life-await-under-lock"
+    description = ("threading lock held across an await or a blocking call "
+                   "(time.sleep / socket ops) in event-loop code: every "
+                   "contending thread stalls for the whole suspension")
+
+
+class UnreleasedLockRule(_LifeRule):
+    id = "life-unreleased-lock"
+    description = ("bare acquire() whose matching release() an exception "
+                   "path can skip — use `with` or move release into finally")
+
+
+class LeakOnRaiseRule(_LifeRule):
+    id = "life-leak-on-raise"
+    description = ("resource acquisition (subprocess / socket / StreamWriter "
+                   "/ executor / telemetry server / tempdir / task) whose "
+                   "release is not postdominated by exception edges: finally, "
+                   "context manager, done-callback, or ownership transfer "
+                   "are the accepted proofs")
+
+
+class DoubleReleaseRule(_LifeRule):
+    id = "life-double-release"
+    description = ("same release verb on the same receiver twice, "
+                   "unconditionally, in one straight-line block")
+
+
+class WipeGapRule(_LifeRule):
+    id = "life-wipe-gap"
+    description = ("a local bound from a SECRET taint source (qrflow's "
+                   "lattice) misses _wipe()/zeroize() on an explicit exit "
+                   "path and never escapes ownership")
+
+
+class UnjustifiedLifeSuppressionRule(Rule):
+    """Suppressing a qrlife finding requires a one-line justification after
+    the rule ids — the same convention every other analyzer enforces."""
+
+    id = "life-unjustified-suppression"
+    severity = "error"
+    description = ("a qrlife suppression comment carries no one-line "
+                   "justification after the rule id(s)")
+
+    _POLICED: frozenset[str] = frozenset({
+        "life-lock-cycle", "life-await-under-lock", "life-unreleased-lock",
+        "life-leak-on-raise", "life-double-release", "life-wipe-gap",
+        "life-unjustified-suppression",
+    })
+
+    def check_project(self, project: Project) -> None:
+        for ctx in project.contexts.values():
+            for lineno, line in enumerate(ctx.lines, start=1):
+                m = _SUPPRESS_RE.search(line)
+                if not m:
+                    continue
+                blob = m.group("rules")
+                rest = m.group("rest") or ""
+                sep = re.search(r"[^\w,\- ]", blob)
+                ids_part = blob[: sep.start()] if sep else blob
+                justification = (blob[sep.start():] if sep else "") + rest
+                ids = {tok for part in ids_part.split(",")
+                       for tok in part.strip().split() if tok}
+                life_ids = ids & self._POLICED
+                if life_ids and not re.search(r"\w", justification):
+                    node = _LineNode(lineno)
+                    project.report(
+                        self, ctx, node,
+                        f"suppression of {', '.join(sorted(life_ids))} has no "
+                        "justification — append one after the rule id "
+                        "(e.g. `# qrlife: disable=life-leak-on-raise — "
+                        "proc stored by caller on the next line`)",
+                    )
+
+
+class _LineNode:
+    """Minimal AST-node stand-in so line-anchored findings route through
+    the normal report/suppression machinery."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.end_lineno = lineno
+        self.col_offset = 0
+
+
+LIFE_RULES = (
+    LockCycleRule, AwaitUnderLockRule, UnreleasedLockRule,
+    LeakOnRaiseRule, DoubleReleaseRule, WipeGapRule,
+    UnjustifiedLifeSuppressionRule,
+)

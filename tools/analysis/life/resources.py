@@ -1,0 +1,433 @@
+"""Resource-lifetime analysis: acquire/release pairing on exception paths.
+
+Tracks the resources the fleet actually leaks — subprocess spawns,
+sockets/StreamWriters, thread pools, telemetry servers, tempdirs,
+asyncio tasks — from the statement that binds them to a local through
+the rest of the enclosing function.  A resource obligation is
+*discharged* by one of the blessed proofs:
+
+* acquired under ``with``/``async with`` (never tracked at all);
+* a release verb for its kind (``close``/``terminate``/``shutdown``/
+  ``cleanup``/``cancel``/…), anywhere downstream — a conditional
+  release counts: one branch releasing is evidence of deliberate
+  conditional ownership, and guessing the condition would only invent
+  false positives;
+* a ``finally`` that releases it (everything inside the ``try`` is then
+  proven, which is exactly why the idiom is blessed);
+* ``add_done_callback``/``await task``/``gather(...)`` for tasks;
+* **escape** — returned, yielded, stored into an attribute, container,
+  or registry, or handed to a method of another object.  Ownership
+  moved; the new owner's lifecycle is its own analysis problem
+  (qrlint's zeroize/teardown rules police attributes).
+
+Between acquisition and discharge, any statement that can raise (a
+call, an ``await`` — CancelledError needs no reason — an explicit
+``raise``) makes the leak reachable: ``life-leak-on-raise`` fires at
+the acquisition with the first unprotected raise site named.
+
+``life-double-release`` is the narrow dual: the same release verb on
+the same receiver twice, unconditionally, in one straight-line block —
+dead code at best (idempotent ``close``) and a crash at worst
+(``lock.release()``, ``os.close``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from ..engine import dotted_name, last_attr
+from .callgraph_shim import CallGraph, FunctionInfo, ModuleInfo, walk_functions
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    kind: str
+    releases: frozenset[str]
+    tuple_index: int | None = None   # which unpack element carries the resource
+
+
+def _spec(kind: str, *releases: str, tuple_index: int | None = None) -> ResourceSpec:
+    return ResourceSpec(kind, frozenset(releases), tuple_index)
+
+
+#: acquisition call leaf -> what was acquired and how it is released
+RESOURCES: dict[str, ResourceSpec] = {
+    "open_connection": _spec("stream-writer", "close", "abort", tuple_index=1),
+    "start_server": _spec("server", "close"),
+    "start_unix_server": _spec("server", "close"),
+    "create_subprocess_exec": _spec("subprocess", "terminate", "kill", "wait",
+                                    "communicate"),
+    "create_subprocess_shell": _spec("subprocess", "terminate", "kill", "wait",
+                                     "communicate"),
+    "Popen": _spec("subprocess", "terminate", "kill", "wait", "communicate"),
+    "ThreadPoolExecutor": _spec("executor", "shutdown"),
+    "ProcessPoolExecutor": _spec("executor", "shutdown"),
+    "TelemetryServer": _spec("telemetry-server", "stop", "close", "shutdown"),
+    "mkdtemp": _spec("tempdir", "cleanup", "rmtree"),
+    "TemporaryDirectory": _spec("tempdir", "cleanup"),
+    "NamedTemporaryFile": _spec("tempfile", "close"),
+    "create_task": _spec("task", "cancel"),
+    "ensure_future": _spec("task", "cancel"),
+    "socket": _spec("socket", "close", "detach", "shutdown"),
+    "create_connection": _spec("socket", "close", "detach", "shutdown"),
+}
+
+#: leaves that must carry a dotted prefix to count (``socket.socket``) —
+#: a bare name with these leaves is too ambiguous to claim
+_NEED_PREFIX = {"socket": ("socket.socket",),
+                "create_connection": ("socket.create_connection",)}
+
+#: calls that take ownership of a task passed as an argument
+_TASK_SINKS = {"gather", "wait", "wait_for", "as_completed", "shield"}
+
+#: release verbs for the straight-line double-release check
+_DOUBLE_VERBS = {"close", "cancel", "shutdown", "terminate", "kill",
+                 "cleanup", "stop", "release", "abort"}
+
+#: calls that never raise in practice — don't make a leak reachable
+_SAFE_DOTTED = {"time.monotonic", "time.time", "time.perf_counter",
+                "asyncio.Lock", "asyncio.Event", "asyncio.Queue",
+                "asyncio.Semaphore", "threading.Lock", "threading.Event",
+                "threading.RLock"}
+_SAFE_LEAVES = {"create_task", "ensure_future", "set", "list", "dict",
+                "tuple", "frozenset", "len", "min", "max", "sorted", "sum",
+                "int", "float", "str", "bool"}
+_SAFE_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                     "critical", "log"}
+
+_DISCHARGED, _LEAK, _FALLTHROUGH = "discharged", "leak", "fallthrough"
+
+
+@dataclasses.dataclass
+class Leak:
+    rule: str
+    fn: FunctionInfo
+    node: ast.AST
+    message: str
+
+
+def _unwrap_value(expr: ast.AST) -> ast.AST:
+    """Peel ``await`` and ``wait_for``/``shield`` wrappers off an
+    acquisition expression."""
+    if isinstance(expr, ast.Await):
+        expr = expr.value
+    if (isinstance(expr, ast.Call)
+            and (last_attr(expr.func) or "") in ("wait_for", "shield")
+            and expr.args):
+        inner = expr.args[0]
+        if isinstance(inner, ast.Call):
+            return inner
+    return expr
+
+
+def _assign_target_value(stmt: ast.stmt) -> tuple[ast.AST, ast.AST] | None:
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        return stmt.targets[0], stmt.value
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        return stmt.target, stmt.value
+    return None
+
+
+def _acquisition(stmt: ast.stmt) -> tuple[str, ResourceSpec, ast.stmt] | None:
+    """``name = <resource ctor>`` (or tuple-unpack thereof) -> obligation."""
+    parts = _assign_target_value(stmt)
+    if parts is None:
+        return None
+    target, raw = parts
+    value = _unwrap_value(raw)
+    if not isinstance(value, ast.Call):
+        return None
+    leaf = last_attr(value.func) or ""
+    spec = RESOURCES.get(leaf)
+    if spec is None:
+        return None
+    dotted = dotted_name(value.func) or leaf
+    need = _NEED_PREFIX.get(leaf)
+    if need and dotted not in need:
+        return None
+    if spec.tuple_index is not None and isinstance(target, ast.Tuple):
+        if len(target.elts) > spec.tuple_index:
+            el = target.elts[spec.tuple_index]
+            if isinstance(el, ast.Name):
+                return el.id, spec, stmt
+        return None
+    if isinstance(target, ast.Name):
+        return target.id, spec, stmt
+    return None  # attribute/subscript target: escaped at birth
+
+
+def _is_module_alias(name: str, mod: ModuleInfo) -> bool:
+    entry = mod.imports.get(name)
+    return entry is not None
+
+
+class _Tracker:
+    """Follows one resource local through the rest of its function."""
+
+    def __init__(self, name: str, spec: ResourceSpec, mod: ModuleInfo):
+        self.name = name
+        self.spec = spec
+        self.mod = mod
+
+    # -- event classification ----------------------------------------------
+
+    def _releases(self, node: ast.AST) -> bool:
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == self.name
+                and node.func.attr in self.spec.releases):
+            return True
+        if self.spec.kind == "tempdir" and isinstance(node, ast.Call):
+            leaf = last_attr(node.func) or ""
+            if leaf == "rmtree" and any(
+                    isinstance(a, ast.Name) and a.id == self.name
+                    for a in node.args):
+                return True
+        return False
+
+    def _escapes(self, node: ast.AST) -> bool:
+        name = self.name
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            return any(isinstance(n, ast.Name) and n.id == name
+                       for n in ast.walk(node))
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            parts = _assign_target_value(node)
+            if parts is None:
+                return False
+            target, value = parts
+            holds = any(isinstance(n, ast.Name) and n.id == name
+                        and isinstance(n.ctx, ast.Load)
+                        for n in ast.walk(value))
+            return holds and not isinstance(target, ast.Name)
+        if isinstance(node, ast.Call):
+            in_args = any(
+                isinstance(n, ast.Name) and n.id == name
+                for a in [*node.args, *[kw.value for kw in node.keywords]]
+                for n in ast.walk(a))
+            if not in_args:
+                return False
+            leaf = last_attr(node.func) or ""
+            if self.spec.kind == "task" and leaf in _TASK_SINKS:
+                return True
+            # handed to a METHOD of some object (registry.add(w),
+            # self._track(proc), stack.enter_context(...)): ownership
+            # transfer.  A plain function using the resource is not.
+            if isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if isinstance(recv, ast.Name) and _is_module_alias(
+                        recv.id, self.mod):
+                    return False
+                if isinstance(recv, ast.Name) and recv.id == name:
+                    return False  # method on the resource itself is usage
+                return True
+        return False
+
+    def _task_discharge(self, node: ast.AST) -> bool:
+        if self.spec.kind != "task":
+            return False
+        if (isinstance(node, ast.Await) and isinstance(node.value, ast.Name)
+                and node.value.id == self.name):
+            return True
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == self.name
+                and node.func.attr == "add_done_callback")
+
+    def discharged_in(self, root: ast.AST) -> bool:
+        for node in ast.walk(root):
+            if (self._releases(node) or self._escapes(node)
+                    or self._task_discharge(node)):
+                return True
+            if isinstance(node, ast.Delete) and any(
+                    isinstance(t, ast.Name) and t.id == self.name
+                    for t in node.targets):
+                return True
+        return False
+
+    def reassigned_in(self, stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            parts = _assign_target_value(stmt)
+            if parts is not None:
+                target, _value = parts
+                if isinstance(target, ast.Name) and target.id == self.name:
+                    return True
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return (isinstance(stmt.target, ast.Name)
+                    and stmt.target.id == self.name)
+        return False
+
+    def can_raise(self, stmt: ast.stmt) -> ast.AST | None:
+        """First raise-capable node in a statement, with a small allowlist
+        of never-raising calls (logging, clock reads, task spawns — their
+        argument subtrees only build coroutine objects, they don't run)."""
+
+        def safe_call(node: ast.Call) -> bool:
+            dotted = dotted_name(node.func) or ""
+            if dotted in _SAFE_DOTTED:
+                return True
+            if (last_attr(node.func) or "") in _SAFE_LEAVES:
+                return True
+            return (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SAFE_LOG_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and "log" in node.func.value.id.lower())
+
+        def first(node: ast.AST) -> ast.AST | None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return None   # a def only binds a name; its body runs later
+            if isinstance(node, (ast.Raise, ast.Await)):
+                return node
+            if isinstance(node, ast.Call):
+                if safe_call(node):
+                    return None   # safe wrapper: its args never execute/raise
+                return node
+            for child in ast.iter_child_nodes(node):
+                got = first(child)
+                if got is not None:
+                    return got
+            return None
+
+        return first(stmt)
+
+
+def _child_blocks(stmt: ast.stmt):
+    for field in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, field, None)
+        if sub:
+            yield sub
+    for handler in getattr(stmt, "handlers", []) or []:
+        yield handler.body
+
+
+def scan_function(fn: FunctionInfo, mod: ModuleInfo, out: list[Leak]) -> None:
+    body = getattr(fn.node, "body", [])
+
+    def follow(tr: _Tracker, frames: list[tuple[list[ast.stmt], int]],
+               acq: ast.stmt) -> None:
+        for stmts, start in frames:
+            for stmt in stmts[start:]:
+                status = _step(tr, stmt)
+                if status == _DISCHARGED:
+                    return
+                if isinstance(status, tuple):       # (_LEAK, at-node)
+                    _, at = status
+                    line = getattr(at, "lineno", getattr(acq, "lineno", 0))
+                    out.append(Leak(
+                        "life-leak-on-raise", fn, acq,
+                        f"{tr.spec.kind} bound to `{tr.name}` can leak: "
+                        f"line {line} can raise before any release/escape "
+                        "— wrap the risky region in try/finally, use a "
+                        "context manager, or hand ownership off first"))
+                    return
+        # fell off the function with the obligation still live
+        out.append(Leak(
+            "life-leak-on-raise", fn, acq,
+            f"{tr.spec.kind} bound to `{tr.name}` is never released, "
+            "stored, or returned on any path through "
+            f"{fn.qualname}() — close it or transfer ownership"))
+
+    def _step(tr: _Tracker, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return _FALLTHROUGH   # nested def: not executed here
+        if tr.reassigned_in(stmt):
+            return _DISCHARGED    # rebound; the old value is out of scope
+        if isinstance(stmt, ast.Try):
+            if any(tr.discharged_in(s) for s in stmt.finalbody):
+                return _DISCHARGED
+            if tr.discharged_in(stmt):
+                return _DISCHARGED
+            at = tr.can_raise(stmt)
+            return (_LEAK, at) if at is not None else _FALLTHROUGH
+        if tr.discharged_in(stmt):
+            return _DISCHARGED
+        at = tr.can_raise(stmt)
+        if at is not None:
+            return (_LEAK, at)
+        return _FALLTHROUGH
+
+    def scan_block(stmts: list[ast.stmt],
+                   conts: list[tuple[list[ast.stmt], int]],
+                   finals: list[list[ast.stmt]]) -> None:
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            got = _acquisition(stmt)
+            if got is not None:
+                name, spec, node = got
+                tr = _Tracker(name, spec, mod)
+                # an enclosing finally that releases it is the blessed
+                # proof no matter where inside the try we are
+                if not any(tr.discharged_in(s)
+                           for final in finals for s in final):
+                    follow(tr, [(stmts, i + 1)] + conts, node)
+            if isinstance(stmt, ast.Try):
+                inner = finals + [stmt.finalbody] if stmt.finalbody else finals
+                for field in ("body", "orelse"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        scan_block(sub, [(stmts, i + 1)] + conts, inner)
+                for handler in stmt.handlers:
+                    scan_block(handler.body, [(stmts, i + 1)] + conts, inner)
+                if stmt.finalbody:
+                    scan_block(stmt.finalbody, [(stmts, i + 1)] + conts,
+                               finals)
+            else:
+                for block in _child_blocks(stmt):
+                    scan_block(block, [(stmts, i + 1)] + conts, finals)
+
+    scan_block(body, [], [])
+    _double_release(fn, out)
+
+
+def _double_release(fn: FunctionInfo, out: list[Leak]) -> None:
+    def recv_key(call: ast.Call) -> str | None:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = dotted_name(func.value)
+        return recv
+
+    def scan(stmts: list[ast.stmt]) -> None:
+        seen: dict[tuple[str, str], ast.stmt] = {}
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    tn = dotted_name(t)
+                    if tn:
+                        for key in [k for k in seen if k[0] == tn]:
+                            del seen[key]
+            if (isinstance(stmt, ast.Expr)):
+                call = stmt.value
+                if isinstance(call, ast.Await):
+                    call = call.value
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr in _DOUBLE_VERBS):
+                    recv = recv_key(call)
+                    if recv:
+                        key = (recv, call.func.attr)
+                        if key in seen:
+                            out.append(Leak(
+                                "life-double-release", fn, stmt,
+                                f"{recv}.{call.func.attr}() already called "
+                                f"unconditionally at line "
+                                f"{getattr(seen[key], 'lineno', '?')} in this "
+                                "block — the second call is dead code or a "
+                                "double release"))
+                        else:
+                            seen[key] = stmt
+            for block in _child_blocks(stmt):
+                scan(block)
+
+    scan(getattr(fn.node, "body", []))
+
+
+def run_resources(cg: CallGraph) -> list[Leak]:
+    out: list[Leak] = []
+    for mod in cg.modules.values():
+        for fn in walk_functions(mod):
+            scan_function(fn, mod, out)
+    return out

@@ -1,0 +1,100 @@
+"""qrlife CLI — ``python -m tools.analysis.life.run <package-or-path>``.
+
+Exit status mirrors the qrlint/qrflow/qrkernel/qrproto ratchet contract:
+0 when the tree is clean (modulo explicit, JUSTIFIED suppressions), 1
+when any error-severity finding remains, 2 on usage errors.
+``--format json``/``--format sarif`` emit machine-readable output;
+``--dump-lock-graph`` prints the project lock-order graph (one
+``src -> dst  site`` line per edge) instead of linting — the quickest
+way to see why a ``life-lock-cycle`` finding names the locks it does.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import argparse
+
+from ..engine import Engine, render_findings, resolve_target
+from ..flow.sarif import to_sarif
+from . import life_rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="qrlife",
+        description=("lock-discipline & resource-lifetime verifier for the "
+                     "multi-process fleet (docs/static_analysis.md)"),
+    )
+    ap.add_argument("targets", nargs="*", default=["quantum_resistant_p2p_tpu"],
+                    help="files, directories, or package names (default: the package)")
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human", help="output format (default: human)")
+    ap.add_argument("--json", action="store_true",
+                    help="alias for --format json (qrlint compatibility)")
+    ap.add_argument("--select", help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    ap.add_argument("--dump-lock-graph", action="store_true",
+                    help="print the lock-order graph edges and exit")
+    args = ap.parse_args(argv)
+
+    rules = life_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.id:30} [{rule.severity}] {rule.description}")
+        return 0
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",")}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"qrlife: unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+    if args.ignore:
+        dropped = {r.strip() for r in args.ignore.split(",")}
+        rules = [r for r in rules if r.id not in dropped]
+
+    targets = [resolve_target(t, "qrlife")
+               for t in (args.targets or ["quantum_resistant_p2p_tpu"])]
+    fmt = "json" if args.json else args.format
+
+    if args.dump_lock_graph:
+        from ..engine import FileContext, Project
+        from .packs import LifeAnalysis
+        contexts = {}
+        for t in targets:
+            files = sorted(t.rglob("*.py")) if t.is_dir() else [t]
+            for f in files:
+                try:
+                    contexts[str(f)] = FileContext(str(f), f.read_text(encoding="utf-8"))
+                except (SyntaxError, UnicodeDecodeError, OSError):
+                    continue
+        analysis = LifeAnalysis.of(Project(contexts))
+        for e in sorted(analysis.locks.edges,
+                        key=lambda e: (e.src, e.dst, e.fn.path)):
+            site = f"{e.fn.path}:{getattr(e.node, 'lineno', '?')}"
+            via = f"  (via {e.via})" if e.via else ""
+            print(f"{e.src} -> {e.dst}  {site} in {e.fn.qualname}{via}")
+        return 0
+
+    engine = Engine(rules)
+    findings, suppressed = engine.lint_paths(targets)
+
+    if fmt == "sarif":
+        print(json.dumps(to_sarif(findings, suppressed, rules,
+                                  tool_name="qrlife"), indent=2))
+    else:
+        out = render_findings(findings, suppressed, as_json=(fmt == "json"))
+        if out and fmt == "human":
+            lines = out.splitlines()
+            lines[-1] = lines[-1].replace("qrlint:", "qrlife:", 1)
+            out = "\n".join(lines)
+        if out:
+            print(out)
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

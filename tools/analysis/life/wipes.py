@@ -1,0 +1,330 @@
+"""Secret-lifetime completeness: every SECRET local reaches a wipe.
+
+Generalizes PR 4's hand-maintained discipline — shared secrets, ticket
+master secrets, and decapsulation outputs are wiped on the paths someone
+remembered — into a checked property: any local bound from a SECRET
+source in qrflow's taint lattice (``decapsulate``, ``open_ticket``'s
+secret element, ``derive_resumption_secret``, …; the source set is
+imported from the lattice's crypto-op MODELS, never duplicated) must
+reach ``_wipe()``/``zeroize()`` on **every** explicit function exit
+path, unless ownership escapes first (returned, stored into an object's
+state — attribute zeroization is qrlint's beat — or handed to a
+container).
+
+Discharge events per secret local:
+
+* any ``WIPERS`` call taking it (``_wipe(ss)``) or receiver-form
+  ``ss.zeroize()``;
+* a ``bytearray(ss)``/``bytes(ss)`` rebind — the wipeable twin inherits
+  the obligation and the immutable original is unredeemable by
+  construction (flagging it would demand the impossible);
+* escape: ``return``/``yield``, attribute/subscript store, or a storing
+  method call (``append``/``add``/``put``/``setdefault``/…).
+
+Passing a secret to a KDF does NOT discharge it — the caller still
+holds the buffer; that is precisely the rekey-path bug class this rule
+exists for.  A wipe inside an enclosing ``finally`` covers every exit
+inside that ``try``.
+
+Scope: ``pyref/`` is excluded (pure-Python FIPS references — secret
+arithmetic IS the algorithm there, mirroring qrflow's CT_EXCLUDE), and
+functions that *are* wipers or sources are exempt (their internals are
+the implementation being modelled).
+
+Known limitation (documented contract): v1 proves explicit exits —
+``return`` statements and fall-off-the-end.  Exception-edge
+completeness composes with ``life-leak-on-raise``'s ``finally``
+discipline rather than duplicating it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from ..engine import last_attr
+from ..flow.taint import MODELS, SECRET, WIPERS
+from .callgraph_shim import CallGraph, FunctionInfo, walk_functions
+
+#: paths excluded from wipe-completeness (see module doc)
+WIPE_EXCLUDE = ("pyref/", "pyref\\")
+
+#: call leaves whose whole return value is SECRET / whose tuple elements
+#: are — derived from qrflow's MODELS so the two analyzers can never drift
+SECRET_CALLS: dict[str, tuple[int, ...] | None] = {}
+for _name, _taint in MODELS.items():
+    if _taint.level != SECRET:
+        continue
+    if _taint.elements is None:
+        SECRET_CALLS[_name] = None          # whole value is secret
+    else:
+        idxs = tuple(i for i, el in enumerate(_taint.elements)
+                     if el.level == SECRET)
+        if idxs:
+            SECRET_CALLS[_name] = idxs       # these unpack elements are
+
+#: receiver-method calls that store their argument somewhere longer-lived
+_STORING_METHODS = {"append", "add", "put", "put_nowait", "insert",
+                    "setdefault", "store", "extend"}
+
+_LIVE, _WIPED, _ESCAPED = "live", "wiped", "escaped"
+
+
+@dataclasses.dataclass
+class WipeGap:
+    fn: FunctionInfo
+    node: ast.AST
+    message: str
+
+
+def _source_of(value: ast.AST) -> tuple[str, tuple[int, ...] | None] | None:
+    if isinstance(value, ast.Await):
+        value = value.value
+    if not isinstance(value, ast.Call):
+        return None
+    leaf = last_attr(value.func) or ""
+    if leaf in SECRET_CALLS:
+        return leaf, SECRET_CALLS[leaf]
+    return None
+
+
+def _names_in(expr: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+class _FnWipeScan:
+    def __init__(self, fn: FunctionInfo, out: list[WipeGap]):
+        self.fn = fn
+        self.out = out
+        self.sources: dict[str, str] = {}      # local -> provenance
+        self.reported: set[str] = set()
+        self.finally_wiped: list[set[str]] = []  # stack of enclosing covers
+
+    def run(self) -> None:
+        state = self._exec_block(getattr(self.fn.node, "body", []), {})
+        if state is not None:
+            self._check_exit(state, self.fn.node, "falls off the end")
+
+    # -- state machine ------------------------------------------------------
+
+    def _exec_block(self, stmts: list[ast.stmt],
+                    state: dict[str, str] | None) -> dict[str, str] | None:
+        for stmt in stmts:
+            if state is None:
+                return None
+            state = self._exec_stmt(stmt, state)
+        return state
+
+    def _exec_stmt(self, stmt: ast.stmt,
+                   state: dict[str, str]) -> dict[str, str] | None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                # the returned value is an ownership transfer, not a gap
+                self._mark_escapes(_names_in(stmt.value), state)
+            self._check_exit(state, stmt,
+                             f"returns at line {stmt.lineno}")
+            return None
+        if isinstance(stmt, ast.Raise):
+            return None   # exception exits compose with life-leak-on-raise
+        if isinstance(stmt, ast.Assign):
+            self._scan_events(stmt, state)
+            self._bind(stmt, state)
+            return state
+        if isinstance(stmt, ast.If):
+            self._scan_events_expr(stmt.test, state)
+            a = self._exec_block(stmt.body, dict(state))
+            b = self._exec_block(stmt.orelse, dict(state))
+            return _merge(a, b)
+        if isinstance(stmt, ast.Try):
+            cover = set()
+            for s in stmt.finalbody:
+                cover |= self._wipes_in(s)
+            self.finally_wiped.append(cover)
+            try:
+                body = self._exec_block(stmt.body, dict(state))
+                if stmt.orelse and body is not None:
+                    body = self._exec_block(stmt.orelse, dict(body))
+                merged = body
+                for handler in stmt.handlers:
+                    merged = _merge(merged,
+                                    self._exec_block(handler.body, dict(state)))
+            finally:
+                self.finally_wiped.pop()
+            return self._exec_block(stmt.finalbody,
+                                    merged if merged is not None else dict(state))
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            for expr in _stmt_exprs(stmt):
+                self._scan_events_expr(expr, state)
+            once = self._exec_block(stmt.body, dict(state))
+            merged = _merge(once, state)
+            return self._exec_block(stmt.orelse, merged) if stmt.orelse else merged
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_events_expr(item.context_expr, state)
+            return self._exec_block(stmt.body, state)
+        for expr in _stmt_exprs(stmt):
+            self._scan_events_expr(expr, state)
+        return state
+
+    # -- events -------------------------------------------------------------
+
+    def _bind(self, stmt: ast.Assign, state: dict[str, str]) -> None:
+        if len(stmt.targets) != 1:
+            return
+        target = stmt.targets[0]
+        src = _source_of(stmt.value)
+        if src is not None:
+            leaf, idxs = src
+            if idxs is None and isinstance(target, ast.Name):
+                self.sources[target.id] = f"{leaf}()"
+                state[target.id] = _LIVE
+            elif idxs is not None and isinstance(target, ast.Tuple):
+                for i in idxs:
+                    if i < len(target.elts) and isinstance(
+                            target.elts[i], ast.Name):
+                        name = target.elts[i].id
+                        if name == "_":   # explicit discard placeholder
+                            continue
+                        self.sources[name] = f"{leaf}()[{i}]"
+                        state[name] = _LIVE
+            return
+        # bytearray/bytes twin: the wipeable copy inherits the obligation
+        value = stmt.value
+        if (isinstance(value, ast.Call)
+                and (last_attr(value.func) or "") in ("bytearray", "bytes")
+                and value.args and isinstance(value.args[0], ast.Name)
+                and isinstance(target, ast.Name)):
+            old = value.args[0].id
+            if state.get(old) == _LIVE:
+                state[old] = _ESCAPED
+                self.sources[target.id] = self.sources.get(
+                    old, "secret") + " via bytearray copy"
+                state[target.id] = _LIVE
+                return
+        # plain rebind of a tracked name drops the old obligation silently
+        # only when the old value was already handled; a live rebind is a
+        # lost buffer
+        if isinstance(target, ast.Name) and state.get(target.id) == _LIVE:
+            self.out.append(WipeGap(
+                self.fn, stmt,
+                f"`{target.id}` (from {self.sources.get(target.id)}) is "
+                "rebound while still holding unwiped key material — wipe "
+                "before reassigning"))
+            self.reported.add(target.id)
+            state[target.id] = _ESCAPED
+        # storing the secret somewhere (attribute/subscript) = escape
+        if not isinstance(target, ast.Name):
+            self._mark_escapes(_names_in(stmt.value), state)
+
+    def _wipes_in(self, root: ast.AST) -> set[str]:
+        got: set[str] = set()
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = last_attr(node.func) or ""
+            if leaf in WIPERS:
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        got.add(a.id)
+                if (isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)):
+                    got.add(node.func.value.id)
+        return got
+
+    def _scan_events(self, stmt: ast.stmt, state: dict[str, str]) -> None:
+        for expr in _stmt_exprs(stmt):
+            self._scan_events_expr(expr, state)
+
+    def _scan_events_expr(self, expr: ast.AST, state: dict[str, str]) -> None:
+        for name in self._wipes_in(expr):
+            if name in state:
+                state[name] = _WIPED
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = last_attr(node.func) or ""
+            storing = (leaf in _STORING_METHODS
+                       and isinstance(node.func, ast.Attribute))
+            # a method on bare `self` delegates within the object — the
+            # callee (also under this rule) owns the buffer from here on;
+            # a plain function / other-object method does NOT discharge
+            # (the KDF-pass case the rule exists for)
+            self_method = (isinstance(node.func, ast.Attribute)
+                           and isinstance(node.func.value, ast.Name)
+                           and node.func.value.id == "self")
+            if storing or self_method:
+                for a in [*node.args, *[kw.value for kw in node.keywords]]:
+                    if isinstance(a, ast.Name) and a.id in state:
+                        state[a.id] = _ESCAPED
+                    elif isinstance(a, (ast.Tuple, ast.List, ast.Set,
+                                        ast.Dict)):
+                        # out.append((pk, sk, sig)): the container owns it
+                        for nm in _names_in(a):
+                            if nm in state:
+                                state[nm] = _ESCAPED
+        # yields inside expression statements
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Yield, ast.YieldFrom)) and node.value:
+                self._mark_escapes(_names_in(node.value), state)
+
+    def _mark_escapes(self, names: set[str], state: dict[str, str]) -> None:
+        for name in names:
+            if name in state and state[name] == _LIVE:
+                state[name] = _ESCAPED
+
+    def _check_exit(self, state: dict[str, str], node: ast.AST,
+                    how: str) -> None:
+        covered = set().union(*self.finally_wiped) if self.finally_wiped else set()
+        for name, st in sorted(state.items()):
+            if st != _LIVE or name in covered or name in self.reported:
+                continue
+            self.reported.add(name)
+            self.out.append(WipeGap(
+                self.fn, node if hasattr(node, "lineno") else self.fn.node,
+                f"`{name}` (from {self.sources.get(name, 'a SECRET source')}) "
+                f"does not reach _wipe()/zeroize() where {self.fn.qualname}() "
+                f"{how} — wipe it on every exit path or transfer ownership"))
+
+
+def _merge(a: dict[str, str] | None,
+           b: dict[str, str] | None) -> dict[str, str] | None:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    out: dict[str, str] = {}
+    for name in a.keys() | b.keys():
+        sa, sb = a.get(name), b.get(name)
+        if sa == _LIVE or sb == _LIVE:
+            out[name] = _LIVE
+        else:
+            out[name] = sa or sb  # type: ignore[assignment]
+    return out
+
+
+def _stmt_exprs(stmt: ast.stmt):
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+
+
+def run_wipes(cg: CallGraph) -> list[WipeGap]:
+    out: list[WipeGap] = []
+    for mod in cg.modules.values():
+        if any(frag in mod.path for frag in WIPE_EXCLUDE):
+            continue
+        for fn in walk_functions(mod):
+            if fn.name in WIPERS or fn.name in SECRET_CALLS:
+                continue
+            _FnWipeScan(fn, out).run()
+    return out

@@ -53,7 +53,7 @@ _FALLBACK_COUNTER_RE = re.compile(
 #: every analyzer prefix the engine accepts — a proto id suppressed via the
 #: qrlint/qrkernel spelling must be policed all the same
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:qrlint|qrkernel|qrproto):\s*disable(?:-file)?\s*=\s*"
+    r"#\s*(?:qrlint|qrkernel|qrproto|qrlife):\s*disable(?:-file)?\s*=\s*"
     r"(?P<rules>[\w.,\- ]+)(?P<rest>.*)$")
 
 

@@ -20,17 +20,26 @@ echo "python -m quantum_resistant_p2p_tpu --help ok"
 # Static-analysis ratchets (docs/static_analysis.md): the unified driver
 # runs qrlint (AST lint) -> qrflow (interprocedural taint/race) -> qrkernel
 # (abstract-interpretation kernel verifier) -> qrproto (protocol-contract
-# verifier) with ONE exit code, and asserts the suppression budget
-# (tools/analysis/suppression_budget.json): counts per analyzer may only
-# go down — an unbudgeted suppression fails loudly.
+# verifier) -> qrlife (lock-discipline / resource-lifetime / wipe-
+# completeness verifier) with ONE exit code, and asserts the suppression
+# budget (tools/analysis/suppression_budget.json): counts per analyzer may
+# only go down — an unbudgeted suppression fails loudly.
 python -m tools.analysis.all quantum_resistant_p2p_tpu
-echo "qr-analysis clean (qrlint + qrflow + qrkernel + qrproto, within suppression budget)"
+echo "qr-analysis clean (qrlint + qrflow + qrkernel + qrproto + qrlife, within suppression budget)"
 
 # The protocol model must still extract (send/handler/feature tables for
 # docs/protocol.md) — a refactor that breaks extraction would silently
 # blind the contract checks, so probe the dump path explicitly.
 python -m tools.analysis.proto.run quantum_resistant_p2p_tpu --dump-model >/dev/null
 echo "qrproto --dump-model ok"
+
+# The lock-order graph must still extract (the deadlock check is only as
+# good as the edges it sees) — probe the dump path and require the known
+# scheduler->instrument edge to be present.
+python -m tools.analysis.life.run quantum_resistant_p2p_tpu --dump-lock-graph \
+    | grep -q "DeviceProgramScheduler._lock" \
+    || { echo "qrlife --dump-lock-graph lost the scheduler lock edges" >&2; exit 1; }
+echo "qrlife --dump-lock-graph ok"
 
 # Gateway storm smoke (docs/gateway.md): a fast 48-session storm through
 # the real TCP transport + protocol engine + autotuner must complete with
@@ -77,6 +86,20 @@ echo "drain smoke ok (rolling restart survived: 0 lost sessions, >=1 ticket resu
 # dead leader's STEK (the replicated accept window really survived).
 python bench.py --storm --fleet 2 --router-roll --routers 2 --sessions 40 >/dev/null
 echo "router-roll smoke ok (leader SIGKILL + router roll survived: 0 lost sessions, post-failover ticket resume)"
+
+# Committed-artifact size cap: metrics snapshots are DIGESTS by default
+# (tools/swarm_bench.py snapshot_digest — a storm's raw dump is one
+# registry per session, ~240k lines); a snapshot over 256 KiB means some
+# path regressed to the raw dump without --full-snapshots.
+for f in bench_results/*_metrics_snapshot.json; do
+    [ -e "$f" ] || continue
+    size=$(wc -c < "$f")
+    if [ "$size" -gt 262144 ]; then
+        echo "committed metrics snapshot too big: $f (${size} bytes > 256 KiB) — digest mode regressed?" >&2
+        exit 1
+    fi
+done
+echo "metrics-snapshot size cap ok (digests only)"
 
 # FrodoKEM device-path smoke (docs/dispatch_budget.md "Kernel matrix"):
 # a 2-batch keygen/encaps/decaps roundtrip through the tpu-backend

@@ -98,6 +98,14 @@ SECTIONS: dict[str, list[str]] = {
         "tools.analysis.kernel.pallas_checks",
         "tools.analysis.kernel.dataflow",
         "tools.analysis.kernel.packs",
+        "tools.analysis.proto",
+        "tools.analysis.proto.model",
+        "tools.analysis.proto.packs",
+        "tools.analysis.life",
+        "tools.analysis.life.locks",
+        "tools.analysis.life.resources",
+        "tools.analysis.life.wipes",
+        "tools.analysis.life.packs",
         "tools.analysis.all",
     ],
 }

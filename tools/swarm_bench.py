@@ -311,13 +311,83 @@ async def run_swarm(n_peers: int, backend: str, use_batching: bool,
     return stats
 
 
+def snapshot_digest(snap: dict) -> dict:
+    """Compact a ``global_snapshot()`` for committing: a storm creates one
+    registry PER SESSION (``messaging:peer01234``, plus ``#N`` dedup
+    suffixes), so the raw dump runs to ~240k lines of mostly-identical
+    per-peer histogram buckets.  The digest groups registries by class
+    (everything before ``:``), sums counters, folds gauges to
+    min/mean/max over the non-null instances, and merges histograms to
+    bucketless count/sum/p50/p99 ranges — a few hundred lines that still
+    answer every question the committed artifact exists for (rates,
+    tails, totals).  Pass ``--full-snapshots`` for the raw dump.
+    """
+    groups: dict[str, list[dict]] = {}
+    for name, reg in snap.items():
+        groups.setdefault(str(name).split(":", 1)[0].split("#", 1)[0],
+                          []).append(reg)
+    digest: dict[str, dict] = {"_digest": {
+        "registries": len(snap),
+        "groups": {k: len(v) for k, v in sorted(groups.items())},
+    }}
+    for key, regs in sorted(groups.items()):
+        counters: dict[str, float] = {}
+        gauges: dict[str, list[float]] = {}
+        hists: dict[str, dict] = {}
+        for reg in regs:
+            for cname, val in (reg.get("counters") or {}).items():
+                if isinstance(val, (int, float)):
+                    counters[cname] = counters.get(cname, 0) + val
+            for gname, val in (reg.get("gauges") or {}).items():
+                if isinstance(val, (int, float)):
+                    gauges.setdefault(gname, []).append(val)
+            for hname, h in (reg.get("histograms") or {}).items():
+                if not isinstance(h, dict):
+                    continue
+                agg = hists.setdefault(hname, {"count": 0, "sum": 0.0,
+                                               "p50": [], "p99": []})
+                agg["count"] += h.get("count") or 0
+                agg["sum"] += h.get("sum") or 0.0
+                for p in ("p50", "p99"):
+                    if isinstance(h.get(p), (int, float)):
+                        agg[p].append(h[p])
+        digest[key] = {
+            "instances": len(regs),
+            "counters": dict(sorted(counters.items())),
+            "gauges": {g: {"min": min(vs), "max": max(vs),
+                           "mean": round(sum(vs) / len(vs), 6)}
+                       for g, vs in sorted(gauges.items())},
+            "histograms": {h: {"count": agg["count"],
+                               "sum": round(agg["sum"], 6),
+                               "p50_range": ([min(agg["p50"]), max(agg["p50"])]
+                                             if agg["p50"] else None),
+                               "p99_range": ([min(agg["p99"]), max(agg["p99"])]
+                                             if agg["p99"] else None)}
+                           for h, agg in sorted(hists.items())},
+        }
+    return digest
+
+
+#: process-wide default for ``write_obs_artifacts`` (set_full_snapshots);
+#: lets bench.py's many mode functions honor ONE --full-snapshots flag
+#: without threading it through every signature
+_FULL_SNAPSHOTS = False
+
+
+def set_full_snapshots(value: bool) -> None:
+    global _FULL_SNAPSHOTS
+    _FULL_SNAPSHOTS = bool(value)
+
+
 def write_obs_artifacts(stats: dict, out_dir: str | Path,
-                        stem: str = "swarm") -> dict:
+                        stem: str = "swarm",
+                        full_snapshots: bool | None = None) -> dict:
     """Attach the run's observability artifacts to its JSON output
     (bench_results/): a chrome://tracing trace-event file of the recorded
     spans, the MERGED multi-node flame graph (one process lane per node,
     flow arrows on the propagated cross-peer parent edges —
-    tools/trace_merge.py), and a metrics snapshot of every live registry.
+    tools/trace_merge.py), and a metrics snapshot of every live registry
+    — digested by :func:`snapshot_digest` unless ``full_snapshots``.
     Returns the paths added to ``stats``.  CI uploads these next to the
     qrflow SARIF.
     """
@@ -336,8 +406,12 @@ def write_obs_artifacts(stats: dict, out_dir: str | Path,
     merged_path = out / f"{stem}_merged_trace.json"
     merged_path.write_text(json.dumps(merged))
     metrics_path = out / f"{stem}_metrics_snapshot.json"
-    metrics_path.write_text(json.dumps(obs_metrics.global_snapshot(),
-                                       indent=2, default=str))
+    if full_snapshots is None:
+        full_snapshots = _FULL_SNAPSHOTS
+    snap = obs_metrics.global_snapshot()
+    if not full_snapshots:
+        snap = snapshot_digest(snap)
+    metrics_path.write_text(json.dumps(snap, indent=2, default=str))
     stats["obs"] = {
         "spans_recorded": len(records),
         "trace_events_file": str(trace_path),
@@ -345,6 +419,7 @@ def write_obs_artifacts(stats: dict, out_dir: str | Path,
         "merged_nodes": merged["otherData"]["merged_nodes"],
         "cross_node_edges": merged["otherData"]["cross_node_edges"],
         "metrics_snapshot_file": str(metrics_path),
+        "metrics_snapshot_mode": "full" if full_snapshots else "digest",
     }
     return stats["obs"]
 
@@ -932,6 +1007,10 @@ def main(argv=None) -> int:
                     help="directory for the trace-event, merged multi-node "
                          "trace, and metrics-snapshot artifacts (slo/storm "
                          "modes; '' disables)")
+    ap.add_argument("--full-snapshots", action="store_true",
+                    help="write the RAW per-registry metrics snapshot "
+                         "(~MBs for a storm: one registry per session) "
+                         "instead of the compact committed digest")
     ap.add_argument("--storm", action="store_true",
                     help="sustained-traffic storm: --peers concurrent live "
                          "sessions with arrival pacing, rekey/bulk mix and "
@@ -1010,7 +1089,8 @@ def main(argv=None) -> int:
             fault_rules=rules,
         ))
         if args.obs_dir:
-            write_obs_artifacts(stats, args.obs_dir, stem="fleet_storm")
+            write_obs_artifacts(stats, args.obs_dir, stem="fleet_storm",
+                                full_snapshots=args.full_snapshots)
             write_fleet_artifacts(stats, args.obs_dir)
         print(json.dumps(stats))
         # the fleet chaos currency: no ESTABLISHED session may be lost —
@@ -1035,7 +1115,8 @@ def main(argv=None) -> int:
             resume_mix=args.resume_mix,
         ))
         if args.obs_dir:
-            write_obs_artifacts(stats, args.obs_dir, stem="storm")
+            write_obs_artifacts(stats, args.obs_dir, stem="storm",
+                                full_snapshots=args.full_snapshots)
         print(json.dumps(stats))
         return 0 if stats["failures"] == 0 else 1
     if args.slo:
@@ -1047,7 +1128,8 @@ def main(argv=None) -> int:
                   args.shard_devices)
     )
     if args.slo and args.obs_dir:
-        write_obs_artifacts(stats, args.obs_dir)
+        write_obs_artifacts(stats, args.obs_dir,
+                            full_snapshots=args.full_snapshots)
     print(json.dumps(stats))
     return 0 if stats["failures"] == 0 else 1
 
